@@ -1,0 +1,99 @@
+"""Benchmark: pod-node pairs scored per second (BASELINE.md config 4 shape).
+
+Runs the full sequential-commit scheduling scan (10k pods x 5k nodes,
+every pod x node pair filtered AND scored by every enabled plugin) and the
+one-shot batch evaluation, on whatever jax default backend is live (TPU
+under the driver).  Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N/50000}
+Baseline: >= 50k pairs/sec north star (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=10_000)
+    ap.add_argument("--nodes", type=int, default=5_000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    t0 = time.perf_counter()
+    from ksim_tpu.engine import Engine
+    from ksim_tpu.engine.profiles import default_plugins
+    from ksim_tpu.state.featurizer import Featurizer
+    from tests.helpers import random_cluster
+
+    nodes, pods = random_cluster(
+        args.seed, n_nodes=args.nodes, n_pods=args.pods, bound_fraction=0.0
+    )
+    t1 = time.perf_counter()
+    feats = Featurizer().featurize(nodes, pods)
+    t2 = time.perf_counter()
+    print(
+        f"built {args.pods} pods x {args.nodes} nodes on {jax.devices()[0].platform}; "
+        f"gen {t1-t0:.1f}s featurize {t2-t1:.1f}s; padded "
+        f"P={feats.pods.valid.shape[0]} N={feats.nodes.padded}",
+        file=sys.stderr,
+    )
+
+    def plugins():
+        return default_plugins(feats)
+
+    pairs = args.pods * args.nodes
+
+    # Sequential-commit scan (the real scheduling semantics) — headline.
+    eng = Engine(feats, plugins(), record="selection")
+    eng.schedule()  # compile + warmup
+    times = []
+    for _ in range(args.repeats):
+        t = time.perf_counter()
+        res, _state = eng.schedule()
+        times.append(time.perf_counter() - t)
+    sched_s = min(times)
+    sched_pairs = pairs / sched_s
+
+    # One-shot batch evaluation, record="full": materializes every filter
+    # reason / raw score / final score matrix (the product's recorded
+    # results), unlike the selection-only scan above.
+    engb = Engine(feats, plugins(), record="full")
+    engb.evaluate_batch()
+    times = []
+    for _ in range(args.repeats):
+        t = time.perf_counter()
+        engb.evaluate_batch()
+        times.append(time.perf_counter() - t)
+    batch_s = min(times)
+    batch_pairs = pairs / batch_s
+
+    n_sched = int((res.selected >= 0).sum())
+    print(
+        f"scan {sched_s*1e3:.1f}ms ({sched_pairs/1e6:.1f}M pairs/s, {n_sched} placed), "
+        f"batch {batch_s*1e3:.1f}ms ({batch_pairs/1e6:.1f}M pairs/s)",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "sched_pairs_per_sec",
+                "value": round(sched_pairs),
+                "unit": "pod-node pairs/s (sequential-commit scan, 10k pods x 5k nodes)",
+                "vs_baseline": round(sched_pairs / 50_000, 2),
+                "batch_pairs_per_sec": round(batch_pairs),
+                "pods_scheduled": n_sched,
+                "platform": jax.devices()[0].platform,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

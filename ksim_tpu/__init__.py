@@ -1,0 +1,29 @@
+"""ksim_tpu — a TPU-native Kubernetes scheduler simulator.
+
+A re-imagining of kubernetes-sigs/kube-scheduler-simulator (reference at
+/root/reference, see SURVEY.md): the debuggable scheduler's per-(pod, node,
+plugin) Filter/Score hot loop (reference:
+simulator/scheduler/plugin/wrappedplugin.go:420-548) is collapsed into fused
+JAX kernels evaluating all pod-by-node filter masks and score matrices in one
+vmap/pjit pass on TPU, while preserving the reference's product surface:
+
+- per-plugin, per-node scheduling results recorded as explainable annotations
+  (reference: simulator/scheduler/plugin/resultstore/store.go)
+- snapshot export/import with a JSON schema compatible with the reference's
+  ``ResourcesForSnap`` (reference: simulator/snapshot/snapshot.go:33-54)
+- KubeSchedulerConfiguration-driven profiles ("profile compilation" replaces
+  the reference's Docker-restart reload, simulator/scheduler/scheduler.go:58-111)
+- scenario replay (reference design: keps/140-scenario-based-simulation)
+- a watchable REST/SSE API (reference: simulator/server/server.go:41-54)
+
+Layout (maps to SURVEY.md section 7):
+    state/     cluster state, quantities, snapshot JSON, featurizer
+    plugins/   per-plugin kernel pairs (filter/score), numpy parity models
+    engine/    batched evaluation, lax.scan commit loop, sharding
+    sched/     scheduling framework: registry, wrapped plugins, result store
+    server/    REST + SSE simulator shell
+    services/  reset / syncer / importer / resource watcher
+    scenario/  replay harness
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+"""Batched TPU scheduling engine."""
+
+from ksim_tpu.engine.core import Engine, EngineResult, ScoredPlugin
+
+__all__ = ["Engine", "EngineResult", "ScoredPlugin"]

@@ -1,0 +1,29 @@
+"""Default plugin set for the engine.
+
+The subset of the upstream default profile implemented so far, with the
+upstream default score weights (upstream pkg/scheduler/apis/config/v1/
+default_plugins.go getDefaultPlugins).  Grows as plugins land; the full
+KubeSchedulerConfiguration-driven profile compiler lives in sched/config.
+"""
+
+from __future__ import annotations
+
+from ksim_tpu.engine.core import ScoredPlugin
+from ksim_tpu.plugins.nodeunschedulable import NodeUnschedulable
+from ksim_tpu.plugins.noderesources import (
+    NodeResourcesBalancedAllocation,
+    NodeResourcesFit,
+)
+from ksim_tpu.state.featurizer import FeaturizedSnapshot
+
+
+def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
+    return (
+        ScoredPlugin(NodeUnschedulable(), score_enabled=False),
+        ScoredPlugin(NodeResourcesFit(feats.resources), weight=1),
+        ScoredPlugin(
+            NodeResourcesBalancedAllocation(feats.resources),
+            weight=1,
+            filter_enabled=False,
+        ),
+    )

@@ -1,0 +1,67 @@
+"""Device-mesh sharding of the scheduling engine.
+
+The pod-by-node evaluation has two natural parallel axes (SURVEY.md
+section 2.4): the pod batch (data parallel, "dp") and the node axis
+(tensor parallel, "tp") — the reference has neither (its loop is
+sequential Go, simulator/scheduler/plugin/wrappedplugin.go:523-548).
+
+We annotate input shardings with jax.sharding.NamedSharding and let
+GSPMD insert the collectives: node-axis reductions (any/argmax over
+sharded N) lower to psum/all-gather over ICI.  No hand-written
+collectives — the idiomatic JAX approach (scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ksim_tpu.plugins.base import NodeStateView
+
+DP, TP = "dp", "tp"
+
+
+def make_mesh(n_devices: int | None = None, *, dp: int | None = None) -> Mesh:
+    """(dp, tp) mesh over the first n devices; tp gets the larger factor
+    since the node axis dominates memory."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    if dp is None:
+        dp = 2 if n % 2 == 0 and n > 2 else 1
+    tp = n // dp
+    if dp * tp != n:
+        raise ValueError(f"cannot factor {n} devices into dp={dp} x tp={tp}")
+    return Mesh(np.asarray(devices).reshape(dp, tp), (DP, TP))
+
+
+def node_state_shardings(mesh: Mesh) -> NodeStateView:
+    """Shard every node-axis array over TP; replicate over DP."""
+    s1 = NamedSharding(mesh, P(TP))
+    s2 = NamedSharding(mesh, P(TP, None))
+    return NodeStateView(
+        allocatable=s2,
+        allowed_pods=s1,
+        valid=s1,
+        unschedulable=s1,
+        requested=s2,
+        nonzero_requested=s2,
+        pod_count=s1,
+    )
+
+
+def shard_pod_batch(pods, mesh: Mesh):
+    """Shard every pod-batch leaf over DP (leading axis)."""
+    def put(a):
+        spec = P(DP, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, pods)
+
+
+def shard_node_state(state: NodeStateView, mesh: Mesh) -> NodeStateView:
+    shardings = node_state_shardings(mesh)
+    return NodeStateView(
+        *(jax.device_put(a, s) for a, s in zip(state, shardings))
+    )

@@ -1,0 +1,17 @@
+"""Sentinel errors (analogue of reference simulator/errors/errors.go)."""
+
+
+class SimulatorError(Exception):
+    """Base class for simulator errors."""
+
+
+class NotFoundError(SimulatorError):
+    """Requested resource does not exist (reference: errors.ErrNotFound)."""
+
+
+class ConflictError(SimulatorError):
+    """Optimistic-concurrency conflict on a resource update."""
+
+
+class InvalidConfigError(SimulatorError):
+    """Configuration failed validation."""

@@ -1,0 +1,15 @@
+"""Scheduling-plugin kernel library.
+
+Each upstream kube-scheduler plugin the reference wraps (reference
+simulator/scheduler/plugin/wrappedplugin.go) is re-implemented twice here:
+
+1. a **batched JAX kernel pair** ``filter``/``score`` producing whole
+   node-axis vectors (vmapped over the pod axis by the engine), and
+2. a **pure-Python oracle** (`plugins/oracle.py`) that mirrors the upstream
+   Go code path exactly — the parity reference every kernel is tested
+   against (SURVEY.md section 4 test-plan implication).
+"""
+
+from ksim_tpu.plugins.base import BatchPlugin, FilterOutput, NodeStateView
+
+__all__ = ["BatchPlugin", "FilterOutput", "NodeStateView"]

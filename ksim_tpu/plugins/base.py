@@ -1,0 +1,95 @@
+"""Common types for batched plugin kernels.
+
+A batch plugin evaluates ONE pod against ALL nodes at once (the node axis is
+vectorized, and may be sharded over the TPU mesh); the engine vmaps over the
+pod axis for one-shot batch evaluation, or lax.scan's over pods for the
+sequential commit loop.  This replaces the reference's per-(pod, node,
+plugin) wrapped calls (reference simulator/scheduler/plugin/
+wrappedplugin.go:420-445 Score, :523-548 Filter).
+
+Reason codes: filters return an int32 bitmask per node instead of a status
+string; bit meanings are plugin-specific and decoded host-side into the
+exact upstream status messages for the result annotations ("Insufficient
+cpu", "Too many pods", ... — upstream noderesources/fit.go).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Protocol
+
+import jax.numpy as jnp
+
+# framework.MaxNodeScore — the single definition for the package.
+MAX_NODE_SCORE = 100
+
+
+class NodeStateView(NamedTuple):
+    """Dynamic + static per-node arrays visible to kernels.
+
+    Static across a scheduling run: allocatable, allowed_pods, valid,
+    unschedulable.  Dynamic (the lax.scan carry): requested,
+    nonzero_requested, pod_count.
+    """
+
+    allocatable: jnp.ndarray  # i32 [N, R]
+    allowed_pods: jnp.ndarray  # i32 [N]
+    valid: jnp.ndarray  # bool [N]
+    unschedulable: jnp.ndarray  # bool [N]
+    requested: jnp.ndarray  # i32 [N, R]
+    nonzero_requested: jnp.ndarray  # i32 [N, R]
+    pod_count: jnp.ndarray  # i32 [N]
+
+    def commit(self, node_idx: jnp.ndarray, pod_req: jnp.ndarray, pod_nz: jnp.ndarray) -> "NodeStateView":
+        """Charge a pod to node ``node_idx`` (no-op when node_idx < 0)."""
+        onehot = (jnp.arange(self.pod_count.shape[0]) == node_idx) & (node_idx >= 0)
+        return self._replace(
+            requested=self.requested + jnp.where(onehot[:, None], pod_req[None, :], 0),
+            nonzero_requested=self.nonzero_requested
+            + jnp.where(onehot[:, None], pod_nz[None, :], 0),
+            pod_count=self.pod_count + onehot.astype(jnp.int32),
+        )
+
+
+class PodView(NamedTuple):
+    """One pod's arrays as seen by kernels (a row of PodBatch)."""
+
+    requests: jnp.ndarray  # i32 [R]
+    nonzero_requests: jnp.ndarray  # i32 [R]
+    tolerates_unschedulable: jnp.ndarray  # bool scalar
+    has_requests: jnp.ndarray  # bool scalar (upstream fitsRequest early-exit)
+
+
+class PodBatch(NamedTuple):
+    """The pod axis as device arrays (leading dim P on every leaf)."""
+
+    requests: jnp.ndarray  # i32 [P, R]
+    nonzero_requests: jnp.ndarray  # i32 [P, R]
+    valid: jnp.ndarray  # bool [P]
+    tolerates_unschedulable: jnp.ndarray  # bool [P]
+    has_requests: jnp.ndarray  # bool [P]
+
+    def row(self, i) -> tuple["PodView", jnp.ndarray]:
+        return (
+            PodView(
+                requests=self.requests[i],
+                nonzero_requests=self.nonzero_requests[i],
+                tolerates_unschedulable=self.tolerates_unschedulable[i],
+                has_requests=self.has_requests[i],
+            ),
+            self.valid[i],
+        )
+
+
+class FilterOutput(NamedTuple):
+    ok: jnp.ndarray  # bool [N]
+    reason_bits: jnp.ndarray  # i32 [N], 0 == passed
+
+
+class BatchPlugin(Protocol):
+    """Static interface of a batched plugin module."""
+
+    name: str
+
+    def filter(self, state: NodeStateView, pod: PodView) -> FilterOutput: ...
+
+    def score(self, state: NodeStateView, pod: PodView) -> jnp.ndarray: ...

@@ -1,0 +1,184 @@
+"""NodeResourcesFit and NodeResourcesBalancedAllocation batched kernels.
+
+Semantics mirror upstream kube-scheduler v1.30 (the version the reference
+pins, simulator/go.mod):
+
+- Fit filter: ``pkg/scheduler/framework/plugins/noderesources/fit.go``
+  fitsRequest — "Too many pods" first, then per-resource
+  ``podRequest > allocatable - requested`` checks; base resources
+  (cpu/memory/ephemeral-storage) are always checked once the pod requests
+  anything at all, extended resources only when the pod requests them.
+- LeastAllocated score: ``noderesources/least_allocated.go``
+  leastResourceScorer — per-resource ``(c - r) * 100 // c`` (0 when the
+  resource is overcommitted), weight-averaged with integer division,
+  skipping zero-allocatable resources; ``r`` uses the *non-zero* request
+  accumulation (resource_allocation.go calculatePodResourceRequest).
+- BalancedAllocation score: ``noderesources/balanced_allocation.go``
+  balancedResourceScorer — fractions clamped to 1, two-resource case is
+  ``std = |f1 - f2| / 2``, score ``int64((1 - std) * 100)``.
+
+Integer exactness: with x64 enabled the balanced score is computed as an
+exact rational floor in int64 (``100 - ceil(50*|r1*c2 - r2*c1| / (c1*c2))``),
+which equals Go's float64 result except within ~1e-13 of integer
+boundaries; without x64 a float32 path with a +1e-4 floor nudge is used
+(documented tolerance, not bit-exact).  Fit/LeastAllocated are pure int32
+and bit-exact given the featurizer's gcd unit scaling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ksim_tpu.plugins.base import MAX_NODE_SCORE, FilterOutput, NodeStateView, PodView
+from ksim_tpu.state.resources import BASE_RESOURCES
+
+# Reason-bit layout for Fit: bit 0 = "Too many pods", bit 1+r = resource r.
+TOO_MANY_PODS_BIT = 0
+RESOURCE_BIT_BASE = 1
+MAX_RESOURCE_BITS = 30
+
+FIT_NAME = "NodeResourcesFit"
+BALANCED_NAME = "NodeResourcesBalancedAllocation"
+
+
+def _x64() -> bool:
+    return jax.config.jax_enable_x64
+
+
+class NodeResourcesFit:
+    """Filter + LeastAllocated score (upstream defaults: cpu=1, memory=1)."""
+
+    name = FIT_NAME
+
+    def __init__(
+        self,
+        resources: tuple[str, ...],
+        *,
+        score_resources: tuple[tuple[str, int], ...] = (("cpu", 1), ("memory", 1)),
+        base_resource_count: int = len(BASE_RESOURCES),
+    ) -> None:
+        self._resources = resources
+        self._base_count = min(base_resource_count, len(resources))
+        idx = {r: i for i, r in enumerate(resources)}
+        self._score_spec = tuple(
+            (idx[r], w) for r, w in score_resources if r in idx
+        )
+
+    # -- filter -------------------------------------------------------------
+
+    def filter(self, state: NodeStateView, pod: PodView) -> FilterOutput:
+        free = state.allocatable - state.requested  # [N, R]
+        podr = pod.requests  # [R]
+        r_axis = jnp.arange(podr.shape[0])
+        checked = (r_axis < self._base_count) | (podr > 0)  # [R]
+        # Upstream fitsRequest early-exits only when cpu/memory/ephemeral
+        # are all zero AND no scalar-resource key exists (even zero-valued
+        # keys defeat the early return) — the featurizer computes that
+        # predicate host-side (PodView.has_requests).
+        insufficient = checked[None, :] & (podr[None, :] > free) & pod.has_requests
+        too_many = state.pod_count + 1 > state.allowed_pods  # [N]
+
+        shift = jnp.minimum(r_axis + RESOURCE_BIT_BASE, MAX_RESOURCE_BITS)
+        # Bits are disjoint per resource, so sum == bitwise-or.  Resources
+        # past MAX_RESOURCE_BITS share a saturated bit; or them first.
+        res_bits = jnp.where(insufficient, (1 << shift)[None, :], 0).astype(jnp.int32)
+        or_reduced = jax.lax.reduce(
+            res_bits, jnp.zeros((), res_bits.dtype), jax.lax.bitwise_or, (1,)
+        )
+        bits = or_reduced | jnp.where(
+            too_many, 1 << TOO_MANY_PODS_BIT, 0
+        ).astype(or_reduced.dtype)
+        bits = bits.astype(jnp.int32)
+        return FilterOutput(ok=bits == 0, reason_bits=bits)
+
+    def decode_reasons(self, bits: int) -> list[str]:
+        """Reason bitmask -> upstream status reasons, in upstream order."""
+        out = []
+        if bits & (1 << TOO_MANY_PODS_BIT):
+            out.append("Too many pods")
+        for i, r in enumerate(self._resources):
+            if bits & (1 << min(i + RESOURCE_BIT_BASE, MAX_RESOURCE_BITS)):
+                out.append(f"Insufficient {r}")
+        return out
+
+    # -- score (LeastAllocated) ---------------------------------------------
+
+    def score(self, state: NodeStateView, pod: PodView) -> jnp.ndarray:
+        req = state.nonzero_requested + pod.nonzero_requests[None, :]  # [N, R]
+        node_score = jnp.zeros(state.pod_count.shape[0], dtype=jnp.int32)
+        weight_sum = jnp.zeros_like(node_score)
+        for ri, w in self._score_spec:
+            c = state.allocatable[:, ri]
+            r = req[:, ri]
+            has = c > 0
+            s = jnp.where(
+                has & (r <= c), ((c - r) * MAX_NODE_SCORE) // jnp.maximum(c, 1), 0
+            )
+            node_score = node_score + s.astype(jnp.int32) * w
+            weight_sum = weight_sum + jnp.where(has, w, 0)
+        return jnp.where(weight_sum > 0, node_score // jnp.maximum(weight_sum, 1), 0)
+
+
+class NodeResourcesBalancedAllocation:
+    """Balanced-allocation score (upstream defaults: cpu, memory)."""
+
+    name = BALANCED_NAME
+
+    def __init__(
+        self,
+        resources: tuple[str, ...],
+        *,
+        score_resources: tuple[str, ...] = ("cpu", "memory"),
+    ) -> None:
+        idx = {r: i for i, r in enumerate(resources)}
+        self._spec = tuple(idx[r] for r in score_resources if r in idx)
+
+    def filter(self, state: NodeStateView, pod: PodView) -> FilterOutput:
+        n = state.pod_count.shape[0]
+        ok = jnp.ones(n, dtype=bool)
+        return FilterOutput(ok=ok, reason_bits=jnp.zeros(n, dtype=jnp.int32))
+
+    def score(self, state: NodeStateView, pod: PodView) -> jnp.ndarray:
+        req = state.nonzero_requested + pod.nonzero_requests[None, :]
+        if len(self._spec) == 2 and _x64():
+            return self._score_exact2(state, req)
+        return self._score_float(state, req)
+
+    def _score_exact2(self, state: NodeStateView, req: jnp.ndarray) -> jnp.ndarray:
+        """Exact rational floor for the two-resource case, int64."""
+        i1, i2 = self._spec
+        c1 = state.allocatable[:, i1].astype(jnp.int64)
+        c2 = state.allocatable[:, i2].astype(jnp.int64)
+        r1 = jnp.minimum(req[:, i1].astype(jnp.int64), c1)
+        r2 = jnp.minimum(req[:, i2].astype(jnp.int64), c2)
+        both = (c1 > 0) & (c2 > 0)
+        # Skip zero-allocatable resources (upstream `continue`): with fewer
+        # than two fractions std == 0 and the score is exactly 100.
+        n = jnp.abs(r1 * c2 - r2 * c1) * 50
+        d = jnp.maximum(c1 * c2, 1)
+        score = MAX_NODE_SCORE - (n + d - 1) // d
+        return jnp.where(both, score, MAX_NODE_SCORE).astype(jnp.int32)
+
+    def _score_float(self, state: NodeStateView, req: jnp.ndarray) -> jnp.ndarray:
+        fracs = []
+        present = []
+        for ri in self._spec:
+            c = state.allocatable[:, ri].astype(jnp.float32)
+            r = req[:, ri].astype(jnp.float32)
+            f = jnp.minimum(jnp.where(c > 0, r / jnp.maximum(c, 1.0), 0.0), 1.0)
+            fracs.append(f)
+            present.append(c > 0)
+        f_mat = jnp.stack(fracs, axis=0)  # [S, N]
+        p_mat = jnp.stack(present, axis=0)
+        count = p_mat.sum(axis=0).astype(jnp.float32)  # [N]
+        safe_count = jnp.maximum(count, 1.0)
+        mean = jnp.where(p_mat, f_mat, 0.0).sum(axis=0) / safe_count
+        var = (jnp.where(p_mat, (f_mat - mean[None, :]) ** 2, 0.0)).sum(axis=0) / safe_count
+        # Upstream's two-fraction special case |f1 - f2| / 2 equals
+        # sqrt(variance) for two points, so sqrt(var) covers all counts.
+        std = jnp.where(count >= 2, jnp.sqrt(var), 0.0)
+        # +1e-4 nudge: floor() of a float32 value that is exactly integral
+        # in exact arithmetic can otherwise land one below.
+        score = jnp.floor((1.0 - std) * MAX_NODE_SCORE + 1e-4)
+        return score.astype(jnp.int32)

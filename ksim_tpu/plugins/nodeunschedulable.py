@@ -1,0 +1,31 @@
+"""NodeUnschedulable filter plugin.
+
+Upstream kube-scheduler v1.30 ``plugins/nodeunschedulable/node_unschedulable.go``:
+a node with ``spec.unschedulable`` fails the filter unless the pod tolerates
+the ``node.kubernetes.io/unschedulable:NoSchedule`` taint.  The toleration
+check is host-side boolean per pod (featurizer), so the kernel is a pure
+mask op.  Reason message matches upstream ``ErrReasonUnschedulable``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ksim_tpu.plugins.base import FilterOutput, NodeStateView, PodView
+from ksim_tpu.state.resources import UNSCHEDULABLE_TAINT  # noqa: F401 (re-export)
+
+NAME = "NodeUnschedulable"
+ERR_REASON_UNSCHEDULABLE = "node(s) were unschedulable"
+
+
+class NodeUnschedulable:
+    name = NAME
+
+    def filter(self, state: NodeStateView, pod: PodView) -> FilterOutput:
+        blocked = state.unschedulable & ~pod.tolerates_unschedulable
+        return FilterOutput(
+            ok=~blocked, reason_bits=jnp.where(blocked, 1, 0).astype(jnp.int32)
+        )
+
+    def decode_reasons(self, bits: int) -> list[str]:
+        return [ERR_REASON_UNSCHEDULABLE] if bits else []

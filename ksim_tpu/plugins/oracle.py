@@ -1,0 +1,183 @@
+"""Pure-Python parity oracle for every plugin.
+
+Direct, slow, obviously-correct re-derivations of upstream kube-scheduler
+v1.30 plugin code paths over Python ints (int64 semantics) and floats (IEEE
+double, same as Go float64).  The batched JAX kernels are tested
+golden-style against these (SURVEY.md section 4: "golden-file parity tests
+... against a pure-Python reference implementation of each plugin").
+
+The oracle operates on NodeInfo dicts built by ``build_node_infos`` —
+the analogue of the upstream scheduler cache NodeInfo.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+from ksim_tpu.state.resources import (
+    CPU,
+    EPHEMERAL_STORAGE,
+    JSON,
+    MEMORY,
+    PODS,
+    name_of,
+    pod_is_scheduled,
+    pod_node_name,
+    pod_requests,
+)
+
+from ksim_tpu.plugins.base import MAX_NODE_SCORE
+from ksim_tpu.state.resources import BASE_RESOURCES
+
+NodeInfo = dict[str, Any]
+
+
+def build_node_infos(nodes: Sequence[JSON], pods: Sequence[JSON]) -> list[NodeInfo]:
+    """NodeInfo accumulation: bound, non-terminal pods charge their node."""
+    from ksim_tpu.state.resources import node_allocatable
+
+    infos: list[NodeInfo] = []
+    by_name: dict[str, NodeInfo] = {}
+    for n in nodes:
+        alloc = node_allocatable(n)
+        info: NodeInfo = {
+            "node": n,
+            "name": name_of(n),
+            "allocatable": {r: v for r, v in alloc.items() if r != PODS},
+            "allowed_pods": alloc.get(PODS, 0),
+            "requested": {},
+            "nonzero_requested": {},
+            "pod_count": 0,
+        }
+        infos.append(info)
+        by_name[info["name"]] = info
+    for p in pods:
+        if not pod_is_scheduled(p):
+            continue
+        if p.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+            continue
+        info = by_name.get(pod_node_name(p))
+        if info is None:
+            continue
+        for r, v in pod_requests(p).items():
+            info["requested"][r] = info["requested"].get(r, 0) + v
+        for r, v in pod_requests(p, non_zero=True).items():
+            info["nonzero_requested"][r] = info["nonzero_requested"].get(r, 0) + v
+        info["pod_count"] += 1
+    return infos
+
+
+def commit_pod(info: NodeInfo, pod: JSON) -> None:
+    """Charge a newly scheduled pod to a NodeInfo (Reserve-phase commit)."""
+    for r, v in pod_requests(pod).items():
+        info["requested"][r] = info["requested"].get(r, 0) + v
+    for r, v in pod_requests(pod, non_zero=True).items():
+        info["nonzero_requested"][r] = info["nonzero_requested"].get(r, 0) + v
+    info["pod_count"] += 1
+
+
+# -- NodeUnschedulable ------------------------------------------------------
+
+
+def node_unschedulable_filter(pod: JSON, info: NodeInfo) -> list[str]:
+    """Upstream node_unschedulable.go Filter."""
+    from ksim_tpu.state.resources import (
+        node_unschedulable,
+        pod_tolerations,
+        tolerations_tolerate_taint,
+    )
+    from ksim_tpu.plugins.nodeunschedulable import UNSCHEDULABLE_TAINT
+
+    if not node_unschedulable(info["node"]):
+        return []
+    if tolerations_tolerate_taint(pod_tolerations(pod), UNSCHEDULABLE_TAINT):
+        return []
+    return ["node(s) were unschedulable"]
+
+
+# -- NodeResourcesFit -------------------------------------------------------
+
+
+def fit_filter(pod: JSON, info: NodeInfo) -> list[str]:
+    """Upstream fit.go fitsRequest: returns insufficient-resource reasons
+    (empty == fits)."""
+    reasons: list[str] = []
+    if info["pod_count"] + 1 > info["allowed_pods"]:
+        reasons.append("Too many pods")
+    req = pod_requests(pod)
+    # Early exit iff base requests are zero AND no scalar-resource key is
+    # present — a zero-valued extended-resource key still populates
+    # ScalarResources upstream and defeats the early return.
+    if all(req.get(r, 0) == 0 for r in BASE_RESOURCES) and not any(
+        k not in BASE_RESOURCES for k in req
+    ):
+        return reasons
+    alloc = info["allocatable"]
+    used = info["requested"]
+    for r in BASE_RESOURCES:
+        if req.get(r, 0) > alloc.get(r, 0) - used.get(r, 0):
+            reasons.append(f"Insufficient {r}")
+    # Extended resources in sorted order — upstream iterates a Go map
+    # (random order); we canonicalize to the featurizer's sorted resource
+    # axis so kernel and oracle agree on reason ordering.
+    for r in sorted(req):
+        v = req[r]
+        if r in BASE_RESOURCES or v == 0:
+            continue
+        if v > alloc.get(r, 0) - used.get(r, 0):
+            reasons.append(f"Insufficient {r}")
+    return reasons
+
+
+def least_allocated_score(
+    pod: JSON,
+    info: NodeInfo,
+    resources: tuple[tuple[str, int], ...] = ((CPU, 1), (MEMORY, 1)),
+) -> int:
+    """Upstream least_allocated.go leastResourceScorer."""
+    pod_nz = pod_requests(pod, non_zero=True)
+    node_score = 0
+    weight_sum = 0
+    for r, weight in resources:
+        allocatable = info["allocatable"].get(r, 0)
+        if allocatable == 0:
+            continue
+        requested = info["nonzero_requested"].get(r, 0) + pod_nz.get(r, 0)
+        if requested > allocatable:
+            s = 0
+        else:
+            s = ((allocatable - requested) * MAX_NODE_SCORE) // allocatable
+        node_score += s * weight
+        weight_sum += weight
+    if weight_sum == 0:
+        return 0
+    return node_score // weight_sum
+
+
+def balanced_allocation_score(
+    pod: JSON,
+    info: NodeInfo,
+    resources: tuple[str, ...] = (CPU, MEMORY),
+) -> int:
+    """Upstream balanced_allocation.go balancedResourceScorer (float64)."""
+    pod_nz = pod_requests(pod, non_zero=True)
+    fractions: list[float] = []
+    total = 0.0
+    for r in resources:
+        allocatable = info["allocatable"].get(r, 0)
+        if allocatable == 0:
+            continue
+        requested = info["nonzero_requested"].get(r, 0) + pod_nz.get(r, 0)
+        fraction = float(requested) / float(allocatable)
+        if fraction > 1:
+            fraction = 1.0
+        total += fraction
+        fractions.append(fraction)
+    std = 0.0
+    if len(fractions) == 2:
+        std = abs((fractions[0] - fractions[1]) / 2)
+    elif len(fractions) > 2:
+        mean = total / len(fractions)
+        std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
+    return int((1 - std) * float(MAX_NODE_SCORE))

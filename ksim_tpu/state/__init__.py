@@ -1,0 +1,6 @@
+"""Cluster state: typed resources, quantities, snapshot JSON, featurization."""
+
+from ksim_tpu.state.quantity import Quantity, parse_quantity
+from ksim_tpu.state.cluster import ClusterStore, WatchEvent
+
+__all__ = ["Quantity", "parse_quantity", "ClusterStore", "WatchEvent"]

@@ -1,0 +1,246 @@
+"""In-memory watchable cluster store.
+
+Replaces the reference's KWOK kube-apiserver + etcd pair (reference
+compose.yml `simulator-cluster`, kwok.yaml) for library and server use: a
+versioned object store for the 7 simulated resource kinds with
+list/watch semantics (the reference's client-go RetryWatcher + SSE pipeline,
+simulator/resourcewatcher/resourcewatcher.go:61-120, consumes exactly this
+event shape), optimistic-concurrency updates (resourceVersion), and
+snapshot/restore used by the reset service (reference
+simulator/reset/reset.go:33-85 snapshots the etcd prefix the same way).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator
+
+from ksim_tpu.errors import ConflictError, NotFoundError
+from ksim_tpu.state.resources import JSON, name_of, namespace_of
+
+# Kind names follow the reference's watcher kinds
+# (simulator/resourcewatcher/resourcewatcher.go:63-71).
+KINDS = (
+    "pods",
+    "nodes",
+    "persistentvolumes",
+    "persistentvolumeclaims",
+    "storageclasses",
+    "priorityclasses",
+    "namespaces",
+)
+NAMESPACED_KINDS = frozenset({"pods", "persistentvolumeclaims"})
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass(frozen=True, slots=True)
+class WatchEvent:
+    """Mirrors the reference's streamwriter.WatchEvent
+    (simulator/resourcewatcher/streamwriter/streamwriter.go:18-23)."""
+
+    kind: str
+    event_type: str
+    obj: JSON
+
+    def to_json(self) -> JSON:
+        return {"Kind": self.kind, "EventType": self.event_type, "Obj": self.obj}
+
+
+def _key(kind: str, obj_or_name: JSON | str, namespace: str = "") -> str:
+    if isinstance(obj_or_name, str):
+        name = obj_or_name
+        ns = namespace
+    else:
+        name = name_of(obj_or_name)
+        ns = namespace_of(obj_or_name)
+    if kind in NAMESPACED_KINDS:
+        return f"{ns or 'default'}/{name}"
+    return name
+
+
+class ClusterStore:
+    """Thread-safe versioned store of cluster objects with watch streams."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._rv = itertools.count(1)
+        self._objects: dict[str, dict[str, JSON]] = {k: {} for k in KINDS}
+        self._watchers: list[tuple[queue.SimpleQueue, frozenset[str]]] = []
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, kind: str, obj: JSON) -> JSON:
+        self._check_kind(kind)
+        obj = copy.deepcopy(obj)
+        with self._lock:
+            key = _key(kind, obj)
+            if key in self._objects[kind]:
+                raise ConflictError(f"{kind} {key!r} already exists")
+            md = obj.setdefault("metadata", {})
+            if kind in NAMESPACED_KINDS:
+                md.setdefault("namespace", "default")
+            md["resourceVersion"] = str(next(self._rv))
+            md.setdefault("uid", f"uid-{kind}-{md['resourceVersion']}")
+            self._objects[kind][key] = obj
+            self._notify(WatchEvent(kind, ADDED, copy.deepcopy(obj)))
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "") -> JSON:
+        self._check_kind(kind)
+        with self._lock:
+            key = _key(kind, name, namespace)
+            try:
+                return copy.deepcopy(self._objects[kind][key])
+            except KeyError:
+                raise NotFoundError(f"{kind} {key!r} not found") from None
+
+    def list(self, kind: str, namespace: str = "") -> list[JSON]:
+        self._check_kind(kind)
+        with self._lock:
+            objs = self._objects[kind].values()
+            if namespace and kind in NAMESPACED_KINDS:
+                objs = [o for o in objs if namespace_of(o) == namespace]
+            return copy.deepcopy(sorted(objs, key=name_of))
+
+    def update(self, kind: str, obj: JSON, *, expect_rv: str | None = None) -> JSON:
+        """Replace an object; raises ConflictError if expect_rv is stale."""
+        self._check_kind(kind)
+        obj = copy.deepcopy(obj)
+        with self._lock:
+            key = _key(kind, obj)
+            current = self._objects[kind].get(key)
+            if current is None:
+                raise NotFoundError(f"{kind} {key!r} not found")
+            if expect_rv is not None and current["metadata"]["resourceVersion"] != expect_rv:
+                raise ConflictError(
+                    f"{kind} {key!r}: resourceVersion {expect_rv} is stale"
+                )
+            md = obj.setdefault("metadata", {})
+            if kind in NAMESPACED_KINDS:
+                md.setdefault("namespace", "default")
+            md["uid"] = current["metadata"].get("uid")
+            md["resourceVersion"] = str(next(self._rv))
+            self._objects[kind][key] = obj
+            self._notify(WatchEvent(kind, MODIFIED, copy.deepcopy(obj)))
+            return copy.deepcopy(obj)
+
+    def patch(
+        self, kind: str, name: str, namespace: str, mutate: Callable[[JSON], None]
+    ) -> JSON:
+        """Atomic read-modify-write under the store lock."""
+        self._check_kind(kind)
+        with self._lock:
+            key = _key(kind, name, namespace)
+            current = self._objects[kind].get(key)
+            if current is None:
+                raise NotFoundError(f"{kind} {key!r} not found")
+            obj = copy.deepcopy(current)
+            mutate(obj)
+            obj["metadata"]["resourceVersion"] = str(next(self._rv))
+            self._objects[kind][key] = obj
+            self._notify(WatchEvent(kind, MODIFIED, copy.deepcopy(obj)))
+            return copy.deepcopy(obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        self._check_kind(kind)
+        with self._lock:
+            key = _key(kind, name, namespace)
+            obj = self._objects[kind].pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{kind} {key!r} not found")
+            self._notify(WatchEvent(kind, DELETED, obj))
+
+    def apply(self, kind: str, obj: JSON) -> JSON:
+        """Create-or-update (the reference Load path uses server-side apply,
+        simulator/snapshot/snapshot.go:158-196)."""
+        self._check_kind(kind)
+        with self._lock:
+            key = _key(kind, obj)
+            if key in self._objects[kind]:
+                return self.update(kind, obj)
+            return self.create(kind, obj)
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kinds: tuple[str, ...] = KINDS) -> "WatchStream":
+        for k in kinds:
+            self._check_kind(k)
+        q: queue.SimpleQueue = queue.SimpleQueue()
+        with self._lock:
+            self._watchers.append((q, frozenset(kinds)))
+        return WatchStream(self, q)
+
+    def _unwatch(self, q: queue.SimpleQueue) -> None:
+        with self._lock:
+            self._watchers = [(w, ks) for (w, ks) in self._watchers if w is not q]
+
+    def _notify(self, event: WatchEvent) -> None:
+        for q, kinds in self._watchers:
+            if event.kind in kinds:
+                q.put(event)
+
+    # -- snapshot/restore (reset service substrate) -------------------------
+
+    def dump(self) -> dict[str, dict[str, JSON]]:
+        with self._lock:
+            return copy.deepcopy(self._objects)
+
+    def restore(self, dump: dict[str, dict[str, JSON]]) -> None:
+        """Wipe and restore; emits DELETED then ADDED events
+        (reference reset deletes the etcd prefix then re-puts initial KVs,
+        simulator/reset/reset.go:58-85)."""
+        with self._lock:
+            for kind in KINDS:
+                for obj in list(self._objects[kind].values()):
+                    self._notify(WatchEvent(kind, DELETED, obj))
+                self._objects[kind].clear()
+            max_rv = 0
+            for kind, objs in dump.items():
+                self._check_kind(kind)
+                for key, obj in objs.items():
+                    restored = copy.deepcopy(obj)
+                    self._objects[kind][key] = restored
+                    try:
+                        max_rv = max(max_rv, int(restored["metadata"]["resourceVersion"]))
+                    except (KeyError, ValueError, TypeError):
+                        pass
+                    self._notify(WatchEvent(kind, ADDED, copy.deepcopy(restored)))
+            # Fast-forward the RV counter past every restored version so the
+            # store-wide monotonicity of resourceVersion survives restore.
+            self._rv = itertools.count(max(next(self._rv), max_rv + 1))
+
+    def _check_kind(self, kind: str) -> None:
+        if kind not in self._objects:
+            raise NotFoundError(f"unknown kind {kind!r}")
+
+
+class WatchStream:
+    """Iterator over watch events; close() detaches from the store."""
+
+    def __init__(self, store: ClusterStore, q: queue.SimpleQueue) -> None:
+        self._store = store
+        self._q = q
+        self._closed = False
+
+    def next(self, timeout: float | None = None) -> WatchEvent | None:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while not self._closed:
+            ev = self.next(timeout=0.1)
+            if ev is not None:
+                yield ev
+
+    def close(self) -> None:
+        self._closed = True
+        self._store._unwatch(self._q)

@@ -1,0 +1,275 @@
+"""Snapshot -> fixed-shape device tensors.
+
+This is the host->TPU boundary of the framework: the analogue of the
+reference's NodeInfo/PodInfo construction in the upstream scheduler cache
+(which the wrapped plugins consume per-(pod,node) call,
+reference simulator/scheduler/plugin/wrappedplugin.go:420-548).  Everything
+the batched Filter/Score kernels need is lowered here once per snapshot:
+
+- **Resource axis.** The tracked resource set is cpu, memory,
+  ephemeral-storage plus any extended resources present in the snapshot.
+  ``pods`` capacity is a separate scalar ("Too many pods" check).
+- **Exact unit scaling.** Kube-scheduler does int64 math; TPU integer math
+  is int32.  Each resource r gets a unit u_r = gcd of every observed value
+  of r, and all values are stored as value/u_r.  Integer-division score
+  formulas like ``(c-r)*100//c`` are ratios of the raw values, so dividing
+  numerator and denominator by the same u_r leaves every result bit-exact.
+  If the scaled values could still overflow ``int32`` through the ``*100``
+  in the score formula the featurizer falls back to lossy scaling and
+  records ``exact=False`` (callers can then route parity-critical runs to
+  the int64 path / host oracle).
+- **Padding + bucketing.**  Pod and node counts are padded up to
+  power-of-two buckets so recompiles are bounded (SURVEY.md section 7 hard
+  part 4); ``valid`` masks carry the true extents.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from ksim_tpu.state.resources import (
+    BASE_RESOURCES,
+    UNSCHEDULABLE_TAINT,
+    CPU,
+    JSON,
+    MEMORY,
+    EPHEMERAL_STORAGE,
+    PODS,
+    labels_of,
+    name_of,
+    namespaced_key,
+    node_allocatable,
+    node_unschedulable,
+    pod_is_scheduled,
+    pod_node_name,
+    pod_requests,
+    pod_tolerations,
+    tolerations_tolerate_taint,
+)
+
+# Largest per-resource scaled value that keeps v*100 (MaxNodeScore) in int32.
+MAX_EXACT_SCALED = (2**31 - 1) // 128
+
+# The tracked-resource prefix is BASE_RESOURCES (state/resources.py);
+# extended resources are appended in sorted order.
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two (>= minimum)."""
+    if n <= minimum:
+        return minimum
+    return 1 << (n - 1).bit_length()
+
+
+@dataclass
+class NodeTensors:
+    """Per-node device-ready arrays, shape [N] or [N, R]."""
+
+    names: list[str]
+    allocatable: np.ndarray  # int32 [N, R] scaled
+    allowed_pods: np.ndarray  # int32 [N]
+    requested: np.ndarray  # int32 [N, R] from already-bound pods
+    nonzero_requested: np.ndarray  # int32 [N, R] scoring-path accumulation
+    pod_count: np.ndarray  # int32 [N]
+    unschedulable: np.ndarray  # bool [N]
+    valid: np.ndarray  # bool [N]
+
+    @property
+    def count(self) -> int:
+        return len(self.names)
+
+    @property
+    def padded(self) -> int:
+        return self.valid.shape[0]
+
+
+@dataclass
+class PodTensors:
+    """Per-pod device-ready arrays, shape [P] or [P, R]."""
+
+    keys: list[str]  # namespace/name
+    requests: np.ndarray  # int32 [P, R] scaled (Fit filter path)
+    nonzero_requests: np.ndarray  # int32 [P, R] scaled (scoring path)
+    valid: np.ndarray  # bool [P]
+    tolerates_unschedulable: np.ndarray  # bool [P]
+    has_requests: np.ndarray  # bool [P] (fitsRequest early-exit predicate)
+
+    @property
+    def count(self) -> int:
+        return len(self.keys)
+
+
+@dataclass
+class FeaturizedSnapshot:
+    """Everything the batched kernels need, plus host-side decode tables."""
+
+    resources: tuple[str, ...]  # the R axis
+    units: dict[str, int]  # resource -> divisor used in scaling
+    exact: bool  # int32 math is bit-exact vs int64
+    nodes: NodeTensors
+    pods: PodTensors
+    aux: dict[str, Any] = field(default_factory=dict)  # plugin extras
+
+    def resource_index(self, r: str) -> int:
+        return self.resources.index(r)
+
+
+def _gcd_unit(values: Sequence[int]) -> int:
+    g = 0
+    for v in values:
+        g = math.gcd(g, v)
+    return g or 1
+
+
+class Featurizer:
+    """Lower a snapshot (lists of pod/node JSON objects) to tensors."""
+
+    def __init__(self, *, node_bucket_min: int = 8, pod_bucket_min: int = 8) -> None:
+        self._node_bucket_min = node_bucket_min
+        self._pod_bucket_min = pod_bucket_min
+
+    def featurize(
+        self,
+        nodes: Sequence[JSON],
+        pods: Sequence[JSON],
+        *,
+        queue_pods: Sequence[JSON] = (),
+    ) -> FeaturizedSnapshot:
+        """``pods`` are existing cluster pods (bound ones charge their node);
+        ``queue_pods`` are the pods to schedule (the pod axis P)."""
+        sched_pods = list(queue_pods) if queue_pods else [
+            p for p in pods if not pod_is_scheduled(p)
+        ]
+        bound_pods = [
+            p
+            for p in pods
+            if pod_is_scheduled(p)
+            and (p.get("status", {}).get("phase") not in ("Succeeded", "Failed"))
+        ]
+
+        node_alloc = [node_allocatable(n) for n in nodes]
+        pod_reqs = [pod_requests(p) for p in sched_pods]
+        pod_nz_reqs = [pod_requests(p, non_zero=True) for p in sched_pods]
+        bound_reqs = [pod_requests(p) for p in bound_pods]
+        bound_nz_reqs = [pod_requests(p, non_zero=True) for p in bound_pods]
+
+        # Resource axis: base prefix + extended resources seen anywhere.
+        seen: set[str] = set()
+        for d in (*node_alloc, *pod_reqs, *bound_reqs):
+            seen.update(d.keys())
+        seen.discard(PODS)
+        extended = sorted(seen - set(BASE_RESOURCES))
+        resources = BASE_RESOURCES + tuple(extended)
+        ridx = {r: i for i, r in enumerate(resources)}
+        R = len(resources)
+        exact = True
+        if R > 29:
+            # Reason bits past bit 30 saturate into a shared bit (see
+            # plugins/noderesources.py); decoded reasons are then ambiguous.
+            exact = False
+
+        # Exact gcd units per resource across every value that enters math.
+        units: dict[str, int] = {}
+        for r in resources:
+            vals = [d.get(r, 0) for d in (*node_alloc, *pod_reqs, *pod_nz_reqs, *bound_reqs, *bound_nz_reqs)]
+            vals = [v for v in vals if v]
+            unit = _gcd_unit(vals)
+            max_scaled = max((v // unit for v in vals), default=0)
+            if max_scaled > MAX_EXACT_SCALED:
+                # Lossy fallback: keep magnitudes bounded, mark inexact.
+                unit = unit * -(-max_scaled // MAX_EXACT_SCALED)
+                exact = False
+            units[r] = unit
+
+        def lower(d: dict[str, int]) -> np.ndarray:
+            row = np.zeros(R, dtype=np.int64)
+            for r, v in d.items():
+                i = ridx.get(r)
+                if i is not None:
+                    u = units[r]
+                    row[i] = v // u if v % u == 0 else -(-v // u)
+            return row
+
+        N, P = len(nodes), len(sched_pods)
+        NP, PP = bucket_size(N, self._node_bucket_min), bucket_size(P, self._pod_bucket_min)
+
+        alloc = np.zeros((NP, R), dtype=np.int32)
+        allowed_pods = np.zeros(NP, dtype=np.int32)
+        # Accumulate in int64: per-value bounds don't bound the SUM over
+        # bound pods; clamp (and drop exactness) only if the sum overflows.
+        requested = np.zeros((NP, R), dtype=np.int64)
+        nz_requested = np.zeros((NP, R), dtype=np.int64)
+        pod_count = np.zeros(NP, dtype=np.int32)
+        unsched = np.zeros(NP, dtype=bool)
+        nvalid = np.zeros(NP, dtype=bool)
+        node_names = [name_of(n) for n in nodes]
+        node_index = {nm: i for i, nm in enumerate(node_names)}
+
+        for i, n in enumerate(nodes):
+            alloc[i] = lower(node_alloc[i])
+            allowed_pods[i] = node_alloc[i].get(PODS, 0)
+            unsched[i] = node_unschedulable(n)
+            nvalid[i] = True
+
+        for p, req, nz in zip(bound_pods, bound_reqs, bound_nz_reqs):
+            i = node_index.get(pod_node_name(p))
+            if i is None:
+                continue
+            requested[i] += lower(req)
+            nz_requested[i] += lower(nz)
+            pod_count[i] += 1
+
+        if requested.max(initial=0) > MAX_EXACT_SCALED or nz_requested.max(initial=0) > MAX_EXACT_SCALED:
+            exact = False
+            requested = np.minimum(requested, MAX_EXACT_SCALED)
+            nz_requested = np.minimum(nz_requested, MAX_EXACT_SCALED)
+        requested = requested.astype(np.int32)
+        nz_requested = nz_requested.astype(np.int32)
+
+        preq = np.zeros((PP, R), dtype=np.int32)
+        pnz = np.zeros((PP, R), dtype=np.int32)
+        pvalid = np.zeros(PP, dtype=bool)
+        ptol = np.zeros(PP, dtype=bool)
+        phas = np.zeros(PP, dtype=bool)
+        base_set = set(BASE_RESOURCES)
+        for j, p in enumerate(sched_pods):
+            preq[j] = lower(pod_reqs[j])
+            pnz[j] = lower(pod_nz_reqs[j])
+            pvalid[j] = True
+            ptol[j] = tolerations_tolerate_taint(
+                pod_tolerations(p), UNSCHEDULABLE_TAINT
+            )
+            # Upstream fitsRequest early-exit predicate: base requests all
+            # zero AND no scalar-resource key present (a zero-valued
+            # extended-resource key still defeats the early return).
+            phas[j] = any(pod_reqs[j].get(r, 0) for r in BASE_RESOURCES) or any(
+                k not in base_set and k != PODS for k in pod_reqs[j]
+            )
+
+        return FeaturizedSnapshot(
+            resources=resources,
+            units=units,
+            exact=exact,
+            nodes=NodeTensors(
+                names=node_names,
+                allocatable=alloc,
+                allowed_pods=allowed_pods,
+                requested=requested,
+                nonzero_requested=nz_requested,
+                pod_count=pod_count,
+                unschedulable=unsched,
+                valid=nvalid,
+            ),
+            pods=PodTensors(
+                keys=[namespaced_key(p) for p in sched_pods],
+                requests=preq,
+                nonzero_requests=pnz,
+                valid=pvalid,
+                tolerates_unschedulable=ptol,
+                has_requests=phas,
+            ),
+        )

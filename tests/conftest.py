@@ -1,0 +1,22 @@
+"""Test env: CPU backend with 8 virtual devices (multi-chip sharding tests
+run on a virtual mesh — real multi-chip hardware is validated separately by
+the driver via __graft_entry__.dryrun_multichip), x64 enabled so the exact
+int64 parity paths are active.
+
+Note: this image's sitecustomize imports jax at interpreter start (axon TPU
+plugin), so env vars are already baked into jax.config defaults — override
+through jax.config.update, not os.environ.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+assert jax.device_count() >= 8, "virtual device mesh not active"

@@ -1,0 +1,125 @@
+"""Shared fixture builders: k8s-shaped JSON objects."""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+JSON = dict[str, Any]
+
+
+def make_node(
+    name: str,
+    cpu: str = "4",
+    memory: str = "16Gi",
+    pods: int = 110,
+    *,
+    labels: dict[str, str] | None = None,
+    taints: list[JSON] | None = None,
+    unschedulable: bool = False,
+    extra_alloc: dict[str, str] | None = None,
+) -> JSON:
+    alloc = {"cpu": cpu, "memory": memory, "pods": str(pods), "ephemeral-storage": "100Gi"}
+    alloc.update(extra_alloc or {})
+    spec: JSON = {}
+    if taints:
+        spec["taints"] = taints
+    if unschedulable:
+        spec["unschedulable"] = True
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": spec,
+        "status": {"allocatable": dict(alloc), "capacity": dict(alloc)},
+    }
+
+
+def make_pod(
+    name: str,
+    cpu: str | None = "100m",
+    memory: str | None = "128Mi",
+    *,
+    namespace: str = "default",
+    node_name: str = "",
+    labels: dict[str, str] | None = None,
+    phase: str = "",
+    tolerations: list[JSON] | None = None,
+    affinity: JSON | None = None,
+    node_selector: dict[str, str] | None = None,
+    topology_spread_constraints: list[JSON] | None = None,
+    priority: int | None = None,
+    extra_requests: dict[str, str] | None = None,
+) -> JSON:
+    requests: JSON = {}
+    if cpu is not None:
+        requests["cpu"] = cpu
+    if memory is not None:
+        requests["memory"] = memory
+    requests.update(extra_requests or {})
+    spec: JSON = {
+        "containers": [
+            {"name": "c", "image": "img", "resources": {"requests": requests} if requests else {}}
+        ]
+    }
+    if node_name:
+        spec["nodeName"] = node_name
+    if tolerations:
+        spec["tolerations"] = tolerations
+    if affinity:
+        spec["affinity"] = affinity
+    if node_selector:
+        spec["nodeSelector"] = node_selector
+    if topology_spread_constraints:
+        spec["topologySpreadConstraints"] = topology_spread_constraints
+    if priority is not None:
+        spec["priority"] = priority
+    status: JSON = {"phase": phase} if phase else {}
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels or {}},
+        "spec": spec,
+        "status": status,
+    }
+
+
+def random_cluster(
+    seed: int,
+    n_nodes: int,
+    n_pods: int,
+    *,
+    bound_fraction: float = 0.3,
+    unschedulable_fraction: float = 0.1,
+) -> tuple[list[JSON], list[JSON]]:
+    """Reproducible random cluster; quantities are Mi/milli multiples."""
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n_nodes):
+        nodes.append(
+            make_node(
+                f"node-{i}",
+                cpu=f"{rng.choice([2, 4, 8, 16, 32])}",
+                memory=f"{rng.choice([4, 8, 16, 32, 64])}Gi",
+                pods=rng.choice([8, 16, 32, 110]),
+                unschedulable=rng.random() < unschedulable_fraction,
+            )
+        )
+    pods = []
+    for i in range(n_pods):
+        bound = rng.random() < bound_fraction
+        tolerates = rng.random() < 0.15
+        pods.append(
+            make_pod(
+                f"pod-{i}",
+                cpu=rng.choice([None, "50m", "100m", "250m", "500m", "1", "2"]),
+                memory=rng.choice([None, "64Mi", "128Mi", "512Mi", "1Gi", "4Gi"]),
+                node_name=f"node-{rng.randrange(n_nodes)}" if bound else "",
+                tolerations=[
+                    {"key": "node.kubernetes.io/unschedulable", "operator": "Exists", "effect": "NoSchedule"}
+                ]
+                if tolerates
+                else None,
+            )
+        )
+    return nodes, pods

@@ -1,0 +1,100 @@
+"""ClusterStore CRUD / watch / restore semantics."""
+
+import pytest
+
+from ksim_tpu.errors import ConflictError, NotFoundError
+from ksim_tpu.state.cluster import ADDED, DELETED, MODIFIED, ClusterStore
+from tests.helpers import make_node, make_pod
+
+
+def test_crud_roundtrip():
+    s = ClusterStore()
+    s.create("nodes", make_node("n1"))
+    got = s.get("nodes", "n1")
+    assert got["metadata"]["name"] == "n1"
+    assert got["metadata"]["resourceVersion"]
+    with pytest.raises(ConflictError):
+        s.create("nodes", make_node("n1"))
+    s.delete("nodes", "n1")
+    with pytest.raises(NotFoundError):
+        s.get("nodes", "n1")
+
+
+def test_namespaced_listing():
+    s = ClusterStore()
+    s.create("pods", make_pod("p1", namespace="a"))
+    s.create("pods", make_pod("p1", namespace="b"))
+    assert len(s.list("pods")) == 2
+    assert len(s.list("pods", namespace="a")) == 1
+
+
+def test_update_conflict_detection():
+    s = ClusterStore()
+    created = s.create("nodes", make_node("n1"))
+    rv = created["metadata"]["resourceVersion"]
+    s.update("nodes", created, expect_rv=rv)
+    with pytest.raises(ConflictError):
+        s.update("nodes", created, expect_rv=rv)  # stale now
+
+
+def test_patch_is_atomic_and_bumps_rv():
+    s = ClusterStore()
+    created = s.create("pods", make_pod("p1"))
+    updated = s.patch(
+        "pods", "p1", "default",
+        lambda o: o["metadata"].setdefault("annotations", {}).update(x="y"),
+    )
+    assert updated["metadata"]["annotations"]["x"] == "y"
+    assert updated["metadata"]["resourceVersion"] != created["metadata"]["resourceVersion"]
+
+
+def test_watch_events():
+    s = ClusterStore()
+    w = s.watch(("pods",))
+    s.create("pods", make_pod("p1"))
+    s.create("nodes", make_node("n1"))  # not subscribed
+    s.patch("pods", "p1", "default", lambda o: None)
+    s.delete("pods", "p1", "default")
+    events = [w.next(timeout=1) for _ in range(3)]
+    assert [e.event_type for e in events] == [ADDED, MODIFIED, DELETED]
+    assert all(e.kind == "pods" for e in events)
+    assert w.next(timeout=0.05) is None
+    w.close()
+
+
+def test_update_defaults_namespace():
+    s = ClusterStore()
+    s.create("pods", make_pod("p1"))
+    pod = {"metadata": {"name": "p1"}, "spec": {}}  # no namespace field
+    s.update("pods", pod)
+    listed = s.list("pods", namespace="default")
+    assert len(listed) == 1 and listed[0]["metadata"]["namespace"] == "default"
+
+
+def test_apply_unknown_kind_raises_not_found():
+    s = ClusterStore()
+    with pytest.raises(NotFoundError):
+        s.apply("widgets", {"metadata": {"name": "w"}})
+
+
+def test_dump_restore_reset_semantics():
+    s = ClusterStore()
+    s.create("nodes", make_node("n1"))
+    initial = s.dump()
+    s.create("nodes", make_node("n2"))
+    s.delete("nodes", "n1")
+    s.restore(initial)
+    names = [n["metadata"]["name"] for n in s.list("nodes")]
+    assert names == ["n1"]
+
+
+def test_restore_keeps_resource_version_monotonic():
+    s = ClusterStore()
+    for i in range(5):
+        s.create("nodes", make_node(f"n{i}"))
+    dump = s.dump()
+    fresh = ClusterStore()
+    fresh.restore(dump)
+    created = fresh.create("nodes", make_node("new"))
+    restored_rvs = [int(n["metadata"]["resourceVersion"]) for n in fresh.list("nodes") if n["metadata"]["name"] != "new"]
+    assert int(created["metadata"]["resourceVersion"]) > max(restored_rvs)

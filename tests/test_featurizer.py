@@ -1,0 +1,69 @@
+"""Featurizer: unit scaling exactness, accumulation, bucketing."""
+
+import numpy as np
+
+from ksim_tpu.state.featurizer import Featurizer, bucket_size
+from tests.helpers import make_node, make_pod
+
+
+def test_bucket_size():
+    assert bucket_size(1) == 8
+    assert bucket_size(8) == 8
+    assert bucket_size(9) == 16
+    assert bucket_size(1000) == 1024
+
+
+def test_resource_axis_and_units():
+    nodes = [make_node("n1", cpu="4", memory="16Gi")]
+    pods = [make_pod("p1", cpu="100m", memory="128Mi")]
+    f = Featurizer().featurize(nodes, pods)
+    assert f.resources[:3] == ("cpu", "memory", "ephemeral-storage")
+    assert f.exact
+    ci, mi = f.resource_index("cpu"), f.resource_index("memory")
+    # Ratios are preserved exactly: alloc/request == raw ratio.
+    assert f.nodes.allocatable[0, ci] / f.pods.requests[0, ci] == 4000 / 100
+    assert f.nodes.allocatable[0, mi] / f.pods.requests[0, mi] == (16 * 1024) / 128
+
+
+def test_bound_pods_accumulate():
+    nodes = [make_node("n1", cpu="4", memory="16Gi")]
+    pods = [
+        make_pod("p1", cpu="500m", memory="1Gi", node_name="n1"),
+        make_pod("p2", cpu="250m", memory="1Gi", node_name="n1"),
+        make_pod("p3", cpu="250m", memory="1Gi", node_name="n1", phase="Succeeded"),
+        make_pod("q1", cpu="100m", memory="128Mi"),
+    ]
+    f = Featurizer().featurize(nodes, pods)
+    ci = f.resource_index("cpu")
+    unit = f.units["cpu"]
+    assert f.nodes.requested[0, ci] * unit == 750  # terminal pod excluded
+    assert f.nodes.pod_count[0] == 2
+    assert f.pods.count == 1  # only the unbound pod is in the queue
+
+
+def test_nonzero_requests_default():
+    nodes = [make_node("n1")]
+    pods = [make_pod("p1", cpu=None, memory=None)]
+    f = Featurizer().featurize(nodes, pods)
+    ci, mi = f.resource_index("cpu"), f.resource_index("memory")
+    assert f.pods.requests[0, ci] == 0
+    assert f.pods.nonzero_requests[0, ci] * f.units["cpu"] == 100  # 100m default
+    assert f.pods.nonzero_requests[0, mi] * f.units["memory"] == 200 * 1024 * 1024
+
+
+def test_extended_resources():
+    nodes = [make_node("n1", extra_alloc={"example.com/gpu": "4"})]
+    pods = [make_pod("p1", extra_requests={"example.com/gpu": "2"})]
+    f = Featurizer().featurize(nodes, pods)
+    gi = f.resource_index("example.com/gpu")
+    assert f.nodes.allocatable[0, gi] * f.units["example.com/gpu"] == 4
+    assert f.pods.requests[0, gi] * f.units["example.com/gpu"] == 2
+
+
+def test_padding_masks():
+    nodes = [make_node(f"n{i}") for i in range(3)]
+    pods = [make_pod(f"p{i}") for i in range(5)]
+    f = Featurizer().featurize(nodes, pods)
+    assert f.nodes.padded == 8 and f.nodes.count == 3
+    assert np.sum(f.nodes.valid) == 3
+    assert np.sum(f.pods.valid) == 5

@@ -1,0 +1,62 @@
+"""pod_requests: upstream resourcehelper.PodRequests semantics incl. sidecars."""
+
+from ksim_tpu.state.resources import pod_requests
+
+
+def _pod(containers=(), init_containers=(), overhead=None):
+    spec = {"containers": list(containers)}
+    if init_containers:
+        spec["initContainers"] = list(init_containers)
+    if overhead:
+        spec["overhead"] = overhead
+    return {"metadata": {"name": "p"}, "spec": spec}
+
+
+def _c(cpu=None, memory=None, restart=None):
+    c = {"name": "c", "resources": {"requests": {}}}
+    if cpu:
+        c["resources"]["requests"]["cpu"] = cpu
+    if memory:
+        c["resources"]["requests"]["memory"] = memory
+    if restart:
+        c["restartPolicy"] = restart
+    return c
+
+
+def test_sum_of_app_containers():
+    p = _pod([_c(cpu="100m"), _c(cpu="200m", memory="1Gi")])
+    assert pod_requests(p) == {"cpu": 300, "memory": 1024**3}
+
+
+def test_init_container_max():
+    p = _pod([_c(cpu="100m")], [_c(cpu="1"), _c(cpu="500m")])
+    assert pod_requests(p)["cpu"] == 1000  # max(100, 1000, 500)
+
+
+def test_sidecar_adds_to_total():
+    # Sidecar (restartPolicy: Always) joins the running sum: 1 + 1 = 2 CPU.
+    p = _pod([_c(cpu="1")], [_c(cpu="1", restart="Always")])
+    assert pod_requests(p)["cpu"] == 2000
+
+
+def test_non_restartable_init_includes_prior_sidecars():
+    # Init container runs while earlier sidecars are up: its requirement is
+    # own + sidecar sum; max'ed against app-sum + sidecars.
+    p = _pod(
+        [_c(cpu="500m")],
+        [_c(cpu="1", restart="Always"), _c(cpu="2")],
+    )
+    # total = max(500m + 1, 2 + 1) = 3
+    assert pod_requests(p)["cpu"] == 3000
+
+
+def test_overhead_added():
+    p = _pod([_c(cpu="100m")], overhead={"cpu": "50m"})
+    assert pod_requests(p)["cpu"] == 150
+
+
+def test_non_zero_defaults_apply_to_init_containers_too():
+    p = _pod([], [_c(memory="1Gi")])
+    nz = pod_requests(p, non_zero=True)
+    assert nz["cpu"] == 100  # defaulted
+    assert nz["memory"] == 1024**3

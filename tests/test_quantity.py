@@ -1,0 +1,48 @@
+"""Quantity parsing parity with k8s resource.Quantity semantics."""
+
+import pytest
+
+from ksim_tpu.state.quantity import parse_quantity
+
+
+@pytest.mark.parametrize(
+    "s,milli,value",
+    [
+        ("100m", 100, 1),  # Value() rounds up
+        ("1", 1000, 1),
+        ("1.5", 1500, 2),
+        ("2", 2000, 2),
+        ("0", 0, 0),
+        ("128Mi", 128 * 1024**2 * 1000, 128 * 1024**2),
+        ("1Gi", 1024**3 * 1000, 1024**3),
+        ("1.5Gi", 1536 * 1024**2 * 1000, 1536 * 1024**2),
+        ("2k", 2000_000, 2000),
+        ("1e3", 1_000_000, 1000),
+        ("2E2", 200_000, 200),
+        ("500u", 1, 1),  # micro rounds up at milli scale
+        ("110", 110_000, 110),
+    ],
+)
+def test_parse(s, milli, value):
+    q = parse_quantity(s)
+    assert q.milli_value == milli
+    assert q.value == value
+
+
+def test_negative_rounds_toward_larger_magnitude():
+    q = parse_quantity("-1.5")
+    assert q.value == -2  # away from zero, like Go
+
+
+def test_add():
+    assert (parse_quantity("100m") + parse_quantity("900m")).value == 1
+
+
+def test_invalid():
+    for bad in ["", "abc", "1.2.3", "12x", "Gi"]:
+        with pytest.raises(ValueError):
+            parse_quantity(bad)
+
+
+def test_int_passthrough():
+    assert parse_quantity(5).milli_value == 5000

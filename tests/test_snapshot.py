@@ -1,0 +1,111 @@
+"""Snapshot export/import: reference JSON-schema compatibility."""
+
+import json
+
+from ksim_tpu.state.cluster import ClusterStore
+from ksim_tpu.state.snapshot import SnapshotService
+from tests.helpers import make_node, make_pod
+
+
+def _store_with_content() -> ClusterStore:
+    s = ClusterStore()
+    s.create("nodes", make_node("n1"))
+    s.create("pods", make_pod("p1", labels={"app": "web"}))
+    s.create("pods", make_pod("p2", labels={"app": "db"}))
+    s.create("namespaces", {"metadata": {"name": "default"}})
+    s.create("namespaces", {"metadata": {"name": "kube-system"}})
+    s.create("priorityclasses", {"metadata": {"name": "high"}, "value": 100})
+    s.create(
+        "priorityclasses",
+        {"metadata": {"name": "system-cluster-critical"}, "value": 2000000000},
+    )
+    return s
+
+
+def test_snap_shape_matches_reference_schema():
+    svc = SnapshotService(_store_with_content())
+    snap = svc.snap()
+    # Exact key set of ResourcesForSnap (reference snapshot.go:33-42).
+    assert set(snap.keys()) == {
+        "pods", "nodes", "pvs", "pvcs", "storageClasses",
+        "priorityClasses", "schedulerConfig", "namespaces",
+    }
+    assert len(snap["pods"]) == 2
+    assert len(snap["nodes"]) == 1
+
+
+def test_snap_excludes_system_pcs_and_kube_namespaces():
+    snap = SnapshotService(_store_with_content()).snap()
+    assert [p["metadata"]["name"] for p in snap["priorityClasses"]] == ["high"]
+    assert [n["metadata"]["name"] for n in snap["namespaces"]] == ["default"]
+
+
+def test_snap_label_selector_filtering():
+    snap = SnapshotService(_store_with_content()).snap(
+        {"matchLabels": {"app": "web"}}
+    )
+    assert [p["metadata"]["name"] for p in snap["pods"]] == ["p1"]
+    assert snap["nodes"] == []  # nodes lack the label
+
+
+def test_load_round_trip():
+    exported = SnapshotService(_store_with_content()).export_json()
+    dst = ClusterStore()
+    SnapshotService(dst).import_json(exported)
+    assert [n["metadata"]["name"] for n in dst.list("nodes")] == ["n1"]
+    assert len(dst.list("pods")) == 2
+    # UIDs are re-assigned on load, not carried in.
+    src_uid = json.loads(exported)["pods"][0]["metadata"].get("uid")
+    dst_uid = dst.list("pods")[0]["metadata"]["uid"]
+    assert dst_uid and dst_uid != src_uid
+
+
+def test_load_fixes_pv_claim_ref_uid():
+    dst = ClusterStore()
+    SnapshotService(dst).load(
+        {
+            "pvcs": [{"metadata": {"name": "claim", "namespace": "apps", "uid": "old-pvc-uid"}}],
+            "pvs": [{
+                "metadata": {"name": "vol"},
+                "spec": {"claimRef": {"name": "claim", "namespace": "apps", "uid": "old-pvc-uid"}},
+                "status": {"phase": "Bound"},
+            }, {
+                "metadata": {"name": "vol-avail"},
+                "spec": {"claimRef": {"name": "claim", "namespace": "apps", "uid": "old-pvc-uid"}},
+                "status": {"phase": "Available"},
+            }, {
+                "metadata": {"name": "vol-orphan"},
+                "spec": {"claimRef": {"name": "gone", "namespace": "apps", "uid": "stale"}},
+                "status": {"phase": "Bound"},
+            }],
+        }
+    )
+    pvc = dst.get("persistentvolumeclaims", "claim", "apps")
+    pv = dst.get("persistentvolumes", "vol")
+    assert pvc["metadata"]["uid"] != "old-pvc-uid"  # re-assigned on load
+    assert pv["spec"]["claimRef"]["uid"] == pvc["metadata"]["uid"]
+    # Non-Bound PVs are untouched; missing PVC clears the stale UID.
+    assert dst.get("persistentvolumes", "vol-avail")["spec"]["claimRef"]["uid"] == "old-pvc-uid"
+    assert dst.get("persistentvolumes", "vol-orphan")["spec"]["claimRef"]["uid"] is None
+
+
+def test_load_skips_kube_namespaces():
+    dst = ClusterStore()
+    SnapshotService(dst).load(
+        {"namespaces": [
+            {"metadata": {"name": "kube-system"}},
+            {"metadata": {"name": "apps"}},
+        ]}
+    )
+    assert [n["metadata"]["name"] for n in dst.list("namespaces")] == ["apps"]
+
+
+def test_load_skips_system_priority_classes():
+    dst = ClusterStore()
+    SnapshotService(dst).load(
+        {"priorityClasses": [
+            {"metadata": {"name": "system-node-critical"}, "value": 1},
+            {"metadata": {"name": "normal"}, "value": 5},
+        ]}
+    )
+    assert [p["metadata"]["name"] for p in dst.list("priorityclasses")] == ["normal"]
