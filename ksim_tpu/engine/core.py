@@ -26,6 +26,7 @@ result-tensor memory for the 10k x 5k configs.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Sequence
@@ -69,6 +70,30 @@ class EngineResult:
     total: np.ndarray | None  # i32 [P, N] summed final scores
     feasible: np.ndarray  # bool [P]
     selected: np.ndarray  # i32 [P]
+
+
+def _device_aux(aux: dict) -> tuple[dict, dict]:
+    """FeaturizedSnapshot.aux -> (pytree of jnp arrays, leading-axis map).
+
+    Dataclasses become dicts of their ndarray fields; host-only fields
+    stay behind.  The axis map mirrors the array tree with "node"/"pod"/
+    None leading-axis kinds (from each dataclass's AXES classvar) for
+    sharding."""
+    out = {}
+    axes = {}
+    for k, v in (aux or {}).items():
+        if dataclasses.is_dataclass(v):
+            declared = getattr(v, "AXES", {})
+            out[k] = {
+                f.name: jnp.asarray(getattr(v, f.name))
+                for f in dataclasses.fields(v)
+                if isinstance(getattr(v, f.name), np.ndarray)
+            }
+            axes[k] = {name: declared.get(name) for name in out[k]}
+        else:
+            out[k] = jax.tree_util.tree_map(jnp.asarray, v)
+            axes[k] = jax.tree_util.tree_map(lambda _: None, v)
+    return out, axes
 
 
 def _final_from_raw(
@@ -120,7 +145,9 @@ class Engine:
             valid=jnp.asarray(p.valid),
             tolerates_unschedulable=jnp.asarray(p.tolerates_unschedulable),
             has_requests=jnp.asarray(p.has_requests),
+            index=jnp.asarray(p.index),
         )
+        self._aux, self._aux_axes = _device_aux(feats.aux)
 
     def shard(self, mesh) -> "Engine":
         """Lay the engine's arrays out over a device mesh: node axis over
@@ -135,18 +162,19 @@ class Engine:
 
         self._node_state = shlib.shard_node_state(self._node_state, mesh)
         self._pods = shlib.shard_pod_batch(self._pods, mesh)
+        self._aux = shlib.shard_aux(self._aux, self._aux_axes, mesh)
         return self
 
     # -- shared per-pod evaluation -----------------------------------------
 
-    def _eval_one(self, state: NodeStateView, pod: PodView):
+    def _eval_one(self, state: NodeStateView, pod: PodView, aux: dict):
         """One pod vs all nodes through every plugin."""
         reason_bits = []
         filter_ok = state.valid
         for sp in self._plugins:
             if not sp.filter_enabled:
                 continue
-            out: FilterOutput = sp.plugin.filter(state, pod)
+            out: FilterOutput = sp.plugin.filter(state, pod, aux)
             reason_bits.append(out.reason_bits)
             filter_ok = filter_ok & out.ok
         raw_scores = []
@@ -155,7 +183,7 @@ class Engine:
         for sp in self._plugins:
             if not sp.score_enabled:
                 continue
-            raw = sp.plugin.score(state, pod)
+            raw = sp.plugin.score(state, pod, aux)
             final = _final_from_raw(sp.plugin, raw, filter_ok, sp.weight)
             raw_scores.append(raw)
             final_scores.append(final)
@@ -181,24 +209,25 @@ class Engine:
             out["raw"] = jnp.stack(raw) if raw else jnp.zeros((0, n), jnp.int32)
         return out
 
-    def batch_step(self, state, pods: PodBatch):
+    def batch_step(self, state, pods: PodBatch, aux: dict):
         """Pure jittable batch-evaluation step (un-jitted public form)."""
-        return self._batch_fn.__wrapped__(self, state, pods)
+        return self._batch_fn.__wrapped__(self, state, pods, aux)
 
     @property
     def example_args(self):
-        return (self._node_state, self._pods)
+        return (self._node_state, self._pods, self._aux)
 
     @partial(jax.jit, static_argnums=0)
-    def _batch_fn(self, state, pods: PodBatch):
+    def _batch_fn(self, state, pods: PodBatch, aux: dict):
         def per_pod(pb: PodBatch):
             pod = PodView(
                 requests=pb.requests,
                 nonzero_requests=pb.nonzero_requests,
                 tolerates_unschedulable=pb.tolerates_unschedulable,
                 has_requests=pb.has_requests,
+                index=pb.index,
             )
-            ok, bits, raw, final, total = self._eval_one(state, pod)
+            ok, bits, raw, final, total = self._eval_one(state, pod, aux)
             feasible, best = self._select(ok, total)
             return self._pod_outputs(pb.valid, feasible, best, bits, raw, final, total)
 
@@ -206,20 +235,21 @@ class Engine:
 
     def evaluate_batch(self) -> EngineResult:
         """All pods x nodes against the fixed snapshot (no state commit)."""
-        return self._to_result(self._batch_fn(self._node_state, self._pods))
+        return self._to_result(self._batch_fn(self._node_state, self._pods, self._aux))
 
     # -- sequential scheduling (lax.scan with commit) ----------------------
 
     @partial(jax.jit, static_argnums=0)
-    def _schedule_fn(self, state, pods: PodBatch):
+    def _schedule_fn(self, state, pods: PodBatch, aux: dict):
         def body(carry: NodeStateView, pb: PodBatch):
             pod = PodView(
                 requests=pb.requests,
                 nonzero_requests=pb.nonzero_requests,
                 tolerates_unschedulable=pb.tolerates_unschedulable,
                 has_requests=pb.has_requests,
+                index=pb.index,
             )
-            ok, bits, raw, final, total = self._eval_one(carry, pod)
+            ok, bits, raw, final, total = self._eval_one(carry, pod, aux)
             feasible, best = self._select(ok, total)
             best = jnp.where(pb.valid, best, -1)
             carry = carry.commit(best, pb.requests, pb.nonzero_requests)
@@ -232,7 +262,7 @@ class Engine:
         """Greedy sequential scheduling of the pod queue with capacity
         commit; pod order is queue order (upstream pops by priority —
         callers sort the queue before featurizing)."""
-        state, out = self._schedule_fn(self._node_state, self._pods)
+        state, out = self._schedule_fn(self._node_state, self._pods, self._aux)
         return self._to_result(out), jax.tree_util.tree_map(np.asarray, state)
 
     # -- decode -------------------------------------------------------------

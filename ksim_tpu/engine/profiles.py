@@ -9,15 +9,19 @@ KubeSchedulerConfiguration-driven profile compiler lives in sched/config.
 from __future__ import annotations
 
 from ksim_tpu.engine.core import ScoredPlugin
+from ksim_tpu.plugins.nodeaffinity import NodeAffinity
 from ksim_tpu.plugins.nodeunschedulable import NodeUnschedulable
 from ksim_tpu.plugins.noderesources import (
     NodeResourcesBalancedAllocation,
     NodeResourcesFit,
 )
+from ksim_tpu.plugins.tainttoleration import TaintToleration
 from ksim_tpu.state.featurizer import FeaturizedSnapshot
 
 
 def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
+    """Upstream default-profile weights: BalancedAllocation 1, Fit 1,
+    NodeAffinity 2, TaintToleration 3 (default_plugins.go)."""
     return (
         ScoredPlugin(NodeUnschedulable(), score_enabled=False),
         ScoredPlugin(NodeResourcesFit(feats.resources), weight=1),
@@ -26,4 +30,6 @@ def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
             weight=1,
             filter_enabled=False,
         ),
+        ScoredPlugin(TaintToleration(feats.aux["taints"]), weight=3),
+        ScoredPlugin(NodeAffinity(), weight=2),
     )

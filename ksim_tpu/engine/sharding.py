@@ -65,3 +65,19 @@ def shard_node_state(state: NodeStateView, mesh: Mesh) -> NodeStateView:
     return NodeStateView(
         *(jax.device_put(a, s) for a, s in zip(state, shardings))
     )
+
+
+def shard_aux(aux: dict, axes: dict, mesh: Mesh) -> dict:
+    """Shard encoding arrays by their declared leading-axis kind
+    ("node" -> TP, "pod" -> DP, None -> replicated) — see the AXES
+    classvars in state/encoding.py."""
+
+    def put(a, kind):
+        name = {"node": TP, "pod": DP}.get(kind)
+        if name is None or a.ndim == 0:
+            spec = P(*([None] * a.ndim))
+        else:
+            spec = P(name, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, aux, axes)

@@ -57,6 +57,7 @@ class PodView(NamedTuple):
     nonzero_requests: jnp.ndarray  # i32 [R]
     tolerates_unschedulable: jnp.ndarray  # bool scalar
     has_requests: jnp.ndarray  # bool scalar (upstream fitsRequest early-exit)
+    index: jnp.ndarray  # i32 scalar — row into per-pod aux arrays
 
 
 class PodBatch(NamedTuple):
@@ -67,6 +68,7 @@ class PodBatch(NamedTuple):
     valid: jnp.ndarray  # bool [P]
     tolerates_unschedulable: jnp.ndarray  # bool [P]
     has_requests: jnp.ndarray  # bool [P]
+    index: jnp.ndarray  # i32 [P] == arange(P)
 
     def row(self, i) -> tuple["PodView", jnp.ndarray]:
         return (
@@ -75,6 +77,7 @@ class PodBatch(NamedTuple):
                 nonzero_requests=self.nonzero_requests[i],
                 tolerates_unschedulable=self.tolerates_unschedulable[i],
                 has_requests=self.has_requests[i],
+                index=self.index[i],
             ),
             self.valid[i],
         )
@@ -86,10 +89,15 @@ class FilterOutput(NamedTuple):
 
 
 class BatchPlugin(Protocol):
-    """Static interface of a batched plugin module."""
+    """Static interface of a batched plugin module.
+
+    ``aux`` is the device-side encoding dict (Engine converts
+    FeaturizedSnapshot.aux dataclasses to pytrees of jnp arrays); plugins
+    that need none ignore it.
+    """
 
     name: str
 
-    def filter(self, state: NodeStateView, pod: PodView) -> FilterOutput: ...
+    def filter(self, state: NodeStateView, pod: PodView, aux: dict) -> FilterOutput: ...
 
-    def score(self, state: NodeStateView, pod: PodView) -> jnp.ndarray: ...
+    def score(self, state: NodeStateView, pod: PodView, aux: dict) -> jnp.ndarray: ...

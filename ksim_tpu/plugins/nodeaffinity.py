@@ -1,0 +1,68 @@
+"""NodeAffinity filter + score kernels.
+
+Upstream kube-scheduler v1.30 ``plugins/nodeaffinity/node_affinity.go``:
+
+- Filter: pod.spec.nodeSelector (all pairs must match) AND
+  requiredDuringSchedulingIgnoredDuringExecution (OR over
+  nodeSelectorTerms; a present-but-unmatchable required clause fails).
+  Failure message: ``node(s) didn't match Pod's node affinity/selector``.
+- Score: sum of weights of matching preferred terms; normalized with
+  DefaultNormalizeScore(MaxNodeScore, reverse=false).
+
+Device algebra over the term vocabulary (state/encoding.py): a node
+matches term t iff its satisfied-requirement count over t's requirement
+set equals |t| — one integer matmul ``node_req_match @ term_req.T`` per
+evaluation, shared by filter and score.  Empty terms have size -1 and can
+never match (upstream: empty term matches nothing).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ksim_tpu.plugins.base import MAX_NODE_SCORE, FilterOutput, NodeStateView, PodView
+
+NAME = "NodeAffinity"
+ERR_REASON_POD = "node(s) didn't match Pod's node affinity/selector"
+
+
+def _term_matches(aux) -> jnp.ndarray:
+    """bool [N, T]: node matches term."""
+    a = aux["affinity"]
+    counts = a["node_req_match"].astype(jnp.int32) @ a["term_req"].astype(jnp.int32).T
+    return counts == a["term_size"][None, :]
+
+
+class NodeAffinity:
+    name = NAME
+
+    def filter(self, state: NodeStateView, pod: PodView, aux) -> FilterOutput:
+        a = aux["affinity"]
+        term_ok = _term_matches(aux)  # [N, T]
+        sel = a["selector_term"][pod.index]  # scalar
+        sel_ok = jnp.where(sel >= 0, term_ok[:, jnp.maximum(sel, 0)], True)
+        req_set = a["required_terms"][pod.index]  # [T]
+        req_ok = jnp.where(
+            a["has_required"][pod.index],
+            jnp.any(term_ok & req_set[None, :], axis=1),
+            True,
+        )
+        ok = sel_ok & req_ok
+        return FilterOutput(ok=ok, reason_bits=jnp.where(ok, 0, 1).astype(jnp.int32))
+
+    def decode_reasons(self, bits: int) -> list[str]:
+        return [ERR_REASON_POD] if bits else []
+
+    def score(self, state: NodeStateView, pod: PodView, aux) -> jnp.ndarray:
+        a = aux["affinity"]
+        term_ok = _term_matches(aux)
+        weights = a["preferred_weights"][pod.index]  # [T] i32
+        return (term_ok.astype(jnp.int32) * weights[None, :]).sum(axis=1)
+
+    def normalize(self, scores: jnp.ndarray, ok: jnp.ndarray) -> jnp.ndarray:
+        """DefaultNormalizeScore(MaxNodeScore, reverse=False) over feasible
+        nodes."""
+        mx = jnp.max(jnp.where(ok, scores, 0))
+        return jnp.where(
+            mx > 0, (MAX_NODE_SCORE * scores) // jnp.maximum(mx, 1), scores
+        ).astype(jnp.int32)

@@ -67,7 +67,7 @@ class NodeResourcesFit:
 
     # -- filter -------------------------------------------------------------
 
-    def filter(self, state: NodeStateView, pod: PodView) -> FilterOutput:
+    def filter(self, state: NodeStateView, pod: PodView, aux=None) -> FilterOutput:
         free = state.allocatable - state.requested  # [N, R]
         podr = pod.requests  # [R]
         r_axis = jnp.arange(podr.shape[0])
@@ -104,7 +104,7 @@ class NodeResourcesFit:
 
     # -- score (LeastAllocated) ---------------------------------------------
 
-    def score(self, state: NodeStateView, pod: PodView) -> jnp.ndarray:
+    def score(self, state: NodeStateView, pod: PodView, aux=None) -> jnp.ndarray:
         req = state.nonzero_requested + pod.nonzero_requests[None, :]  # [N, R]
         node_score = jnp.zeros(state.pod_count.shape[0], dtype=jnp.int32)
         weight_sum = jnp.zeros_like(node_score)
@@ -134,12 +134,12 @@ class NodeResourcesBalancedAllocation:
         idx = {r: i for i, r in enumerate(resources)}
         self._spec = tuple(idx[r] for r in score_resources if r in idx)
 
-    def filter(self, state: NodeStateView, pod: PodView) -> FilterOutput:
+    def filter(self, state: NodeStateView, pod: PodView, aux=None) -> FilterOutput:
         n = state.pod_count.shape[0]
         ok = jnp.ones(n, dtype=bool)
         return FilterOutput(ok=ok, reason_bits=jnp.zeros(n, dtype=jnp.int32))
 
-    def score(self, state: NodeStateView, pod: PodView) -> jnp.ndarray:
+    def score(self, state: NodeStateView, pod: PodView, aux=None) -> jnp.ndarray:
         req = state.nonzero_requested + pod.nonzero_requests[None, :]
         if len(self._spec) == 2 and _x64():
             return self._score_exact2(state, req)

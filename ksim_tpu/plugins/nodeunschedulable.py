@@ -21,7 +21,7 @@ ERR_REASON_UNSCHEDULABLE = "node(s) were unschedulable"
 class NodeUnschedulable:
     name = NAME
 
-    def filter(self, state: NodeStateView, pod: PodView) -> FilterOutput:
+    def filter(self, state: NodeStateView, pod: PodView, aux=None) -> FilterOutput:
         blocked = state.unschedulable & ~pod.tolerates_unschedulable
         return FilterOutput(
             ok=~blocked, reason_bits=jnp.where(blocked, 1, 0).astype(jnp.int32)

@@ -96,6 +96,108 @@ def node_unschedulable_filter(pod: JSON, info: NodeInfo) -> list[str]:
     return ["node(s) were unschedulable"]
 
 
+# -- TaintToleration --------------------------------------------------------
+
+
+def taint_toleration_filter(pod: JSON, info: NodeInfo) -> list[str]:
+    """Upstream taint_toleration.go Filter (FindMatchingUntoleratedTaint
+    over NoSchedule/NoExecute taints, node order)."""
+    from ksim_tpu.state.resources import node_taints, pod_tolerations, untolerated_taint
+
+    taint = untolerated_taint(node_taints(info["node"]), pod_tolerations(pod))
+    if taint is None:
+        return []
+    return [
+        f"node(s) had untolerated taint {{{taint.get('key', '')}: {taint.get('value', '')}}}"
+    ]
+
+
+def taint_toleration_score(pod: JSON, info: NodeInfo) -> int:
+    """Upstream countIntolerableTaintsPreferNoSchedule: PreferNoSchedule
+    taints not tolerated by the pod's ""/PreferNoSchedule tolerations."""
+    from ksim_tpu.state.resources import (
+        node_taints,
+        pod_tolerations,
+        tolerations_tolerate_taint,
+    )
+
+    tols = [
+        t
+        for t in pod_tolerations(pod)
+        if (t.get("effect") or "") in ("", "PreferNoSchedule")
+    ]
+    count = 0
+    for taint in node_taints(info["node"]):
+        if taint.get("effect") != "PreferNoSchedule":
+            continue
+        if not tolerations_tolerate_taint(tols, taint):
+            count += 1
+    return count
+
+
+# -- NodeAffinity ------------------------------------------------------------
+
+
+def node_affinity_filter(pod: JSON, info: NodeInfo) -> list[str]:
+    """Upstream node_affinity.go Filter: nodeSelector AND required terms."""
+    from ksim_tpu.state.selectors import match_node_selector_terms
+
+    node = info["node"]
+    labels = dict(node.get("metadata", {}).get("labels") or {})
+    spec = pod.get("spec", {})
+    ns = spec.get("nodeSelector")
+    if ns:
+        for k, v in ns.items():
+            if labels.get(k) != v:
+                return ["node(s) didn't match Pod's node affinity/selector"]
+    aff = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+    required = aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if required is not None:
+        if not match_node_selector_terms(
+            required.get("nodeSelectorTerms") or [], labels, info["name"]
+        ):
+            return ["node(s) didn't match Pod's node affinity/selector"]
+    return []
+
+
+def node_affinity_score(pod: JSON, info: NodeInfo) -> int:
+    """Upstream node_affinity.go Score: sum of matching preferred weights."""
+    from ksim_tpu.state.selectors import match_node_selector_term
+
+    node = info["node"]
+    labels = dict(node.get("metadata", {}).get("labels") or {})
+    aff = (pod.get("spec", {}).get("affinity") or {}).get("nodeAffinity") or {}
+    score = 0
+    for pt in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+        w = int(pt.get("weight", 0))
+        if w == 0:
+            continue
+        if match_node_selector_term(pt.get("preference") or {}, labels, info["name"]):
+            score += w
+    return score
+
+
+# -- normalization helper ----------------------------------------------------
+
+
+def default_normalize_score(
+    scores: list[int], *, reverse: bool, max_priority: int = MAX_NODE_SCORE
+) -> list[int]:
+    """Upstream helper.DefaultNormalizeScore over a scored-node list."""
+    max_count = max(scores, default=0)
+    if max_count == 0:
+        if reverse:
+            return [max_priority] * len(scores)
+        return list(scores)
+    out = []
+    for s in scores:
+        s = max_priority * s // max_count
+        if reverse:
+            s = max_priority - s
+        out.append(s)
+    return out
+
+
 # -- NodeResourcesFit -------------------------------------------------------
 
 

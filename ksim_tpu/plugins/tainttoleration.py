@@ -1,0 +1,69 @@
+"""TaintToleration filter + score kernels.
+
+Upstream kube-scheduler v1.30 ``plugins/tainttoleration/taint_toleration.go``:
+
+- Filter: the first taint with effect NoSchedule/NoExecute (in node taint
+  order) not tolerated by the pod fails the node with
+  ``node(s) had untolerated taint {<key>: <value>}``.
+- Score: count of PreferNoSchedule taints not tolerated by the pod's
+  tolerations with effect ""/PreferNoSchedule; normalized with
+  DefaultNormalizeScore(MaxNodeScore, reverse=true).
+
+Toleration matching runs host-side (state/encoding.py encode_taints);
+the kernel works on the distinct-taint vocabulary: ``reason_bits`` holds
+``w + 1`` of the first untolerated taint (0 == passed) so the exact
+upstream message is reconstructable.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ksim_tpu.plugins.base import MAX_NODE_SCORE, FilterOutput, NodeStateView, PodView
+from ksim_tpu.state.encoding import TaintTensors
+
+NAME = "TaintToleration"
+_BIG = jnp.iinfo(jnp.int32).max
+
+
+class TaintToleration:
+    name = NAME
+
+    def __init__(self, taints: TaintTensors) -> None:
+        self._taints = taints  # host-side vocab for decode
+
+    def filter(self, state: NodeStateView, pod: PodView, aux) -> FilterOutput:
+        a = aux["taints"]
+        order = a["node_taint_order"]  # [N, W]
+        tolerated = a["pod_tolerated"][pod.index]  # [W]
+        bad = (order > 0) & a["forbidding"][None, :] & ~tolerated[None, :]
+        first = jnp.min(jnp.where(bad, order, _BIG), axis=1)  # [N]
+        blocked = first != _BIG
+        # Recover which taint vocab index sits at that position.
+        w_idx = jnp.argmax(
+            (order == first[:, None]) & bad, axis=1
+        ).astype(jnp.int32)
+        reason = jnp.where(blocked, w_idx + 1, 0).astype(jnp.int32)
+        return FilterOutput(ok=~blocked, reason_bits=reason)
+
+    def decode_reasons(self, bits: int) -> list[str]:
+        if bits == 0:
+            return []
+        t = self._taints.taints[bits - 1]
+        return [f"node(s) had untolerated taint {{{t['key']}: {t['value']}}}"]
+
+    def score(self, state: NodeStateView, pod: PodView, aux) -> jnp.ndarray:
+        a = aux["taints"]
+        order = a["node_taint_order"]
+        tolerated = a["pod_tolerated_prefer"][pod.index]
+        intolerable = (order > 0) & a["prefer"][None, :] & ~tolerated[None, :]
+        return intolerable.sum(axis=1).astype(jnp.int32)
+
+    def normalize(self, scores: jnp.ndarray, ok: jnp.ndarray) -> jnp.ndarray:
+        """DefaultNormalizeScore(MaxNodeScore, reverse=True) over feasible
+        nodes (upstream normalizes the scored-node list only)."""
+        mx = jnp.max(jnp.where(ok, scores, 0))
+        scaled = (MAX_NODE_SCORE * scores) // jnp.maximum(mx, 1)
+        return jnp.where(mx > 0, MAX_NODE_SCORE - scaled, MAX_NODE_SCORE).astype(
+            jnp.int32
+        )

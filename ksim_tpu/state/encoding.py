@@ -1,0 +1,267 @@
+"""Vocabulary encodings: labels/selector terms and taints as tensors.
+
+SURVEY.md hard part 5 — "expressing label/taint/affinity matching as
+tensors".  The split that keeps semantics exact AND the device path dense:
+
+- **Host side** (here, numpy + exact string matching): build vocabularies
+  of distinct selector *requirements* (key, operator, values) and *terms*
+  (conjunctions of requirements) across the pod set, evaluate every
+  requirement against every node's labels once (Q x N boolean matrix),
+  and evaluate each pod's tolerations against the cluster's distinct
+  taints (P x W boolean matrix).  All In/NotIn/Exists/DoesNotExist/Gt/Lt
+  and toleration operator semantics run in Python — bit-exact by
+  construction (state/selectors.py, state/resources.py).
+- **Device side** (plugins/nodeaffinity.py, plugins/tainttoleration.py):
+  term matching reduces to an integer matmul — a node matches term t iff
+  its satisfied-requirement count over the term's requirement set equals
+  the term size — and taint filtering/scoring to masked reductions.
+
+Everything here keys into ``FeaturizedSnapshot.aux`` and rides into the
+jitted programs as traced inputs (never baked constants).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import numpy as np
+
+from ksim_tpu.state.resources import (
+    JSON,
+    labels_of,
+    name_of,
+    pod_tolerations,
+    toleration_tolerates,
+)
+from ksim_tpu.state.selectors import match_node_selector_requirement
+
+FORBIDDING_EFFECTS = ("NoSchedule", "NoExecute")
+
+
+# -- node-affinity / node-selector encoding ---------------------------------
+
+
+def _canon(obj: Any) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+@dataclass
+class AffinityTensors:
+    """Term-algebra arrays for NodeAffinity + pod.spec.nodeSelector."""
+
+    # Leading-axis kind per field, consumed by engine/sharding.shard_aux
+    # ("node" -> tp, "pod" -> dp, None -> replicated).
+    AXES = {
+        "node_req_match": "node",
+        "term_req": None,
+        "term_size": None,
+        "selector_term": "pod",
+        "has_required": "pod",
+        "required_terms": "pod",
+        "preferred_weights": "pod",
+    }
+
+    node_req_match: np.ndarray  # bool [N(padded), Q]
+    term_req: np.ndarray  # bool [T, Q]
+    term_size: np.ndarray  # int32 [T] (-1 for empty terms: match nothing)
+    selector_term: np.ndarray  # int32 [P(padded)] index into T, -1 = none
+    has_required: np.ndarray  # bool [P]
+    required_terms: np.ndarray  # bool [P, T]
+    preferred_weights: np.ndarray  # int32 [P, T]
+
+    @property
+    def n_terms(self) -> int:
+        return self.term_req.shape[0]
+
+
+class _TermVocab:
+    def __init__(self) -> None:
+        self.reqs: dict[str, int] = {}
+        self.req_list: list[JSON] = []
+        self.terms: dict[str, int] = {}
+        self.term_list: list[list[int]] = []
+
+    def req_id(self, req: JSON) -> int:
+        k = _canon(req)
+        if k not in self.reqs:
+            self.reqs[k] = len(self.req_list)
+            self.req_list.append(req)
+        return self.reqs[k]
+
+    def term_id(self, reqs: Sequence[JSON]) -> int:
+        ids = sorted(self.req_id(r) for r in reqs)
+        k = _canon(ids)
+        if k not in self.terms:
+            self.terms[k] = len(self.term_list)
+            self.term_list.append(ids)
+        return self.terms[k]
+
+
+def _term_reqs_from_selector_term(term: JSON) -> list[JSON] | None:
+    """NodeSelectorTerm -> requirement list; None for terms that match
+    nothing: the empty term, or a matchFields key other than metadata.name
+    (the only supported field — upstream nodeaffinity.go)."""
+    reqs = []
+    for e in term.get("matchExpressions") or []:
+        reqs.append(dict(e))
+    for f in term.get("matchFields") or []:
+        if f.get("key") != "metadata.name":
+            return None
+        reqs.append({**f, "_field": True})
+    return reqs or None
+
+
+def encode_affinity(
+    nodes: Sequence[JSON], pods: Sequence[JSON], n_padded: int, p_padded: int
+) -> AffinityTensors:
+    vocab = _TermVocab()
+    EMPTY = -2  # sentinel term id for match-nothing terms
+
+    def term_for(term: JSON) -> int:
+        reqs = _term_reqs_from_selector_term(term)
+        return EMPTY if reqs is None else vocab.term_id(reqs)
+
+    sel_term = np.full(p_padded, -1, dtype=np.int32)
+    has_req = np.zeros(p_padded, dtype=bool)
+    req_terms: list[list[int]] = [[] for _ in range(p_padded)]
+    pref: list[dict[int, int]] = [{} for _ in range(p_padded)]
+
+    for j, pod in enumerate(pods):
+        spec = pod.get("spec", {})
+        ns = spec.get("nodeSelector")
+        if ns:
+            reqs = [
+                {"key": k, "operator": "In", "values": [v]} for k, v in sorted(ns.items())
+            ]
+            sel_term[j] = vocab.term_id(reqs)
+        aff = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+        required = aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+        if required is not None:
+            has_req[j] = True
+            for t in required.get("nodeSelectorTerms") or []:
+                tid = term_for(t)
+                # Match-nothing terms contribute nothing to the OR.
+                if tid != EMPTY:
+                    req_terms[j].append(tid)
+        for pt in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+            tid = term_for(pt.get("preference") or {})
+            if tid != EMPTY:
+                w = int(pt.get("weight", 0))
+                pref[j][tid] = pref[j].get(tid, 0) + w
+
+    Q = len(vocab.req_list)
+    T = len(vocab.term_list)
+    node_req_match = np.zeros((n_padded, max(Q, 1)), dtype=bool)
+    for ni, node in enumerate(nodes):
+        lbls = dict(labels_of(node))
+        field_lbls = {"metadata.name": name_of(node)}
+        for qi, req in enumerate(vocab.req_list):
+            if req.get("_field"):
+                r = {k: v for k, v in req.items() if k != "_field"}
+                node_req_match[ni, qi] = match_node_selector_requirement(r, field_lbls)
+            else:
+                node_req_match[ni, qi] = match_node_selector_requirement(req, lbls)
+
+    term_req = np.zeros((max(T, 1), max(Q, 1)), dtype=bool)
+    term_size = np.full(max(T, 1), -1, dtype=np.int32)
+    for ti, ids in enumerate(vocab.term_list):
+        for qi in ids:
+            term_req[ti, qi] = True
+        term_size[ti] = len(ids)
+
+    required_terms = np.zeros((p_padded, max(T, 1)), dtype=bool)
+    preferred_weights = np.zeros((p_padded, max(T, 1)), dtype=np.int32)
+    for j in range(p_padded):
+        for tid in req_terms[j]:
+            required_terms[j, tid] = True
+        for tid, w in pref[j].items():
+            preferred_weights[j, tid] = w
+
+    return AffinityTensors(
+        node_req_match=node_req_match,
+        term_req=term_req,
+        term_size=term_size,
+        selector_term=sel_term,
+        has_required=has_req,
+        required_terms=required_terms,
+        preferred_weights=preferred_weights,
+    )
+
+
+# -- taint / toleration encoding --------------------------------------------
+
+
+@dataclass
+class TaintTensors:
+    """Distinct-taint vocabulary arrays."""
+
+    AXES = {
+        "node_taint_order": "node",
+        "forbidding": None,
+        "prefer": None,
+        "pod_tolerated": "pod",
+        "pod_tolerated_prefer": "pod",
+    }
+
+    taints: list[JSON]  # W distinct taints (key, value, effect)
+    node_taint_order: np.ndarray  # int32 [N(padded), W], position+1, 0=absent
+    forbidding: np.ndarray  # bool [W] effect in (NoSchedule, NoExecute)
+    prefer: np.ndarray  # bool [W] effect == PreferNoSchedule
+    pod_tolerated: np.ndarray  # bool [P(padded), W] (all tolerations)
+    pod_tolerated_prefer: np.ndarray  # bool [P, W] (effect ""|PreferNoSchedule tolerations only)
+
+    @property
+    def n_taints(self) -> int:
+        return len(self.taints)
+
+
+def encode_taints(
+    nodes: Sequence[JSON], pods: Sequence[JSON], n_padded: int, p_padded: int
+) -> TaintTensors:
+    vocab: dict[str, int] = {}
+    taints: list[JSON] = []
+
+    def tid(t: JSON) -> int:
+        key = _canon({"key": t.get("key", ""), "value": t.get("value", ""), "effect": t.get("effect", "")})
+        if key not in vocab:
+            vocab[key] = len(taints)
+            taints.append(
+                {"key": t.get("key", ""), "value": t.get("value", ""), "effect": t.get("effect", "")}
+            )
+        return vocab[key]
+
+    per_node: list[list[int]] = []
+    for node in nodes:
+        per_node.append([tid(t) for t in node.get("spec", {}).get("taints") or []])
+
+    W = max(len(taints), 1)
+    order = np.zeros((n_padded, W), dtype=np.int32)
+    for ni, ids in enumerate(per_node):
+        for pos, w in enumerate(ids):
+            if order[ni, w] == 0:
+                order[ni, w] = pos + 1
+    forbidding = np.zeros(W, dtype=bool)
+    prefer = np.zeros(W, dtype=bool)
+    for w, t in enumerate(taints):
+        forbidding[w] = t["effect"] in FORBIDDING_EFFECTS
+        prefer[w] = t["effect"] == "PreferNoSchedule"
+
+    tolerated = np.zeros((p_padded, W), dtype=bool)
+    tolerated_prefer = np.zeros((p_padded, W), dtype=bool)
+    for j, pod in enumerate(pods):
+        tols = pod_tolerations(pod)
+        prefer_tols = [t for t in tols if (t.get("effect") or "") in ("", "PreferNoSchedule")]
+        for w, t in enumerate(taints):
+            tolerated[j, w] = any(toleration_tolerates(tl, t) for tl in tols)
+            tolerated_prefer[j, w] = any(toleration_tolerates(tl, t) for tl in prefer_tols)
+
+    return TaintTensors(
+        taints=taints,
+        node_taint_order=order,
+        forbidding=forbidding,
+        prefer=prefer,
+        pod_tolerated=tolerated,
+        pod_tolerated_prefer=tolerated_prefer,
+    )

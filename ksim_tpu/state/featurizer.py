@@ -97,6 +97,7 @@ class PodTensors:
     valid: np.ndarray  # bool [P]
     tolerates_unschedulable: np.ndarray  # bool [P]
     has_requests: np.ndarray  # bool [P] (fitsRequest early-exit predicate)
+    index: np.ndarray  # int32 [P] == arange (row into per-pod aux arrays)
 
     @property
     def count(self) -> int:
@@ -250,10 +251,18 @@ class Featurizer:
                 k not in base_set and k != PODS for k in pod_reqs[j]
             )
 
+        from ksim_tpu.state.encoding import encode_affinity, encode_taints
+
+        aux = {
+            "affinity": encode_affinity(nodes, sched_pods, NP, PP),
+            "taints": encode_taints(nodes, sched_pods, NP, PP),
+        }
+
         return FeaturizedSnapshot(
             resources=resources,
             units=units,
             exact=exact,
+            aux=aux,
             nodes=NodeTensors(
                 names=node_names,
                 allocatable=alloc,
@@ -271,5 +280,6 @@ class Featurizer:
                 valid=pvalid,
                 tolerates_unschedulable=ptol,
                 has_requests=phas,
+                index=np.arange(PP, dtype=np.int32),
             ),
         )

@@ -70,10 +70,13 @@ def match_node_selector_requirement(req: JSON, labels: dict[str, str]) -> bool:
     raise ValueError(f"unknown node selector operator {op!r}")
 
 
-def match_node_selector_term(term: JSON, node_labels: dict[str, str]) -> bool:
-    """One NodeSelectorTerm: AND of matchExpressions (matchFields on
-    metadata.name are handled by the caller via labels injection). An empty
-    term matches nothing (upstream nodeaffinity.go NodeSelectorTerm)."""
+def match_node_selector_term(
+    term: JSON, node_labels: dict[str, str], node_name: str = ""
+) -> bool:
+    """One NodeSelectorTerm: AND of matchExpressions (against labels only)
+    and matchFields (only metadata.name is supported — upstream
+    nodeaffinity.go; a term naming any other field matches nothing).  An
+    empty term matches nothing."""
     exprs = term.get("matchExpressions") or []
     fields = term.get("matchFields") or []
     if not exprs and not fields:
@@ -82,17 +85,15 @@ def match_node_selector_term(term: JSON, node_labels: dict[str, str]) -> bool:
         if not match_node_selector_requirement(req, node_labels):
             return False
     for req in fields:
-        # Only supported field is metadata.name (upstream restriction).
         if req.get("key") != "metadata.name":
             return False
-        if not match_node_selector_requirement(
-            {**req, "key": "metadata.name"},
-            {"metadata.name": node_labels.get("metadata.name", "")},
-        ):
+        if not match_node_selector_requirement(req, {"metadata.name": node_name}):
             return False
     return True
 
 
-def match_node_selector_terms(terms: list[JSON], node_labels: dict[str, str]) -> bool:
+def match_node_selector_terms(
+    terms: list[JSON], node_labels: dict[str, str], node_name: str = ""
+) -> bool:
     """NodeSelector: OR over terms; empty list matches nothing."""
-    return any(match_node_selector_term(t, node_labels) for t in terms)
+    return any(match_node_selector_term(t, node_labels, node_name) for t in terms)

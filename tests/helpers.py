@@ -94,8 +94,15 @@ def random_cluster(
 ) -> tuple[list[JSON], list[JSON]]:
     """Reproducible random cluster; quantities are Mi/milli multiples."""
     rng = random.Random(seed)
+    zones = ["zone-a", "zone-b", "zone-c"]
+    disks = ["ssd", "hdd"]
     nodes = []
     for i in range(n_nodes):
+        taints = []
+        if rng.random() < 0.15:
+            taints.append({"key": "dedicated", "value": rng.choice(["gpu", "db"]), "effect": "NoSchedule"})
+        if rng.random() < 0.15:
+            taints.append({"key": "maintenance", "value": "", "effect": "PreferNoSchedule"})
         nodes.append(
             make_node(
                 f"node-{i}",
@@ -103,23 +110,60 @@ def random_cluster(
                 memory=f"{rng.choice([4, 8, 16, 32, 64])}Gi",
                 pods=rng.choice([8, 16, 32, 110]),
                 unschedulable=rng.random() < unschedulable_fraction,
+                labels={
+                    "topology.kubernetes.io/zone": rng.choice(zones),
+                    "kubernetes.io/hostname": f"node-{i}",
+                    "disktype": rng.choice(disks),
+                },
+                taints=taints or None,
             )
         )
     pods = []
     for i in range(n_pods):
         bound = rng.random() < bound_fraction
-        tolerates = rng.random() < 0.15
+        tolerations = []
+        if rng.random() < 0.15:
+            tolerations.append(
+                {"key": "node.kubernetes.io/unschedulable", "operator": "Exists", "effect": "NoSchedule"}
+            )
+        if rng.random() < 0.25:
+            tolerations.append(
+                {"key": "dedicated", "operator": rng.choice(["Exists", "Equal"]), "value": "gpu", "effect": "NoSchedule"}
+            )
+        if rng.random() < 0.15:
+            tolerations.append({"key": "maintenance", "operator": "Exists"})
+        node_selector = {"disktype": rng.choice(disks)} if rng.random() < 0.2 else None
+        affinity = None
+        if rng.random() < 0.3:
+            node_affinity = {}
+            if rng.random() < 0.6:
+                node_affinity["requiredDuringSchedulingIgnoredDuringExecution"] = {
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [
+                            {"key": "topology.kubernetes.io/zone", "operator": "In",
+                             "values": rng.sample(zones, rng.randint(1, 2))}
+                        ]}
+                    ]
+                }
+            if rng.random() < 0.7:
+                node_affinity["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                    {"weight": rng.choice([1, 10, 50, 100]),
+                     "preference": {"matchExpressions": [
+                         {"key": "disktype", "operator": rng.choice(["In", "NotIn"]),
+                          "values": [rng.choice(disks)]}
+                     ]}}
+                ]
+            if node_affinity:
+                affinity = {"nodeAffinity": node_affinity}
         pods.append(
             make_pod(
                 f"pod-{i}",
                 cpu=rng.choice([None, "50m", "100m", "250m", "500m", "1", "2"]),
                 memory=rng.choice([None, "64Mi", "128Mi", "512Mi", "1Gi", "4Gi"]),
                 node_name=f"node-{rng.randrange(n_nodes)}" if bound else "",
-                tolerations=[
-                    {"key": "node.kubernetes.io/unschedulable", "operator": "Exists", "effect": "NoSchedule"}
-                ]
-                if tolerates
-                else None,
+                tolerations=tolerations or None,
+                node_selector=node_selector,
+                affinity=affinity,
             )
         )
     return nodes, pods

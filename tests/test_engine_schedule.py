@@ -13,18 +13,35 @@ from tests.helpers import make_node, make_pod, random_cluster
 
 
 def greedy_oracle(nodes, pods, queue):
-    """Pure-Python replication of the engine's loop: filter, total score,
-    first-max selection, commit."""
+    """Pure-Python replication of the full default-profile cycle: all four
+    filters, raw scores, per-plugin normalization over feasible nodes,
+    upstream weights, first-max selection, commit."""
     infos = oracle.build_node_infos(nodes, pods)
     out = []
     for pod in queue:
+        feasible = [
+            ni
+            for ni, info in enumerate(infos)
+            if not (
+                oracle.node_unschedulable_filter(pod, info)
+                or oracle.fit_filter(pod, info)
+                or oracle.taint_toleration_filter(pod, info)
+                or oracle.node_affinity_filter(pod, info)
+            )
+        ]
         best, best_score = -1, None
-        for ni, info in enumerate(infos):
-            if oracle.node_unschedulable_filter(pod, info):
-                continue
-            if oracle.fit_filter(pod, info):
-                continue
-            total = oracle.least_allocated_score(pod, info) + oracle.balanced_allocation_score(pod, info)
+        fit = [oracle.least_allocated_score(pod, infos[ni]) for ni in feasible]
+        bal = [oracle.balanced_allocation_score(pod, infos[ni]) for ni in feasible]
+        tnt = oracle.default_normalize_score(
+            [oracle.taint_toleration_score(pod, infos[ni]) for ni in feasible],
+            reverse=True,
+        )
+        aff = oracle.default_normalize_score(
+            [oracle.node_affinity_score(pod, infos[ni]) for ni in feasible],
+            reverse=False,
+        )
+        for k, ni in enumerate(feasible):
+            total = fit[k] * 1 + bal[k] * 1 + tnt[k] * 3 + aff[k] * 2
             if best_score is None or total > best_score:
                 best, best_score = ni, total
         if best >= 0:

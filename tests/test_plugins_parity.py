@@ -36,13 +36,21 @@ def test_batch_parity_random_clusters(seed):
     assert len(queue) == feats.pods.count
 
     fit = NodeResourcesFit(feats.resources)
+    from ksim_tpu.plugins.nodeaffinity import NodeAffinity
     from ksim_tpu.plugins.nodeunschedulable import NodeUnschedulable
+    from ksim_tpu.plugins.tainttoleration import TaintToleration
 
     unsched = NodeUnschedulable()
+    taint = TaintToleration(feats.aux["taints"])
+    aff = NodeAffinity()
     uns_f = res.filter_plugin_names.index("NodeUnschedulable")
     fit_f = res.filter_plugin_names.index("NodeResourcesFit")
+    tnt_f = res.filter_plugin_names.index("TaintToleration")
+    aff_f = res.filter_plugin_names.index("NodeAffinity")
     fit_s = res.plugin_names.index("NodeResourcesFit")
     bal_s = res.plugin_names.index("NodeResourcesBalancedAllocation")
+    tnt_s = res.plugin_names.index("TaintToleration")
+    aff_s = res.plugin_names.index("NodeAffinity")
 
     for pi, pod in enumerate(queue):
         for ni, info in enumerate(infos):
@@ -53,8 +61,16 @@ def test_batch_parity_random_clusters(seed):
             want_uns = oracle.node_unschedulable_filter(pod, info)
             got_uns = unsched.decode_reasons(int(res.reason_bits[pi, uns_f, ni]))
             assert got_uns == want_uns, key
+            want_tnt = oracle.taint_toleration_filter(pod, info)
+            got_tnt = taint.decode_reasons(int(res.reason_bits[pi, tnt_f, ni]))
+            assert got_tnt == want_tnt, key
+            want_aff = oracle.node_affinity_filter(pod, info)
+            got_aff = aff.decode_reasons(int(res.reason_bits[pi, aff_f, ni]))
+            assert got_aff == want_aff, key
             assert int(res.scores[pi, fit_s, ni]) == oracle.least_allocated_score(pod, info)
             assert int(res.scores[pi, bal_s, ni]) == oracle.balanced_allocation_score(pod, info)
+            assert int(res.scores[pi, tnt_s, ni]) == oracle.taint_toleration_score(pod, info)
+            assert int(res.scores[pi, aff_s, ni]) == oracle.node_affinity_score(pod, info)
 
 
 def test_fit_filter_messages():
@@ -126,3 +142,30 @@ def test_zero_valued_extended_resource_defeats_early_exit():
     got = fit.decode_reasons(int(res.reason_bits[0, fit_f, 0]))
     info = oracle.build_node_infos(nodes, pods)[0]
     assert got == oracle.fit_filter(q, info) == ["Insufficient cpu"]
+
+
+def test_match_fields_metadata_name():
+    nodes = [make_node("target"), make_node("other")]
+    aff = {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+        "nodeSelectorTerms": [{"matchFields": [
+            {"key": "metadata.name", "operator": "In", "values": ["target"]}]}]}}}
+    q = make_pod("q", affinity=aff)
+    feats, eng = build_engine(nodes, [], queue=[q])
+    res = eng.evaluate_batch()
+    assert feats.nodes.names[int(res.selected[0])] == "target"
+    infos = oracle.build_node_infos(nodes, [])
+    assert oracle.node_affinity_filter(q, infos[0]) == []
+    assert oracle.node_affinity_filter(q, infos[1]) != []
+
+
+def test_match_fields_unsupported_key_matches_nothing():
+    nodes = [make_node("n1")]
+    aff = {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+        "nodeSelectorTerms": [{"matchFields": [
+            {"key": "spec.foo", "operator": "Exists"}]}]}}}
+    q = make_pod("q", affinity=aff)
+    feats, eng = build_engine(nodes, [], queue=[q])
+    res = eng.evaluate_batch()
+    assert int(res.selected[0]) == -1  # term matches nothing -> unschedulable
+    info = oracle.build_node_infos(nodes, [])[0]
+    assert oracle.node_affinity_filter(q, info) != []
