@@ -97,12 +97,24 @@ def _device_aux(aux: dict) -> tuple[dict, dict]:
 
 
 def _final_from_raw(
-    plugin: Any, raw: jnp.ndarray, ok: jnp.ndarray, weight: int
+    plugin: Any,
+    raw: jnp.ndarray,
+    ok: jnp.ndarray,
+    weight: int,
+    state=None,
+    pod=None,
+    aux=None,
+    kw=None,
 ) -> jnp.ndarray:
     """normalize (if the plugin defines it) then apply weight — the
-    reference's applyWeightOnScore (resultstore/store.go:504-507)."""
+    reference's applyWeightOnScore (resultstore/store.go:504-507).
+    Plugins declaring ``normalize_needs_ctx = True`` get the evaluation
+    context (PodTopologySpread's normalize depends on the pod)."""
     if hasattr(plugin, "normalize"):
-        raw = plugin.normalize(raw, ok)
+        if getattr(plugin, "normalize_needs_ctx", False):
+            raw = plugin.normalize(raw, ok, state=state, pod=pod, aux=aux, **(kw or {}))
+        else:
+            raw = plugin.normalize(raw, ok)
     return raw * weight
 
 
@@ -167,14 +179,20 @@ class Engine:
 
     # -- shared per-pod evaluation -----------------------------------------
 
-    def _eval_one(self, state: NodeStateView, pod: PodView, aux: dict):
-        """One pod vs all nodes through every plugin."""
+    def _eval_one(self, state: NodeStateView, pod: PodView, aux: dict, carries: dict):
+        """One pod vs all nodes through every plugin.
+
+        ``carries`` maps plugin name -> that plugin's scan-carried state
+        (e.g. PodTopologySpread's per-selector per-node match counts);
+        plugins without carry state never see the dict.
+        """
         reason_bits = []
         filter_ok = state.valid
         for sp in self._plugins:
             if not sp.filter_enabled:
                 continue
-            out: FilterOutput = sp.plugin.filter(state, pod, aux)
+            kw = {"carry": carries[sp.plugin.name]} if sp.plugin.name in carries else {}
+            out: FilterOutput = sp.plugin.filter(state, pod, aux, **kw)
             reason_bits.append(out.reason_bits)
             filter_ok = filter_ok & out.ok
         raw_scores = []
@@ -183,12 +201,29 @@ class Engine:
         for sp in self._plugins:
             if not sp.score_enabled:
                 continue
-            raw = sp.plugin.score(state, pod, aux)
-            final = _final_from_raw(sp.plugin, raw, filter_ok, sp.weight)
+            kw = {"carry": carries[sp.plugin.name]} if sp.plugin.name in carries else {}
+            raw = sp.plugin.score(state, pod, aux, ok=filter_ok, **kw)
+            final = _final_from_raw(sp.plugin, raw, filter_ok, sp.weight, state, pod, aux, kw)
             raw_scores.append(raw)
             final_scores.append(final)
             total = total + final.astype(jnp.int32)
         return filter_ok, reason_bits, raw_scores, final_scores, total
+
+    def _init_carries(self) -> dict:
+        return {
+            sp.plugin.name: sp.plugin.carry_init(self._aux)
+            for sp in self._plugins
+            if hasattr(sp.plugin, "carry_init")
+        }
+
+    def _commit_carries(self, carries: dict, pod: PodView, best, aux: dict) -> dict:
+        out = dict(carries)
+        for sp in self._plugins:
+            if sp.plugin.name in carries and hasattr(sp.plugin, "carry_commit"):
+                out[sp.plugin.name] = sp.plugin.carry_commit(
+                    carries[sp.plugin.name], aux, pod, best
+                )
+        return out
 
     def _select(self, filter_ok: jnp.ndarray, total: jnp.ndarray):
         feasible = jnp.any(filter_ok)
@@ -209,16 +244,16 @@ class Engine:
             out["raw"] = jnp.stack(raw) if raw else jnp.zeros((0, n), jnp.int32)
         return out
 
-    def batch_step(self, state, pods: PodBatch, aux: dict):
+    def batch_step(self, state, pods: PodBatch, aux: dict, carries: dict):
         """Pure jittable batch-evaluation step (un-jitted public form)."""
-        return self._batch_fn.__wrapped__(self, state, pods, aux)
+        return self._batch_fn.__wrapped__(self, state, pods, aux, carries)
 
     @property
     def example_args(self):
-        return (self._node_state, self._pods, self._aux)
+        return (self._node_state, self._pods, self._aux, self._init_carries())
 
     @partial(jax.jit, static_argnums=0)
-    def _batch_fn(self, state, pods: PodBatch, aux: dict):
+    def _batch_fn(self, state, pods: PodBatch, aux: dict, carries: dict):
         def per_pod(pb: PodBatch):
             pod = PodView(
                 requests=pb.requests,
@@ -227,7 +262,7 @@ class Engine:
                 has_requests=pb.has_requests,
                 index=pb.index,
             )
-            ok, bits, raw, final, total = self._eval_one(state, pod, aux)
+            ok, bits, raw, final, total = self._eval_one(state, pod, aux, carries)
             feasible, best = self._select(ok, total)
             return self._pod_outputs(pb.valid, feasible, best, bits, raw, final, total)
 
@@ -235,13 +270,16 @@ class Engine:
 
     def evaluate_batch(self) -> EngineResult:
         """All pods x nodes against the fixed snapshot (no state commit)."""
-        return self._to_result(self._batch_fn(self._node_state, self._pods, self._aux))
+        return self._to_result(
+            self._batch_fn(self._node_state, self._pods, self._aux, self._init_carries())
+        )
 
     # -- sequential scheduling (lax.scan with commit) ----------------------
 
     @partial(jax.jit, static_argnums=0)
-    def _schedule_fn(self, state, pods: PodBatch, aux: dict):
-        def body(carry: NodeStateView, pb: PodBatch):
+    def _schedule_fn(self, state, pods: PodBatch, aux: dict, carries: dict):
+        def body(carry, pb: PodBatch):
+            node_state, plugin_carries = carry
             pod = PodView(
                 requests=pb.requests,
                 nonzero_requests=pb.nonzero_requests,
@@ -249,20 +287,25 @@ class Engine:
                 has_requests=pb.has_requests,
                 index=pb.index,
             )
-            ok, bits, raw, final, total = self._eval_one(carry, pod, aux)
+            ok, bits, raw, final, total = self._eval_one(node_state, pod, aux, plugin_carries)
             feasible, best = self._select(ok, total)
             best = jnp.where(pb.valid, best, -1)
-            carry = carry.commit(best, pb.requests, pb.nonzero_requests)
-            return carry, self._pod_outputs(pb.valid, feasible, best, bits, raw, final, total)
+            node_state = node_state.commit(best, pb.requests, pb.nonzero_requests)
+            plugin_carries = self._commit_carries(plugin_carries, pod, best, aux)
+            return (node_state, plugin_carries), self._pod_outputs(
+                pb.valid, feasible, best, bits, raw, final, total
+            )
 
-        final_state, out = jax.lax.scan(body, state, pods)
-        return final_state, out
+        (final_state, final_carries), out = jax.lax.scan(body, (state, carries), pods)
+        return final_state, final_carries, out
 
     def schedule(self) -> tuple[EngineResult, NodeStateView]:
         """Greedy sequential scheduling of the pod queue with capacity
         commit; pod order is queue order (upstream pops by priority —
         callers sort the queue before featurizing)."""
-        state, out = self._schedule_fn(self._node_state, self._pods, self._aux)
+        state, _carries, out = self._schedule_fn(
+            self._node_state, self._pods, self._aux, self._init_carries()
+        )
         return self._to_result(out), jax.tree_util.tree_map(np.asarray, state)
 
     # -- decode -------------------------------------------------------------

@@ -15,13 +15,15 @@ from ksim_tpu.plugins.noderesources import (
     NodeResourcesBalancedAllocation,
     NodeResourcesFit,
 )
+from ksim_tpu.plugins.podtopologyspread import PodTopologySpread
 from ksim_tpu.plugins.tainttoleration import TaintToleration
 from ksim_tpu.state.featurizer import FeaturizedSnapshot
 
 
 def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
     """Upstream default-profile weights: BalancedAllocation 1, Fit 1,
-    NodeAffinity 2, TaintToleration 3 (default_plugins.go)."""
+    NodeAffinity 2, PodTopologySpread 2, TaintToleration 3
+    (default_plugins.go)."""
     return (
         ScoredPlugin(NodeUnschedulable(), score_enabled=False),
         ScoredPlugin(NodeResourcesFit(feats.resources), weight=1),
@@ -32,4 +34,5 @@ def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
         ),
         ScoredPlugin(TaintToleration(feats.aux["taints"]), weight=3),
         ScoredPlugin(NodeAffinity(), weight=2),
+        ScoredPlugin(PodTopologySpread(feats.aux["spread"]), weight=2),
     )

@@ -93,11 +93,18 @@ class BatchPlugin(Protocol):
 
     ``aux`` is the device-side encoding dict (Engine converts
     FeaturizedSnapshot.aux dataclasses to pytrees of jnp arrays); plugins
-    that need none ignore it.
+    that need none ignore it.  ``ok`` is the combined post-filter
+    feasibility mask passed to score.  Plugins with scan-carried state
+    additionally define carry_init(aux) / carry_commit(carry, aux, pod,
+    best) and receive ``carry=`` in filter/score.
     """
 
     name: str
 
-    def filter(self, state: NodeStateView, pod: PodView, aux: dict) -> FilterOutput: ...
+    def filter(
+        self, state: NodeStateView, pod: PodView, aux: dict, **kw
+    ) -> FilterOutput: ...
 
-    def score(self, state: NodeStateView, pod: PodView, aux: dict) -> jnp.ndarray: ...
+    def score(
+        self, state: NodeStateView, pod: PodView, aux: dict, ok=None, **kw
+    ) -> jnp.ndarray: ...

@@ -33,27 +33,34 @@ def _term_matches(aux) -> jnp.ndarray:
     return counts == a["term_size"][None, :]
 
 
+def required_affinity_match(aux, pod: PodView) -> jnp.ndarray:
+    """bool [N]: node passes the pod's nodeSelector AND required node
+    affinity — upstream nodeaffinity.GetRequiredNodeAffinity(pod).Match,
+    which PodTopologySpread's Honor nodeAffinityPolicy also consults."""
+    a = aux["affinity"]
+    term_ok = _term_matches(aux)  # [N, T]
+    sel = a["selector_term"][pod.index]  # scalar
+    sel_ok = jnp.where(sel >= 0, term_ok[:, jnp.maximum(sel, 0)], True)
+    req_set = a["required_terms"][pod.index]  # [T]
+    req_ok = jnp.where(
+        a["has_required"][pod.index],
+        jnp.any(term_ok & req_set[None, :], axis=1),
+        True,
+    )
+    return sel_ok & req_ok
+
+
 class NodeAffinity:
     name = NAME
 
     def filter(self, state: NodeStateView, pod: PodView, aux) -> FilterOutput:
-        a = aux["affinity"]
-        term_ok = _term_matches(aux)  # [N, T]
-        sel = a["selector_term"][pod.index]  # scalar
-        sel_ok = jnp.where(sel >= 0, term_ok[:, jnp.maximum(sel, 0)], True)
-        req_set = a["required_terms"][pod.index]  # [T]
-        req_ok = jnp.where(
-            a["has_required"][pod.index],
-            jnp.any(term_ok & req_set[None, :], axis=1),
-            True,
-        )
-        ok = sel_ok & req_ok
+        ok = required_affinity_match(aux, pod)
         return FilterOutput(ok=ok, reason_bits=jnp.where(ok, 0, 1).astype(jnp.int32))
 
     def decode_reasons(self, bits: int) -> list[str]:
         return [ERR_REASON_POD] if bits else []
 
-    def score(self, state: NodeStateView, pod: PodView, aux) -> jnp.ndarray:
+    def score(self, state: NodeStateView, pod: PodView, aux, ok=None) -> jnp.ndarray:
         a = aux["affinity"]
         term_ok = _term_matches(aux)
         weights = a["preferred_weights"][pod.index]  # [T] i32

@@ -104,7 +104,7 @@ class NodeResourcesFit:
 
     # -- score (LeastAllocated) ---------------------------------------------
 
-    def score(self, state: NodeStateView, pod: PodView, aux=None) -> jnp.ndarray:
+    def score(self, state: NodeStateView, pod: PodView, aux=None, ok=None) -> jnp.ndarray:
         req = state.nonzero_requested + pod.nonzero_requests[None, :]  # [N, R]
         node_score = jnp.zeros(state.pod_count.shape[0], dtype=jnp.int32)
         weight_sum = jnp.zeros_like(node_score)
@@ -139,7 +139,7 @@ class NodeResourcesBalancedAllocation:
         ok = jnp.ones(n, dtype=bool)
         return FilterOutput(ok=ok, reason_bits=jnp.zeros(n, dtype=jnp.int32))
 
-    def score(self, state: NodeStateView, pod: PodView, aux=None) -> jnp.ndarray:
+    def score(self, state: NodeStateView, pod: PodView, aux=None, ok=None) -> jnp.ndarray:
         req = state.nonzero_requested + pod.nonzero_requests[None, :]
         if len(self._spec) == 2 and _x64():
             return self._score_exact2(state, req)

@@ -177,6 +177,187 @@ def node_affinity_score(pod: JSON, info: NodeInfo) -> int:
     return score
 
 
+# -- PodTopologySpread -------------------------------------------------------
+
+
+def _spread_constraints(pod: JSON, mode: str) -> list[JSON]:
+    want = "DoNotSchedule" if mode == "filter" else "ScheduleAnyway"
+    out = []
+    for con in pod.get("spec", {}).get("topologySpreadConstraints") or []:
+        if con.get("whenUnsatisfiable", "DoNotSchedule") == want:
+            out.append(con)
+    return out
+
+
+def _spread_selector(con: JSON, pod: JSON) -> JSON:
+    from ksim_tpu.state.encoding import _effective_selector
+
+    return _effective_selector(con, pod)
+
+
+def _spread_node_eligible(pod: JSON, info: NodeInfo, con: JSON) -> bool:
+    """Per-constraint inclusion policies (NodeInclusionPolicy on,
+    defaults Honor affinity / Ignore taints)."""
+    from ksim_tpu.state.resources import node_taints, pod_tolerations, untolerated_taint
+
+    if (con.get("nodeAffinityPolicy") or "Honor") == "Honor":
+        if node_affinity_filter(pod, info):
+            return False
+    if (con.get("nodeTaintsPolicy") or "Ignore") == "Honor":
+        if untolerated_taint(node_taints(info["node"]), pod_tolerations(pod)) is not None:
+            return False
+    return True
+
+
+def _count_matching(info: NodeInfo, all_pods_by_node, ns: str, sel: JSON) -> int:
+    from ksim_tpu.state.selectors import match_label_selector
+    from ksim_tpu.state.resources import labels_of, namespace_of
+
+    count = 0
+    for p in all_pods_by_node.get(info["name"], []):
+        if (namespace_of(p) or "default") != ns:
+            continue
+        if match_label_selector(sel, labels_of(p)):
+            count += 1
+    return count
+
+
+def _node_has_keys(info: NodeInfo, cons: list[JSON]) -> bool:
+    from ksim_tpu.state.resources import labels_of
+
+    lbls = labels_of(info["node"])
+    return all(c.get("topologyKey", "") in lbls for c in cons)
+
+
+def topology_spread_filter_all(
+    pod: JSON, infos: list[NodeInfo], all_pods_by_node: dict
+) -> list[list[str]]:
+    """Upstream filtering.go: per-node failure reasons (empty = pass)."""
+    from ksim_tpu.state.resources import labels_of, namespace_of
+    from ksim_tpu.state.selectors import match_label_selector
+
+    cons = _spread_constraints(pod, "filter")
+    if not cons:
+        return [[] for _ in infos]
+    ns = namespace_of(pod) or "default"
+    out: list[list[str]] = []
+    # Domain stats per constraint over eligible nodes with all filter keys.
+    per_con: list[dict] = []
+    for con in cons:
+        sel = _spread_selector(con, pod)
+        counts: dict[str, int] = {}
+        for info in infos:
+            if not _node_has_keys(info, cons):
+                continue
+            if not _spread_node_eligible(pod, info, con):
+                continue
+            v = labels_of(info["node"]).get(con.get("topologyKey", ""))
+            counts[v] = counts.get(v, 0) + _count_matching(info, all_pods_by_node, ns, sel)
+        min_match = min(counts.values()) if counts else 0
+        min_domains = int(con.get("minDomains") or 0)
+        if min_domains > 0 and len(counts) < min_domains:
+            min_match = 0
+        per_con.append(
+            {
+                "con": con,
+                "sel": sel,
+                "counts": counts,
+                "min_match": min_match,
+                "self": match_label_selector(sel, labels_of(pod)),
+            }
+        )
+    for info in infos:
+        reasons: list[str] = []
+        lbls = labels_of(info["node"])
+        for pc in per_con:
+            tk = pc["con"].get("topologyKey", "")
+            if tk not in lbls:
+                reasons = [
+                    "node(s) didn't match pod topology spread constraints (missing required label)"
+                ]
+                break
+            match_num = pc["counts"].get(lbls[tk], 0)
+            skew = match_num + (1 if pc["self"] else 0) - pc["min_match"]
+            if skew > int(pc["con"].get("maxSkew", 1)):
+                reasons = ["node(s) didn't match pod topology spread constraints"]
+                break
+        out.append(reasons)
+    return out
+
+
+def topology_spread_score_all(
+    pod: JSON,
+    infos: list[NodeInfo],
+    all_pods_by_node: dict,
+    feasible: list[bool],
+) -> tuple[list[int], list[int]]:
+    """Upstream scoring.go: (raw, normalized) per node.  ``feasible`` marks
+    nodes that passed the whole framework filter (PreScore's
+    filteredNodes)."""
+    import math as _math
+
+    from ksim_tpu.state.resources import labels_of, namespace_of
+    from ksim_tpu.state.selectors import match_label_selector
+
+    n = len(infos)
+    cons = _spread_constraints(pod, "score")
+    if not cons:
+        # PreScore returns Skip: the plugin contributes nothing.
+        return [0] * n, [0] * n
+    ns = namespace_of(pod) or "default"
+    ignored = [not _node_has_keys(info, cons) for info in infos]
+    per_con = []
+    for con in cons:
+        sel = _spread_selector(con, pod)
+        registered: set[str] = set()
+        for i, info in enumerate(infos):
+            if feasible[i] and not ignored[i]:
+                v = labels_of(info["node"]).get(con.get("topologyKey", ""))
+                if v is not None:
+                    registered.add(v)
+        counts: dict[str, int] = {v: 0 for v in registered}
+        for info in infos:
+            if not _spread_node_eligible(pod, info, con):
+                continue
+            v = labels_of(info["node"]).get(con.get("topologyKey", ""))
+            if v in counts:
+                counts[v] += _count_matching(info, all_pods_by_node, ns, sel)
+        per_con.append(
+            {
+                "con": con,
+                "counts": counts,
+                "tp_weight": _math.log(len(registered) + 2),
+            }
+        )
+    raw = []
+    for i, info in enumerate(infos):
+        if not feasible[i] or ignored[i]:
+            raw.append(0)
+            continue
+        lbls = labels_of(info["node"])
+        total = 0.0
+        for pc in per_con:
+            v = lbls.get(pc["con"].get("topologyKey", ""))
+            if v in pc["counts"]:
+                total += pc["counts"][v] * pc["tp_weight"] + (
+                    int(pc["con"].get("maxSkew", 1)) - 1
+                )
+        raw.append(int(round(total)))
+    scoreable = [raw[i] for i in range(n) if feasible[i] and not ignored[i]]
+    mx = max(scoreable, default=0)
+    mn = min(scoreable, default=0)
+    norm = []
+    for i in range(n):
+        if ignored[i] or not feasible[i]:
+            norm.append(0)
+            continue
+        if mx == 0:
+            norm.append(MAX_NODE_SCORE)
+        else:
+            norm.append(MAX_NODE_SCORE * (mx + mn - raw[i]) // mx)
+    return raw, norm
+
+
 # -- normalization helper ----------------------------------------------------
 
 

@@ -1,0 +1,230 @@
+"""PodTopologySpread filter + score kernels.
+
+Upstream kube-scheduler v1.30 ``plugins/podtopologyspread/{filtering,scoring}.go``
+with NodeInclusionPolicy and MatchLabelKeys on (their v1.30 defaults),
+MinDomains honored for DoNotSchedule constraints:
+
+- Filter: for each DoNotSchedule constraint, nodes eligible for domain
+  statistics are those passing the constraint's inclusion policies
+  (nodeAffinityPolicy Honor -> pod's nodeSelector+required affinity;
+  nodeTaintsPolicy default Ignore) and carrying ALL the pod's
+  DoNotSchedule topology keys.  skew = matchNum + selfMatch - minMatchNum
+  must not exceed maxSkew; a candidate missing the topology key fails with
+  the upstream "(missing required label)" message.  minMatchNum is 0 when
+  the observed domain count is below minDomains.
+- Score: for each ScheduleAnyway constraint, counts accumulate over
+  policy-passing nodes whose domain is registered (i.e. present among
+  framework-feasible nodes with all score keys); per-node score is
+  ``count * log(domains + 2) + (maxSkew - 1)`` summed over constraints and
+  rounded; NormalizeScore is the integer ``100 * (max + min - s) // max``
+  with ignored nodes (missing a score key) pinned to 0, and everything
+  100 when max == 0.  Pods with no ScheduleAnyway constraints take
+  upstream's PreScore-Skip path: final contribution 0.
+
+The scan-carried state is the per-node matching-pod count per selector
+context (``[N, S]``); per-pod, per-constraint domain statistics are
+segment reductions over the global domain vocabulary (Dom axis).
+
+Known divergence (documented): upstream's *system default* constraints
+derive selectors from owning Services/ReplicaSets via DefaultSelector;
+the snapshot model (like the reference's 7-kind snapshot,
+simulator/snapshot/snapshot.go:33-42) carries no Services, so default
+constraints are not synthesized — only pod-defined constraints apply.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ksim_tpu.plugins.base import MAX_NODE_SCORE, FilterOutput, NodeStateView, PodView
+from ksim_tpu.plugins.nodeaffinity import required_affinity_match
+from ksim_tpu.plugins.tainttoleration import forbidding_taints_tolerated
+from ksim_tpu.state.encoding import SpreadTensors
+
+NAME = "PodTopologySpread"
+ERR_REASON_CONSTRAINTS_NOT_MATCH = "node(s) didn't match pod topology spread constraints"
+ERR_REASON_NODE_LABEL_NOT_MATCH = (
+    ERR_REASON_CONSTRAINTS_NOT_MATCH + " (missing required label)"
+)
+_BIG = jnp.iinfo(jnp.int32).max
+
+SKEW_BIT = 1
+MISSING_LABEL_BIT = 2
+
+
+class PodTopologySpread:
+    name = NAME
+    normalize_needs_ctx = True
+
+    def __init__(self, spread: SpreadTensors) -> None:
+        self._dom = spread.n_domains  # static for segment ops
+        self._mc = spread.con_valid.shape[1]
+
+    # -- carried state ------------------------------------------------------
+
+    def carry_init(self, aux) -> jnp.ndarray:
+        return aux["spread"]["init_counts"]  # i32 [N, S]
+
+    def carry_commit(self, carry, aux, pod: PodView, best) -> jnp.ndarray:
+        match = aux["spread"]["pod_sel_match"][pod.index]  # [S]
+        onehot = (jnp.arange(carry.shape[0]) == best) & (best >= 0)
+        return carry + (onehot[:, None] & match[None, :]).astype(carry.dtype)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _constraint_arrays(self, aux, pod: PodView):
+        a = aux["spread"]
+        j = pod.index
+        return {
+            "valid": a["con_valid"][j],
+            "mode": a["con_mode"][j],
+            "sel": a["con_sel"][j],
+            "tk": a["con_tk"][j],
+            "max_skew": a["con_max_skew"][j],
+            "min_domains": a["con_min_domains"][j],
+            "self": a["con_self"][j],
+            "honor_aff": a["con_honor_aff"][j],
+            "honor_taints": a["con_honor_taints"][j],
+        }
+
+    def _eligibility(self, state, pod, aux, honor_aff, honor_taints):
+        aff = required_affinity_match(aux, pod)
+        tnt = forbidding_taints_tolerated(aux, pod)
+        e = state.valid
+        e = e & jnp.where(honor_aff, aff, True)
+        e = e & jnp.where(honor_taints, tnt, True)
+        return e
+
+    def _has_all_keys(self, aux, con, mode_val) -> jnp.ndarray:
+        """bool [N]: node has every topology key of the pod's constraints
+        with the given mode."""
+        a = aux["spread"]
+        node_dom = a["node_dom"]  # [N, TK]
+        ok = jnp.ones(node_dom.shape[0], dtype=bool)
+        for ci in range(self._mc):
+            active = con["valid"][ci] & (con["mode"][ci] == mode_val)
+            has = jnp.take(node_dom, con["tk"][ci], axis=1) >= 0
+            ok = ok & jnp.where(active, has, True)
+        return ok
+
+    # -- filter -------------------------------------------------------------
+
+    def filter(self, state: NodeStateView, pod: PodView, aux, carry) -> FilterOutput:
+        a = aux["spread"]
+        con = self._constraint_arrays(aux, pod)
+        node_dom = a["node_dom"]
+        n = node_dom.shape[0]
+        allkeys = self._has_all_keys(aux, con, 0)
+
+        code = jnp.zeros(n, dtype=jnp.int32)
+        for ci in range(self._mc):
+            active = con["valid"][ci] & (con["mode"][ci] == 0)
+            d = jnp.take(node_dom, con["tk"][ci], axis=1)  # [N]
+            elig = (
+                self._eligibility(state, pod, aux, con["honor_aff"][ci], con["honor_taints"][ci])
+                & allkeys
+            )
+            cnt_node = jnp.take(carry, con["sel"][ci], axis=1)  # [N]
+            d_safe = jnp.maximum(d, 0)
+            stat = elig & (d >= 0)
+            seg = jax.ops.segment_sum(
+                jnp.where(stat, cnt_node, 0), d_safe, num_segments=self._dom
+            )
+            present = (
+                jax.ops.segment_max(
+                    jnp.where(stat, 1, 0), d_safe, num_segments=self._dom
+                )
+                > 0
+            )
+            domains_num = present.sum()
+            min_match = jnp.min(jnp.where(present, seg, _BIG))
+            min_match = jnp.where(domains_num > 0, min_match, 0)
+            min_match = jnp.where(
+                (con["min_domains"][ci] > 0) & (domains_num < con["min_domains"][ci]),
+                0,
+                min_match,
+            )
+            match_num = jnp.where(d >= 0, seg[d_safe], 0)
+            skew = match_num + con["self"][ci].astype(jnp.int32) - min_match
+            viol = skew > con["max_skew"][ci]
+            missing = d < 0
+            this_code = jnp.where(missing, MISSING_LABEL_BIT, jnp.where(viol, SKEW_BIT, 0))
+            code = jnp.where(active & (code == 0), this_code, code)
+        return FilterOutput(ok=code == 0, reason_bits=code)
+
+    def decode_reasons(self, bits: int) -> list[str]:
+        if bits == MISSING_LABEL_BIT:
+            return [ERR_REASON_NODE_LABEL_NOT_MATCH]
+        if bits == SKEW_BIT:
+            return [ERR_REASON_CONSTRAINTS_NOT_MATCH]
+        return []
+
+    # -- score --------------------------------------------------------------
+
+    def _ignored(self, aux, con, pod: PodView) -> jnp.ndarray:
+        """Nodes missing any ScheduleAnyway key while the pod has
+        constraints (requireAllTopologies -> IgnoredNodes)."""
+        a = aux["spread"]
+        has_con = a["has_score_con"][pod.index]
+        return has_con & ~self._has_all_keys(aux, con, 1)
+
+    def score(self, state: NodeStateView, pod: PodView, aux, ok=None, carry=None) -> jnp.ndarray:
+        a = aux["spread"]
+        con = self._constraint_arrays(aux, pod)
+        node_dom = a["node_dom"]
+        n = node_dom.shape[0]
+        ignored = self._ignored(aux, con, pod)
+        filtered = ok & ~ignored
+
+        # float64 under x64 (exact vs the float64 oracle/upstream);
+        # float32 on TPU (documented rounding tolerance at .5 boundaries).
+        ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        total = jnp.zeros(n, dtype=ftype)
+        for ci in range(self._mc):
+            active = con["valid"][ci] & (con["mode"][ci] == 1)
+            d = jnp.take(node_dom, con["tk"][ci], axis=1)
+            d_safe = jnp.maximum(d, 0)
+            # Registered domains: present among framework-feasible,
+            # non-ignored nodes (upstream calPreScoreState filteredNodes).
+            reg = (
+                jax.ops.segment_max(
+                    jnp.where(filtered & (d >= 0), 1, 0), d_safe, num_segments=self._dom
+                )
+                > 0
+            )
+            elig = (
+                self._eligibility(state, pod, aux, con["honor_aff"][ci], con["honor_taints"][ci])
+                & (d >= 0)
+                & reg[d_safe]
+            )
+            cnt_node = jnp.take(carry, con["sel"][ci], axis=1)
+            seg = jax.ops.segment_sum(
+                jnp.where(elig, cnt_node, 0), d_safe, num_segments=self._dom
+            )
+            domains_num = reg.sum()
+            tp_weight = jnp.log(domains_num.astype(ftype) + 2.0)
+            contrib = seg[d_safe].astype(ftype) * tp_weight + (
+                con["max_skew"][ci].astype(ftype) - 1.0
+            )
+            total = total + jnp.where(active & filtered, contrib, 0.0)
+        return jnp.round(total).astype(jnp.int32)
+
+    def normalize(self, scores, ok, *, state=None, pod=None, aux=None, carry=None):
+        con = self._constraint_arrays(aux, pod)
+        ignored = self._ignored(aux, con, pod)
+        scoreable = ok & ~ignored
+        has_con = aux["spread"]["has_score_con"][pod.index]
+        mx = jnp.max(jnp.where(scoreable, scores, jnp.iinfo(jnp.int32).min))
+        mn = jnp.min(jnp.where(scoreable, scores, _BIG))
+        any_scoreable = jnp.any(scoreable)
+        mx = jnp.where(any_scoreable, mx, 0)
+        mn = jnp.where(any_scoreable, mn, 0)
+        norm = jnp.where(
+            mx == 0,
+            MAX_NODE_SCORE,
+            (MAX_NODE_SCORE * (mx + mn - scores)) // jnp.maximum(mx, 1),
+        )
+        out = jnp.where(ignored, 0, norm)
+        # PreScore Skip: no ScheduleAnyway constraints -> no contribution.
+        return jnp.where(has_con, out, 0).astype(jnp.int32)

@@ -26,6 +26,16 @@ NAME = "TaintToleration"
 _BIG = jnp.iinfo(jnp.int32).max
 
 
+def forbidding_taints_tolerated(aux, pod: PodView) -> jnp.ndarray:
+    """bool [N]: no untolerated NoSchedule/NoExecute taint — the predicate
+    PodTopologySpread's Honor nodeTaintsPolicy consults."""
+    a = aux["taints"]
+    order = a["node_taint_order"]
+    tolerated = a["pod_tolerated"][pod.index]
+    bad = (order > 0) & a["forbidding"][None, :] & ~tolerated[None, :]
+    return ~jnp.any(bad, axis=1)
+
+
 class TaintToleration:
     name = NAME
 
@@ -52,7 +62,7 @@ class TaintToleration:
         t = self._taints.taints[bits - 1]
         return [f"node(s) had untolerated taint {{{t['key']}: {t['value']}}}"]
 
-    def score(self, state: NodeStateView, pod: PodView, aux) -> jnp.ndarray:
+    def score(self, state: NodeStateView, pod: PodView, aux, ok=None) -> jnp.ndarray:
         a = aux["taints"]
         order = a["node_taint_order"]
         tolerated = a["pod_tolerated_prefer"][pod.index]

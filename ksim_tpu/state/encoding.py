@@ -265,3 +265,184 @@ def encode_taints(
         pod_tolerated=tolerated,
         pod_tolerated_prefer=tolerated_prefer,
     )
+
+
+# -- pod-topology-spread encoding -------------------------------------------
+
+
+@dataclass
+class SpreadTensors:
+    """PodTopologySpread constraint tables and per-node selector counts.
+
+    S = distinct selector contexts (namespace, effective labelSelector —
+    matchLabelKeys merged in); TK = distinct topology keys; Dom = distinct
+    (key, value) domains; MC = max constraints per pod.
+    """
+
+    AXES = {
+        "node_dom": "node",
+        "init_counts": "node",
+        "pod_sel_match": "pod",
+        "con_valid": "pod",
+        "con_mode": "pod",
+        "con_sel": "pod",
+        "con_tk": "pod",
+        "con_max_skew": "pod",
+        "con_min_domains": "pod",
+        "con_self": "pod",
+        "con_honor_aff": "pod",
+        "con_honor_taints": "pod",
+        "has_score_con": "pod",
+    }
+
+    n_domains: int  # static Dom size (for segment ops)
+    node_dom: np.ndarray  # int32 [N, TK], domain id or -1
+    init_counts: np.ndarray  # int32 [N, S] matching bound pods per node
+    pod_sel_match: np.ndarray  # bool [P, S] queue pod matches context
+    con_valid: np.ndarray  # bool [P, MC]
+    con_mode: np.ndarray  # int32 [P, MC] 0=DoNotSchedule 1=ScheduleAnyway
+    con_sel: np.ndarray  # int32 [P, MC] selector-context id
+    con_tk: np.ndarray  # int32 [P, MC] topology-key id
+    con_max_skew: np.ndarray  # int32 [P, MC]
+    con_min_domains: np.ndarray  # int32 [P, MC] 0 = unset
+    con_self: np.ndarray  # bool [P, MC] pod matches own selector
+    con_honor_aff: np.ndarray  # bool [P, MC] nodeAffinityPolicy Honor
+    con_honor_taints: np.ndarray  # bool [P, MC] nodeTaintsPolicy Honor
+    has_score_con: np.ndarray  # bool [P]
+
+
+def _effective_selector(con: JSON, pod: JSON) -> JSON:
+    """labelSelector with matchLabelKeys folded in as In-requirements on
+    the pod's own label values (upstream MatchLabelKeysInPodTopologySpread,
+    beta/on in v1.30)."""
+    sel = dict(con.get("labelSelector") or {})
+    keys = con.get("matchLabelKeys") or []
+    if keys:
+        pod_labels = labels_of(pod)
+        exprs = list(sel.get("matchExpressions") or [])
+        for k in keys:
+            if k in pod_labels:
+                exprs.append({"key": k, "operator": "In", "values": [pod_labels[k]]})
+        sel["matchExpressions"] = exprs
+    return sel
+
+
+def encode_topology_spread(
+    nodes: Sequence[JSON],
+    pods: Sequence[JSON],
+    bound_pods: Sequence[JSON],
+    n_padded: int,
+    p_padded: int,
+) -> SpreadTensors:
+    from ksim_tpu.state.resources import namespace_of
+    from ksim_tpu.state.selectors import match_label_selector
+
+    tk_vocab: dict[str, int] = {}
+    dom_vocab: dict[tuple[int, str], int] = {}
+    sel_vocab: dict[str, int] = {}
+    sel_list: list[tuple[str, JSON]] = []  # (namespace, selector)
+
+    def tk_id(k: str) -> int:
+        if k not in tk_vocab:
+            tk_vocab[k] = len(tk_vocab)
+        return tk_vocab[k]
+
+    def sel_id(ns: str, sel: JSON) -> int:
+        key = _canon({"ns": ns, "sel": sel})
+        if key not in sel_vocab:
+            sel_vocab[key] = len(sel_list)
+            sel_list.append((ns, sel))
+        return sel_vocab[key]
+
+    # Pass 1: constraint tables.
+    per_pod_cons: list[list[dict]] = []
+    for pod in pods:
+        cons = []
+        for con in pod.get("spec", {}).get("topologySpreadConstraints") or []:
+            sel = _effective_selector(con, pod)
+            cons.append(
+                {
+                    "tk": tk_id(con.get("topologyKey", "")),
+                    "sel": sel_id(namespace_of(pod) or "default", sel),
+                    "mode": 0 if con.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule" else 1,
+                    "max_skew": int(con.get("maxSkew", 1)),
+                    "min_domains": int(con.get("minDomains") or 0),
+                    "self": match_label_selector(sel, labels_of(pod)),
+                    "honor_aff": (con.get("nodeAffinityPolicy") or "Honor") == "Honor",
+                    "honor_taints": (con.get("nodeTaintsPolicy") or "Ignore") == "Honor",
+                }
+            )
+        per_pod_cons.append(cons)
+
+    TK = max(len(tk_vocab), 1)
+    node_dom = np.full((n_padded, TK), -1, dtype=np.int32)
+    for ni, node in enumerate(nodes):
+        lbls = labels_of(node)
+        for k, ki in tk_vocab.items():
+            if k in lbls:
+                dk = (ki, lbls[k])
+                if dk not in dom_vocab:
+                    dom_vocab[dk] = len(dom_vocab)
+                node_dom[ni, ki] = dom_vocab[dk]
+
+    S = max(len(sel_list), 1)
+    init_counts = np.zeros((n_padded, S), dtype=np.int32)
+    node_index = {name_of(n): i for i, n in enumerate(nodes)}
+    for bp in bound_pods:
+        ni = node_index.get(bp.get("spec", {}).get("nodeName", ""))
+        if ni is None:
+            continue
+        for si, (ns, sel) in enumerate(sel_list):
+            if (namespace_of(bp) or "default") == ns and match_label_selector(sel, labels_of(bp)):
+                init_counts[ni, si] += 1
+
+    pod_sel_match = np.zeros((p_padded, S), dtype=bool)
+    for j, pod in enumerate(pods):
+        for si, (ns, sel) in enumerate(sel_list):
+            pod_sel_match[j, si] = (namespace_of(pod) or "default") == ns and match_label_selector(
+                sel, labels_of(pod)
+            )
+
+    MC = max((len(c) for c in per_pod_cons), default=0)
+    MC = max(MC, 1)
+    shape = (p_padded, MC)
+    con_valid = np.zeros(shape, dtype=bool)
+    con_mode = np.zeros(shape, dtype=np.int32)
+    con_sel = np.zeros(shape, dtype=np.int32)
+    con_tk = np.zeros(shape, dtype=np.int32)
+    con_max_skew = np.ones(shape, dtype=np.int32)
+    con_min_domains = np.zeros(shape, dtype=np.int32)
+    con_self = np.zeros(shape, dtype=bool)
+    con_honor_aff = np.ones(shape, dtype=bool)
+    con_honor_taints = np.zeros(shape, dtype=bool)
+    has_score = np.zeros(p_padded, dtype=bool)
+    for j, cons in enumerate(per_pod_cons):
+        for ci, c in enumerate(cons):
+            con_valid[j, ci] = True
+            con_mode[j, ci] = c["mode"]
+            con_sel[j, ci] = c["sel"]
+            con_tk[j, ci] = c["tk"]
+            con_max_skew[j, ci] = c["max_skew"]
+            con_min_domains[j, ci] = c["min_domains"]
+            con_self[j, ci] = c["self"]
+            con_honor_aff[j, ci] = c["honor_aff"]
+            con_honor_taints[j, ci] = c["honor_taints"]
+            if c["mode"] == 1:
+                has_score[j] = True
+
+    return SpreadTensors(
+        n_domains=max(len(dom_vocab), 1),
+        node_dom=node_dom,
+        init_counts=init_counts,
+        pod_sel_match=pod_sel_match,
+        con_valid=con_valid,
+        con_mode=con_mode,
+        con_sel=con_sel,
+        con_tk=con_tk,
+        con_max_skew=con_max_skew,
+        con_min_domains=con_min_domains,
+        con_self=con_self,
+        con_honor_aff=con_honor_aff,
+        con_honor_taints=con_honor_taints,
+        has_score_con=has_score,
+    )

@@ -251,11 +251,16 @@ class Featurizer:
                 k not in base_set and k != PODS for k in pod_reqs[j]
             )
 
-        from ksim_tpu.state.encoding import encode_affinity, encode_taints
+        from ksim_tpu.state.encoding import (
+            encode_affinity,
+            encode_taints,
+            encode_topology_spread,
+        )
 
         aux = {
             "affinity": encode_affinity(nodes, sched_pods, NP, PP),
             "taints": encode_taints(nodes, sched_pods, NP, PP),
+            "spread": encode_topology_spread(nodes, sched_pods, bound_pods, NP, PP),
         }
 
         return FeaturizedSnapshot(

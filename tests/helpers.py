@@ -118,9 +118,26 @@ def random_cluster(
                 taints=taints or None,
             )
         )
+    apps = ["web", "db", "cache", "batch"]
     pods = []
     for i in range(n_pods):
         bound = rng.random() < bound_fraction
+        app = rng.choice(apps)
+        spread = None
+        if rng.random() < 0.3:
+            spread = [{
+                "maxSkew": rng.choice([1, 2]),
+                "topologyKey": rng.choice(["topology.kubernetes.io/zone", "kubernetes.io/hostname"]),
+                "whenUnsatisfiable": rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                "labelSelector": {"matchLabels": {"app": app}},
+            }]
+            if rng.random() < 0.3:
+                spread.append({
+                    "maxSkew": 3,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": {"app": app}},
+                })
         tolerations = []
         if rng.random() < 0.15:
             tolerations.append(
@@ -161,9 +178,23 @@ def random_cluster(
                 cpu=rng.choice([None, "50m", "100m", "250m", "500m", "1", "2"]),
                 memory=rng.choice([None, "64Mi", "128Mi", "512Mi", "1Gi", "4Gi"]),
                 node_name=f"node-{rng.randrange(n_nodes)}" if bound else "",
+                labels={"app": app},
                 tolerations=tolerations or None,
                 node_selector=node_selector,
                 affinity=affinity,
+                topology_spread_constraints=spread,
             )
         )
     return nodes, pods
+
+
+def pods_by_node(pods: list[JSON]) -> dict[str, list[JSON]]:
+    """Bound, non-terminal pods grouped by node (the spread-stats view)."""
+    out: dict[str, list[JSON]] = {}
+    for p in pods:
+        if not p.get("spec", {}).get("nodeName"):
+            continue
+        if p.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+            continue
+        out.setdefault(p["spec"]["nodeName"], []).append(p)
+    return out

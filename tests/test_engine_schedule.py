@@ -13,22 +13,30 @@ from tests.helpers import make_node, make_pod, random_cluster
 
 
 def greedy_oracle(nodes, pods, queue):
-    """Pure-Python replication of the full default-profile cycle: all four
+    """Pure-Python replication of the full default-profile cycle: all five
     filters, raw scores, per-plugin normalization over feasible nodes,
     upstream weights, first-max selection, commit."""
+    from tests.helpers import pods_by_node as group_pods
+
     infos = oracle.build_node_infos(nodes, pods)
+    pods_by_node = group_pods(pods)
     out = []
     for pod in queue:
-        feasible = [
-            ni
-            for ni, info in enumerate(infos)
-            if not (
+        spread_reasons = oracle.topology_spread_filter_all(pod, infos, pods_by_node)
+        feasible_mask = [
+            not (
                 oracle.node_unschedulable_filter(pod, info)
                 or oracle.fit_filter(pod, info)
                 or oracle.taint_toleration_filter(pod, info)
                 or oracle.node_affinity_filter(pod, info)
+                or spread_reasons[ni]
             )
+            for ni, info in enumerate(infos)
         ]
+        feasible = [ni for ni, m in enumerate(feasible_mask) if m]
+        _, spread_norm = oracle.topology_spread_score_all(
+            pod, infos, pods_by_node, feasible_mask
+        )
         best, best_score = -1, None
         fit = [oracle.least_allocated_score(pod, infos[ni]) for ni in feasible]
         bal = [oracle.balanced_allocation_score(pod, infos[ni]) for ni in feasible]
@@ -41,11 +49,12 @@ def greedy_oracle(nodes, pods, queue):
             reverse=False,
         )
         for k, ni in enumerate(feasible):
-            total = fit[k] * 1 + bal[k] * 1 + tnt[k] * 3 + aff[k] * 2
+            total = fit[k] * 1 + bal[k] * 1 + tnt[k] * 3 + aff[k] * 2 + spread_norm[ni] * 2
             if best_score is None or total > best_score:
                 best, best_score = ni, total
         if best >= 0:
             oracle.commit_pod(infos[best], pod)
+            pods_by_node.setdefault(infos[best]["name"], []).append(pod)
         out.append(best)
     return out
 

@@ -1,0 +1,105 @@
+"""PodTopologySpread: kernel-vs-oracle parity and behavioral tests."""
+
+import numpy as np
+
+from ksim_tpu.engine import Engine
+from ksim_tpu.engine.profiles import default_plugins
+from ksim_tpu.plugins import oracle
+from ksim_tpu.plugins.podtopologyspread import PodTopologySpread
+from ksim_tpu.state.featurizer import Featurizer
+from tests.helpers import make_node, make_pod, pods_by_node, random_cluster
+
+
+def test_batch_parity_spread_random():
+    for seed in (11, 12):
+        nodes, pods = random_cluster(seed, n_nodes=11, n_pods=31, bound_fraction=0.4)
+        queue = [p for p in pods if not p["spec"].get("nodeName")]
+        feats = Featurizer().featurize(nodes, pods, queue_pods=queue)
+        eng = Engine(feats, default_plugins(feats), record="full")
+        res = eng.evaluate_batch()
+        infos = oracle.build_node_infos(nodes, pods)
+        by_node = pods_by_node(pods)
+        sp = PodTopologySpread(feats.aux["spread"])
+        sp_f = res.filter_plugin_names.index("PodTopologySpread")
+        sp_s = res.plugin_names.index("PodTopologySpread")
+        for pi, pod in enumerate(queue):
+            want_rows = oracle.topology_spread_filter_all(pod, infos, by_node)
+            for ni in range(len(infos)):
+                got = sp.decode_reasons(int(res.reason_bits[pi, sp_f, ni]))
+                assert got == want_rows[ni], (seed, pod["metadata"]["name"], ni)
+            # Raw score parity over the engine's feasibility mask.
+            feasible_mask = [
+                bool(
+                    np.all(res.reason_bits[pi, :, ni] == 0)
+                ) for ni in range(len(infos))
+            ]
+            raw, _ = oracle.topology_spread_score_all(pod, infos, by_node, feasible_mask)
+            for ni in range(len(infos)):
+                assert int(res.scores[pi, sp_s, ni]) == raw[ni], (seed, pi, ni)
+
+
+def test_do_not_schedule_skew_enforced():
+    # Two zones; zone-a already has 2 matching pods, zone-b has 0.
+    # maxSkew=1 forbids adding a third to zone-a (skew 3-0 > 1).
+    nodes = [
+        make_node("a1", labels={"topology.kubernetes.io/zone": "za"}),
+        make_node("b1", labels={"topology.kubernetes.io/zone": "zb"}),
+    ]
+    bound = [
+        make_pod("w1", labels={"app": "web"}, node_name="a1"),
+        make_pod("w2", labels={"app": "web"}, node_name="a1"),
+    ]
+    con = [{
+        "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "web"}},
+    }]
+    q = make_pod("w3", labels={"app": "web"}, topology_spread_constraints=con)
+    feats = Featurizer().featurize(nodes, bound, queue_pods=[q])
+    eng = Engine(feats, default_plugins(feats), record="full")
+    res = eng.evaluate_batch()
+    assert feats.nodes.names[int(res.selected[0])] == "b1"
+    sp_f = res.filter_plugin_names.index("PodTopologySpread")
+    assert int(res.reason_bits[0, sp_f, 0]) != 0  # zone-a blocked
+    assert int(res.reason_bits[0, sp_f, 1]) == 0
+
+
+def test_missing_topology_key_fails_with_label_reason():
+    nodes = [make_node("plain", labels={})]
+    con = [{
+        "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "web"}},
+    }]
+    q = make_pod("w", labels={"app": "web"}, topology_spread_constraints=con)
+    feats = Featurizer().featurize(nodes, [], queue_pods=[q])
+    eng = Engine(feats, default_plugins(feats), record="full")
+    res = eng.evaluate_batch()
+    sp = PodTopologySpread(feats.aux["spread"])
+    sp_f = res.filter_plugin_names.index("PodTopologySpread")
+    assert sp.decode_reasons(int(res.reason_bits[0, sp_f, 0])) == [
+        "node(s) didn't match pod topology spread constraints (missing required label)"
+    ]
+
+
+def test_schedule_anyway_spreads_across_zones():
+    # 4 schedulable pods with a ScheduleAnyway zone constraint and equal
+    # nodes: the scan should spread across zones, never stacking 3+ in one.
+    nodes = [
+        make_node(f"n{i}", labels={"topology.kubernetes.io/zone": f"z{i % 2}"})
+        for i in range(4)
+    ]
+    con = [{
+        "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+        "whenUnsatisfiable": "ScheduleAnyway",
+        "labelSelector": {"matchLabels": {"app": "web"}},
+    }]
+    queue = [
+        make_pod(f"w{i}", labels={"app": "web"}, topology_spread_constraints=con)
+        for i in range(4)
+    ]
+    feats = Featurizer().featurize(nodes, [], queue_pods=queue)
+    eng = Engine(feats, default_plugins(feats), record="selection")
+    res, _ = eng.schedule()
+    zones = [int(s) % 2 for s in res.selected[:4]]
+    assert sorted(zones) == [0, 0, 1, 1]
