@@ -9,6 +9,7 @@ KubeSchedulerConfiguration-driven profile compiler lives in sched/config.
 from __future__ import annotations
 
 from ksim_tpu.engine.core import ScoredPlugin
+from ksim_tpu.plugins.interpodaffinity import InterPodAffinity
 from ksim_tpu.plugins.nodeaffinity import NodeAffinity
 from ksim_tpu.plugins.nodeunschedulable import NodeUnschedulable
 from ksim_tpu.plugins.noderesources import (
@@ -22,8 +23,8 @@ from ksim_tpu.state.featurizer import FeaturizedSnapshot
 
 def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
     """Upstream default-profile weights: BalancedAllocation 1, Fit 1,
-    NodeAffinity 2, PodTopologySpread 2, TaintToleration 3
-    (default_plugins.go)."""
+    NodeAffinity 2, PodTopologySpread 2, InterPodAffinity 2,
+    TaintToleration 3 (default_plugins.go)."""
     return (
         ScoredPlugin(NodeUnschedulable(), score_enabled=False),
         ScoredPlugin(NodeResourcesFit(feats.resources), weight=1),
@@ -35,4 +36,5 @@ def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
         ScoredPlugin(TaintToleration(feats.aux["taints"]), weight=3),
         ScoredPlugin(NodeAffinity(), weight=2),
         ScoredPlugin(PodTopologySpread(feats.aux["spread"]), weight=2),
+        ScoredPlugin(InterPodAffinity(feats.aux["interpod"]), weight=2),
     )

@@ -1,0 +1,168 @@
+"""InterPodAffinity filter + score kernels.
+
+Upstream kube-scheduler v1.30 ``plugins/interpodaffinity/{filtering,
+scoring}.go`` (the reference records this plugin's per-node outcomes via its
+wrapped-plugin layer, reference simulator/scheduler/plugin/
+wrappedplugin.go:420-548):
+
+- Filter: (1) every required affinity term must have a matching existing
+  pod in the candidate node's topology domain — unless NO pod in the
+  cluster matches any term and the pod matches its own terms (the
+  first-pod-of-a-series escape); a node missing any term's topology key
+  fails.  (2) No required anti-affinity term may have a matching pod in
+  the domain.  (3) No existing pod's required anti-affinity term that
+  matches the incoming pod may have presence in the domain.  First failing
+  check wins (upstream Filter order).
+- Score: topology-pair weights accumulated from (a) the incoming pod's
+  preferred (anti-)affinity terms over matching existing pods (+w / -w)
+  and (b) existing pods' terms matched against the incoming pod —
+  required-affinity terms at HardPodAffinityWeight, preferred at +-w
+  (scoring.go processExistingPod).  NormalizeScore is
+  ``int(100 * (s - min) / (max - min))`` over feasible nodes, all zeros
+  when max == min.
+
+Tensorization: domain match counts are segment sums over the node axis
+(one per (context, topologyKey) term, batched via a flattened segment id
+space); each per-pod check is then a ``[N,T] x [T]`` matvec, which vmap
+turns into ``[P,T] x [T,N]`` MXU matmuls.  The [N,T] count tensors depend
+only on the scan carry, so XLA hoists them out of the vmapped pod batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ksim_tpu.plugins.base import MAX_NODE_SCORE, FilterOutput, NodeStateView, PodView
+from ksim_tpu.state.interpod import InterPodTensors
+
+NAME = "InterPodAffinity"
+
+ERR_REASON_AFFINITY_RULES_NOT_MATCH = "node(s) didn't match pod affinity rules"
+ERR_REASON_ANTI_AFFINITY_RULES_NOT_MATCH = "node(s) didn't match pod anti-affinity rules"
+ERR_REASON_EXISTING_ANTI_AFFINITY_RULES_NOT_MATCH = (
+    "node(s) didn't satisfy existing pods' anti-affinity rules"
+)
+
+AFFINITY_BIT = 1
+ANTI_BIT = 2
+EXISTING_ANTI_BIT = 4
+
+
+def _domain_counts(cols: jnp.ndarray, dom_t: jnp.ndarray, n_dom: int) -> jnp.ndarray:
+    """Per-(node, term) domain totals: out[n,t] = sum over nodes n' in the
+    same t-domain as n of cols[n',t]; 0 where the node lacks the key.
+
+    One flattened segment_sum covers all T terms (term t's ids live in
+    [t*(Dom+1), (t+1)*(Dom+1)); slot Dom collects the key-missing rows)."""
+    t = cols.shape[1]
+    ids = jnp.where(dom_t >= 0, dom_t, n_dom) + jnp.arange(t, dtype=dom_t.dtype)[None, :] * (
+        n_dom + 1
+    )
+    flat = jax.ops.segment_sum(
+        cols.reshape(-1), ids.reshape(-1), num_segments=t * (n_dom + 1)
+    )
+    out = flat[ids.reshape(-1)].reshape(cols.shape)
+    return jnp.where(dom_t >= 0, out, 0)
+
+
+class InterPodAffinity:
+    name = NAME
+
+    def __init__(self, ipa: InterPodTensors) -> None:
+        self._dom = ipa.n_domains  # static for segment ops
+
+    # -- carried state ------------------------------------------------------
+
+    def carry_init(self, aux) -> dict:
+        a = aux["interpod"]
+        return {
+            "match": a["match_counts"],
+            "ranti": a["ranti_counts"],
+            "ew": a["ew_counts"],
+        }
+
+    def carry_commit(self, carry, aux, pod: PodView, best) -> dict:
+        a = aux["interpod"]
+        j = pod.index
+        n = carry["match"].shape[0]
+        onehot = ((jnp.arange(n) == best) & (best >= 0)).astype(jnp.int32)
+        return {
+            "match": carry["match"] + onehot[:, None] * a["pod_ctx_match"][j].astype(jnp.int32),
+            "ranti": carry["ranti"] + onehot[:, None] * a["pod_eat"][j],
+            "ew": carry["ew"] + onehot[:, None] * a["pod_vw"][j],
+        }
+
+    # -- shared pod-independent tensors -------------------------------------
+
+    def _shared(self, aux, carry):
+        a = aux["interpod"]
+        dom_t = jnp.take(a["node_dom"], a["term_tk"], axis=1)  # [N, T]
+        mc_t = jnp.take(carry["match"], a["term_u"], axis=1)  # [N, T]
+        cnt = _domain_counts(mc_t, dom_t, self._dom)  # [N, T]
+        return a, dom_t, mc_t, cnt
+
+    # -- filter -------------------------------------------------------------
+
+    def filter(self, state: NodeStateView, pod: PodView, aux, carry) -> FilterOutput:
+        a, dom_t, mc_t, cnt = self._shared(aux, carry)
+        j = pod.index
+        i32 = jnp.int32
+        raff = a["req_aff"][j].astype(i32)  # [T]
+        ranti = a["req_anti"][j].astype(i32)
+        qm_t = jnp.take(a["pod_ctx_match"][j], a["term_u"]).astype(i32)  # [T]
+
+        # (1) required affinity: all topology keys present AND every term's
+        # domain count > 0 — or the global-empty + self-match escape.
+        missing_any = jnp.dot((dom_t < 0).astype(i32), raff) > 0  # [N]
+        no_pods_any = jnp.dot((cnt <= 0).astype(i32), raff) > 0
+        total_t = jnp.sum(jnp.where(dom_t >= 0, mc_t, 0), axis=0)  # [T]
+        escape = (jnp.dot(total_t, raff) == 0) & a["self_aff"][j]
+        pass_aff = ~missing_any & (~no_pods_any | escape)
+        # (2) incoming required anti-affinity (missing key = satisfied).
+        viol_anti = jnp.dot((cnt > 0).astype(i32), ranti) > 0
+        # (3) existing pods' required anti-affinity vs this pod.
+        ecnt = _domain_counts(carry["ranti"], dom_t, self._dom)
+        viol_existing = jnp.dot((ecnt > 0).astype(i32), qm_t) > 0
+
+        code = jnp.where(
+            ~pass_aff,
+            AFFINITY_BIT,
+            jnp.where(viol_anti, ANTI_BIT, jnp.where(viol_existing, EXISTING_ANTI_BIT, 0)),
+        ).astype(i32)
+        return FilterOutput(ok=code == 0, reason_bits=code)
+
+    def decode_reasons(self, bits: int) -> list[str]:
+        if bits & AFFINITY_BIT:
+            return [ERR_REASON_AFFINITY_RULES_NOT_MATCH]
+        if bits & ANTI_BIT:
+            return [ERR_REASON_ANTI_AFFINITY_RULES_NOT_MATCH]
+        if bits & EXISTING_ANTI_BIT:
+            return [ERR_REASON_EXISTING_ANTI_AFFINITY_RULES_NOT_MATCH]
+        return []
+
+    # -- score --------------------------------------------------------------
+
+    def score(self, state: NodeStateView, pod: PodView, aux, ok=None, carry=None) -> jnp.ndarray:
+        a, dom_t, _mc_t, cnt = self._shared(aux, carry)
+        j = pod.index
+        ew_c = _domain_counts(carry["ew"], dom_t, self._dom)
+        qm_t = jnp.take(a["pod_ctx_match"][j], a["term_u"]).astype(jnp.int32)
+        return (jnp.dot(cnt, a["pref_w"][j]) + jnp.dot(ew_c, qm_t)).astype(jnp.int32)
+
+    def normalize(self, scores: jnp.ndarray, ok: jnp.ndarray) -> jnp.ndarray:
+        big = jnp.iinfo(jnp.int32).max
+        any_ok = jnp.any(ok)
+        mn = jnp.where(any_ok, jnp.min(jnp.where(ok, scores, big)), 0)
+        mx = jnp.where(any_ok, jnp.max(jnp.where(ok, scores, -big - 1)), 0)
+        diff = mx - mn
+        # Go: fScore = float64(MaxNodeScore) * (float64(s-min)/float64(diff));
+        # int64(fScore) truncates (values >= 0 -> floor).  Division first.
+        # float64 under x64 (exact vs the float64 oracle/upstream); float32
+        # on TPU (documented +-1 rounding tolerance at exact-integer ratio
+        # boundaries, same caveat as PodTopologySpread.score).
+        ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        ratio = (scores - mn).astype(ftype) / jnp.maximum(diff, 1).astype(ftype)
+        val = jnp.floor(ftype(MAX_NODE_SCORE) * ratio)
+        out = jnp.where(diff > 0, val, 0.0)
+        return jnp.where(ok, out, 0).astype(jnp.int32)

@@ -358,6 +358,180 @@ def topology_spread_score_all(
     return raw, norm
 
 
+# -- InterPodAffinity --------------------------------------------------------
+
+
+def _ipa_required(pod: JSON, kind: str) -> list[JSON]:
+    aff = (pod.get("spec", {}).get("affinity") or {}).get(kind) or {}
+    return list(aff.get("requiredDuringSchedulingIgnoredDuringExecution") or [])
+
+
+def _ipa_preferred(pod: JSON, kind: str) -> list[JSON]:
+    aff = (pod.get("spec", {}).get("affinity") or {}).get(kind) or {}
+    return list(aff.get("preferredDuringSchedulingIgnoredDuringExecution") or [])
+
+
+def _ipa_term_matches(term: JSON, owner: JSON, other: JSON, ns_labels: dict) -> bool:
+    from ksim_tpu.state.interpod import context_matches, term_context
+    from ksim_tpu.state.resources import namespace_of
+
+    ctx = term_context(term, namespace_of(owner) or "default")
+    return context_matches(ctx, other, ns_labels)
+
+
+def _ipa_has_affinity(pod: JSON) -> bool:
+    from ksim_tpu.state.interpod import has_any_affinity
+
+    return has_any_affinity(pod)
+
+
+def inter_pod_affinity_filter_all(
+    pod: JSON,
+    infos: list[NodeInfo],
+    all_pods_by_node: dict,
+    namespaces: Sequence[JSON] = (),
+) -> list[list[str]]:
+    """Upstream filtering.go: per-node failure reasons (empty = pass),
+    first failing check only (Filter returns on first violation)."""
+    from ksim_tpu.state.resources import labels_of
+
+    ns_labels = {name_of(ns): dict(labels_of(ns)) for ns in namespaces}
+    aff_terms = _ipa_required(pod, "podAffinity")
+    anti_terms = _ipa_required(pod, "podAntiAffinity")
+
+    # PreFilter count maps: topologyPair -> matched term count.
+    affinity_counts: dict[tuple[str, str], int] = {}
+    anti_counts: dict[tuple[str, str], int] = {}
+    existing_anti_counts: dict[tuple[str, str], int] = {}
+    for info in infos:
+        node_lbls = labels_of(info["node"])
+        for ep in all_pods_by_node.get(info["name"], []):
+            for t in aff_terms:
+                tk = t.get("topologyKey", "")
+                if tk in node_lbls and _ipa_term_matches(t, pod, ep, ns_labels):
+                    key = (tk, node_lbls[tk])
+                    affinity_counts[key] = affinity_counts.get(key, 0) + 1
+            for t in anti_terms:
+                tk = t.get("topologyKey", "")
+                if tk in node_lbls and _ipa_term_matches(t, pod, ep, ns_labels):
+                    key = (tk, node_lbls[tk])
+                    anti_counts[key] = anti_counts.get(key, 0) + 1
+            for t in _ipa_required(ep, "podAntiAffinity"):
+                tk = t.get("topologyKey", "")
+                if tk in node_lbls and _ipa_term_matches(t, ep, pod, ns_labels):
+                    key = (tk, node_lbls[tk])
+                    existing_anti_counts[key] = existing_anti_counts.get(key, 0) + 1
+
+    self_match = bool(aff_terms) and all(
+        _ipa_term_matches(t, pod, pod, ns_labels) for t in aff_terms
+    )
+
+    out: list[list[str]] = []
+    for info in infos:
+        node_lbls = labels_of(info["node"])
+        # (1) satisfyPodAffinity.
+        pods_exist = True
+        missing_key = False
+        for t in aff_terms:
+            tk = t.get("topologyKey", "")
+            if tk in node_lbls:
+                if affinity_counts.get((tk, node_lbls[tk]), 0) <= 0:
+                    pods_exist = False
+            else:
+                missing_key = True
+                break
+        ok_aff = not missing_key and (
+            pods_exist or (len(affinity_counts) == 0 and self_match)
+        )
+        if not ok_aff:
+            out.append(["node(s) didn't match pod affinity rules"])
+            continue
+        # (2) satisfyPodAntiAffinity.
+        viol = any(
+            t.get("topologyKey", "") in node_lbls
+            and anti_counts.get(
+                (t.get("topologyKey", ""), node_lbls[t.get("topologyKey", "")]), 0
+            )
+            > 0
+            for t in anti_terms
+        )
+        if viol:
+            out.append(["node(s) didn't match pod anti-affinity rules"])
+            continue
+        # (3) satisfyExistingPodsAntiAffinity.
+        viol = any(
+            node_lbls.get(tk) == val and cnt > 0
+            for (tk, val), cnt in existing_anti_counts.items()
+        )
+        if viol:
+            out.append(["node(s) didn't satisfy existing pods' anti-affinity rules"])
+            continue
+        out.append([])
+    return out
+
+
+def inter_pod_affinity_score_all(
+    pod: JSON,
+    infos: list[NodeInfo],
+    all_pods_by_node: dict,
+    feasible: list[bool],
+    namespaces: Sequence[JSON] = (),
+    hard_weight: int = 1,
+) -> tuple[list[int], list[int]]:
+    """Upstream scoring.go: (raw, normalized) per node; non-feasible nodes
+    (absent from the upstream score list) get 0."""
+    from ksim_tpu.state.resources import labels_of
+
+    ns_labels = {name_of(ns): dict(labels_of(ns)) for ns in namespaces}
+    pref_aff = _ipa_preferred(pod, "podAffinity")
+    pref_anti = _ipa_preferred(pod, "podAntiAffinity")
+    has_constraints = bool(pref_aff) or bool(pref_anti)
+
+    topo: dict[tuple[str, str], int] = {}
+
+    def add(term: JSON, owner: JSON, to_check: JSON, node_lbls: dict, w: int) -> None:
+        tk = term.get("topologyKey", "")
+        if tk in node_lbls and _ipa_term_matches(term, owner, to_check, ns_labels):
+            key = (tk, node_lbls[tk])
+            topo[key] = topo.get(key, 0) + w
+
+    for info in infos:
+        node_lbls = labels_of(info["node"])
+        for ep in all_pods_by_node.get(info["name"], []):
+            if not has_constraints and not _ipa_has_affinity(ep):
+                continue  # podsToProcess = PodsWithAffinity
+            for wt in pref_aff:
+                add(wt.get("podAffinityTerm") or {}, pod, ep, node_lbls, int(wt.get("weight", 0)))
+            for wt in pref_anti:
+                add(wt.get("podAffinityTerm") or {}, pod, ep, node_lbls, -int(wt.get("weight", 0)))
+            if hard_weight > 0:
+                for t in _ipa_required(ep, "podAffinity"):
+                    add(t, ep, pod, node_lbls, hard_weight)
+            for wt in _ipa_preferred(ep, "podAffinity"):
+                add(wt.get("podAffinityTerm") or {}, ep, pod, node_lbls, int(wt.get("weight", 0)))
+            for wt in _ipa_preferred(ep, "podAntiAffinity"):
+                add(wt.get("podAffinityTerm") or {}, ep, pod, node_lbls, -int(wt.get("weight", 0)))
+
+    raw = []
+    for i, info in enumerate(infos):
+        if not feasible[i]:
+            raw.append(0)
+            continue
+        node_lbls = labels_of(info["node"])
+        raw.append(
+            sum(cnt for (tk, val), cnt in topo.items() if node_lbls.get(tk) == val)
+        )
+    feas_scores = [raw[i] for i in range(len(infos)) if feasible[i]]
+    norm = [0] * len(infos)
+    if feas_scores:
+        mn, mx = min(feas_scores), max(feas_scores)
+        diff = mx - mn
+        for i in range(len(infos)):
+            if feasible[i] and diff > 0:
+                norm[i] = int(float(MAX_NODE_SCORE) * (float(raw[i] - mn) / float(diff)))
+    return raw, norm
+
+
 # -- normalization helper ----------------------------------------------------
 
 

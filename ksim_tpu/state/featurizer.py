@@ -139,9 +139,11 @@ class Featurizer:
         pods: Sequence[JSON],
         *,
         queue_pods: Sequence[JSON] = (),
+        namespaces: Sequence[JSON] = (),
     ) -> FeaturizedSnapshot:
         """``pods`` are existing cluster pods (bound ones charge their node);
-        ``queue_pods`` are the pods to schedule (the pod axis P)."""
+        ``queue_pods`` are the pods to schedule (the pod axis P);
+        ``namespaces`` feed namespaceSelector matching (InterPodAffinity)."""
         sched_pods = list(queue_pods) if queue_pods else [
             p for p in pods if not pod_is_scheduled(p)
         ]
@@ -256,11 +258,15 @@ class Featurizer:
             encode_taints,
             encode_topology_spread,
         )
+        from ksim_tpu.state.interpod import encode_inter_pod
 
         aux = {
             "affinity": encode_affinity(nodes, sched_pods, NP, PP),
             "taints": encode_taints(nodes, sched_pods, NP, PP),
             "spread": encode_topology_spread(nodes, sched_pods, bound_pods, NP, PP),
+            "interpod": encode_inter_pod(
+                nodes, sched_pods, bound_pods, namespaces, NP, PP
+            ),
         }
 
         return FeaturizedSnapshot(

@@ -91,6 +91,7 @@ def random_cluster(
     *,
     bound_fraction: float = 0.3,
     unschedulable_fraction: float = 0.1,
+    pod_affinity_fraction: float = 0.15,
 ) -> tuple[list[JSON], list[JSON]]:
     """Reproducible random cluster; quantities are Mi/milli multiples."""
     rng = random.Random(seed)
@@ -172,6 +173,43 @@ def random_cluster(
                 ]
             if node_affinity:
                 affinity = {"nodeAffinity": node_affinity}
+        if rng.random() < pod_affinity_fraction:
+            tk = rng.choice(["topology.kubernetes.io/zone", "kubernetes.io/hostname"])
+            term = {
+                "labelSelector": {"matchLabels": {"app": rng.choice(apps)}},
+                "topologyKey": tk,
+            }
+            kind = rng.random()
+            pod_aff: JSON = {}
+            if kind < 0.35:
+                pod_aff["podAffinity"] = {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [term]
+                }
+            elif kind < 0.65:
+                pod_aff["podAntiAffinity"] = {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [term]
+                }
+            else:
+                pod_aff["podAffinity"] = {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {"weight": rng.choice([1, 25, 100]), "podAffinityTerm": term}
+                    ]
+                }
+                if rng.random() < 0.5:
+                    pod_aff["podAntiAffinity"] = {
+                        "preferredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "weight": rng.choice([1, 25, 100]),
+                                "podAffinityTerm": {
+                                    "labelSelector": {
+                                        "matchLabels": {"app": rng.choice(apps)}
+                                    },
+                                    "topologyKey": "topology.kubernetes.io/zone",
+                                },
+                            }
+                        ]
+                    }
+            affinity = {**(affinity or {}), **pod_aff}
         pods.append(
             make_pod(
                 f"pod-{i}",

@@ -23,6 +23,7 @@ def greedy_oracle(nodes, pods, queue):
     out = []
     for pod in queue:
         spread_reasons = oracle.topology_spread_filter_all(pod, infos, pods_by_node)
+        ipa_reasons = oracle.inter_pod_affinity_filter_all(pod, infos, pods_by_node)
         feasible_mask = [
             not (
                 oracle.node_unschedulable_filter(pod, info)
@@ -30,11 +31,15 @@ def greedy_oracle(nodes, pods, queue):
                 or oracle.taint_toleration_filter(pod, info)
                 or oracle.node_affinity_filter(pod, info)
                 or spread_reasons[ni]
+                or ipa_reasons[ni]
             )
             for ni, info in enumerate(infos)
         ]
         feasible = [ni for ni, m in enumerate(feasible_mask) if m]
         _, spread_norm = oracle.topology_spread_score_all(
+            pod, infos, pods_by_node, feasible_mask
+        )
+        _, ipa_norm = oracle.inter_pod_affinity_score_all(
             pod, infos, pods_by_node, feasible_mask
         )
         best, best_score = -1, None
@@ -49,7 +54,10 @@ def greedy_oracle(nodes, pods, queue):
             reverse=False,
         )
         for k, ni in enumerate(feasible):
-            total = fit[k] * 1 + bal[k] * 1 + tnt[k] * 3 + aff[k] * 2 + spread_norm[ni] * 2
+            total = (
+                fit[k] * 1 + bal[k] * 1 + tnt[k] * 3 + aff[k] * 2
+                + spread_norm[ni] * 2 + ipa_norm[ni] * 2
+            )
             if best_score is None or total > best_score:
                 best, best_score = ni, total
         if best >= 0:

@@ -1,0 +1,242 @@
+"""InterPodAffinity: kernel-vs-oracle parity and behavioral tests."""
+
+import numpy as np
+import pytest
+
+from ksim_tpu.engine import Engine
+from ksim_tpu.engine.profiles import default_plugins
+from ksim_tpu.plugins import oracle
+from ksim_tpu.plugins.interpodaffinity import InterPodAffinity
+from ksim_tpu.state.featurizer import Featurizer
+from tests.helpers import make_node, make_pod, pods_by_node, random_cluster
+
+
+def run_batch(nodes, pods, queue, namespaces=()):
+    feats = Featurizer().featurize(nodes, pods, queue_pods=queue, namespaces=namespaces)
+    eng = Engine(feats, default_plugins(feats), record="full")
+    return feats, eng.evaluate_batch()
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_batch_parity_interpod_random(seed):
+    nodes, pods = random_cluster(
+        seed, n_nodes=9, n_pods=33, bound_fraction=0.5, pod_affinity_fraction=0.45
+    )
+    queue = [p for p in pods if not p["spec"].get("nodeName")]
+    feats, res = run_batch(nodes, pods, queue)
+    infos = oracle.build_node_infos(nodes, pods)
+    by_node = pods_by_node(pods)
+    ipa = InterPodAffinity(feats.aux["interpod"])
+    f_i = res.filter_plugin_names.index("InterPodAffinity")
+    s_i = res.plugin_names.index("InterPodAffinity")
+    for pi, pod in enumerate(queue):
+        want_rows = oracle.inter_pod_affinity_filter_all(pod, infos, by_node)
+        for ni in range(len(infos)):
+            got = ipa.decode_reasons(int(res.reason_bits[pi, f_i, ni]))
+            assert got == want_rows[ni], (seed, pod["metadata"]["name"], ni)
+        feasible = [
+            bool(np.all(res.reason_bits[pi, :, ni] == 0)) for ni in range(len(infos))
+        ]
+        raw, norm = oracle.inter_pod_affinity_score_all(pod, infos, by_node, feasible)
+        for ni in range(len(infos)):
+            if feasible[ni]:
+                assert int(res.scores[pi, s_i, ni]) == raw[ni], (seed, pi, ni)
+                # final = normalized x weight (2).
+                assert int(res.final_scores[pi, s_i, ni]) == 2 * norm[ni], (seed, pi, ni)
+
+
+def test_required_affinity_missing_everywhere_blocks():
+    nodes = [make_node("n0", labels={"topology.kubernetes.io/zone": "za"})]
+    aff = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "labelSelector": {"matchLabels": {"app": "db"}},
+        "topologyKey": "topology.kubernetes.io/zone",
+    }]}}
+    q = make_pod("q", labels={"app": "web"}, affinity=aff)  # doesn't match itself
+    feats, res = run_batch(nodes, [], [q])
+    ipa = InterPodAffinity(feats.aux["interpod"])
+    f_i = res.filter_plugin_names.index("InterPodAffinity")
+    assert ipa.decode_reasons(int(res.reason_bits[0, f_i, 0])) == [
+        "node(s) didn't match pod affinity rules"
+    ]
+    assert int(res.selected[0]) == -1
+
+
+def test_self_affinity_escape_first_pod_of_series():
+    # No matching pods exist anywhere, but the pod matches its own term:
+    # upstream lets the first pod of a self-affine series through.
+    nodes = [make_node("n0", labels={"topology.kubernetes.io/zone": "za"})]
+    aff = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "topologyKey": "topology.kubernetes.io/zone",
+    }]}}
+    q = make_pod("q", labels={"app": "web"}, affinity=aff)
+    feats, res = run_batch(nodes, [], [q])
+    f_i = res.filter_plugin_names.index("InterPodAffinity")
+    assert int(res.reason_bits[0, f_i, 0]) == 0
+    assert int(res.selected[0]) == 0
+
+
+def test_affinity_requires_topology_key_on_node():
+    nodes = [
+        make_node("keyed", labels={"topology.kubernetes.io/zone": "za"}),
+        make_node("plain", labels={}),
+    ]
+    aff = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "topologyKey": "topology.kubernetes.io/zone",
+    }]}}
+    q = make_pod("q", labels={"app": "web"}, affinity=aff)
+    feats, res = run_batch(nodes, [], [q])
+    f_i = res.filter_plugin_names.index("InterPodAffinity")
+    assert int(res.reason_bits[0, f_i, 0]) == 0  # escape applies, key present
+    assert int(res.reason_bits[0, f_i, 1]) != 0  # missing key always fails
+
+
+def test_required_anti_affinity_blocks_domain():
+    nodes = [
+        make_node("a1", labels={"topology.kubernetes.io/zone": "za"}),
+        make_node("b1", labels={"topology.kubernetes.io/zone": "zb"}),
+    ]
+    bound = [make_pod("w1", labels={"app": "web"}, node_name="a1")]
+    aff = {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "topologyKey": "topology.kubernetes.io/zone",
+    }]}}
+    q = make_pod("q", labels={"app": "other"}, affinity=aff)
+    feats, res = run_batch(nodes, bound, [q])
+    ipa = InterPodAffinity(feats.aux["interpod"])
+    f_i = res.filter_plugin_names.index("InterPodAffinity")
+    assert ipa.decode_reasons(int(res.reason_bits[0, f_i, 0])) == [
+        "node(s) didn't match pod anti-affinity rules"
+    ]
+    assert feats.nodes.names[int(res.selected[0])] == "b1"
+
+
+def test_existing_pods_anti_affinity_blocks_incoming():
+    # Bound pod has anti-affinity against app=web; incoming web pod must
+    # avoid the bound pod's zone.
+    nodes = [
+        make_node("a1", labels={"topology.kubernetes.io/zone": "za"}),
+        make_node("b1", labels={"topology.kubernetes.io/zone": "zb"}),
+    ]
+    anti = {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "topologyKey": "topology.kubernetes.io/zone",
+    }]}}
+    bound = [make_pod("guard", labels={"app": "db"}, node_name="a1", affinity=anti)]
+    q = make_pod("q", labels={"app": "web"})
+    feats, res = run_batch(nodes, bound, [q])
+    ipa = InterPodAffinity(feats.aux["interpod"])
+    f_i = res.filter_plugin_names.index("InterPodAffinity")
+    assert ipa.decode_reasons(int(res.reason_bits[0, f_i, 0])) == [
+        "node(s) didn't satisfy existing pods' anti-affinity rules"
+    ]
+    assert feats.nodes.names[int(res.selected[0])] == "b1"
+
+
+def test_preferred_affinity_scores_colocated_domain():
+    nodes = [
+        make_node("a1", labels={"topology.kubernetes.io/zone": "za"}),
+        make_node("a2", labels={"topology.kubernetes.io/zone": "za"}),
+        make_node("b1", labels={"topology.kubernetes.io/zone": "zb"}),
+    ]
+    bound = [make_pod("w1", labels={"app": "web"}, node_name="a1")]
+    aff = {"podAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [{
+        "weight": 50,
+        "podAffinityTerm": {
+            "labelSelector": {"matchLabels": {"app": "web"}},
+            "topologyKey": "topology.kubernetes.io/zone",
+        },
+    }]}}
+    q = make_pod("q", labels={"app": "cache"}, affinity=aff)
+    feats, res = run_batch(nodes, bound, [q])
+    s_i = res.plugin_names.index("InterPodAffinity")
+    # Both za nodes get raw 50, zb gets 0.
+    assert int(res.scores[0, s_i, 0]) == 50
+    assert int(res.scores[0, s_i, 1]) == 50
+    assert int(res.scores[0, s_i, 2]) == 0
+    assert feats.nodes.names[int(res.selected[0])] in ("a1", "a2")
+
+
+def test_hard_affinity_weight_symmetry():
+    # Existing pod REQUIRES affinity to app=web; an incoming web pod is
+    # drawn to its domain with HardPodAffinityWeight (=1).
+    nodes = [
+        make_node("a1", labels={"topology.kubernetes.io/zone": "za"}),
+        make_node("b1", labels={"topology.kubernetes.io/zone": "zb"}),
+    ]
+    need_web = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "topologyKey": "topology.kubernetes.io/zone",
+    }]}}
+    bound = [make_pod("seed", labels={"app": "web"}, node_name="a1", affinity=need_web)]
+    q = make_pod("q", labels={"app": "web"})
+    feats, res = run_batch(nodes, bound, [q])
+    s_i = res.plugin_names.index("InterPodAffinity")
+    assert int(res.scores[0, s_i, 0]) == 1  # hard weight
+    assert int(res.scores[0, s_i, 1]) == 0
+
+
+def test_namespace_selector_matching():
+    nodes = [make_node("n0", labels={"kubernetes.io/hostname": "n0"})]
+    namespaces = [
+        {"metadata": {"name": "team-a", "labels": {"team": "a"}}},
+        {"metadata": {"name": "team-b", "labels": {"team": "b"}}},
+    ]
+    bound = [make_pod("w1", namespace="team-a", labels={"app": "web"}, node_name="n0")]
+    # Anti-affinity with namespaceSelector team=a: sees the team-a pod.
+    aff = {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "namespaceSelector": {"matchLabels": {"team": "a"}},
+        "topologyKey": "kubernetes.io/hostname",
+    }]}}
+    q = make_pod("q", namespace="team-b", labels={"app": "x"}, affinity=aff)
+    feats, res = run_batch(nodes, bound, [q], namespaces=namespaces)
+    f_i = res.filter_plugin_names.index("InterPodAffinity")
+    assert int(res.reason_bits[0, f_i, 0]) != 0
+    # Without the selector the term defaults to the pod's own namespace
+    # (team-b) and the team-a pod is invisible.
+    aff2 = {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "topologyKey": "kubernetes.io/hostname",
+    }]}}
+    q2 = make_pod("q2", namespace="team-b", labels={"app": "x"}, affinity=aff2)
+    feats2, res2 = run_batch(nodes, bound, [q2], namespaces=namespaces)
+    f_i2 = res2.filter_plugin_names.index("InterPodAffinity")
+    assert int(res2.reason_bits[0, f_i2, 0]) == 0
+
+
+def test_sequential_anti_affinity_spreads_one_per_host():
+    # 3 pods with required hostname anti-affinity to their own app: the
+    # scan must place one per node (each placement updates the carry).
+    nodes = [make_node(f"n{i}", labels={"kubernetes.io/hostname": f"n{i}"}) for i in range(3)]
+    aff = {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "topologyKey": "kubernetes.io/hostname",
+    }]}}
+    queue = [make_pod(f"w{i}", labels={"app": "web"}, affinity=aff) for i in range(3)]
+    feats = Featurizer().featurize(nodes, [], queue_pods=queue)
+    eng = Engine(feats, default_plugins(feats), record="selection")
+    res, _ = eng.schedule()
+    chosen = sorted(int(s) for s in res.selected[:3])
+    assert chosen == [0, 1, 2]
+
+
+def test_sequential_affinity_follows_first_placement():
+    # First pod self-escapes into some zone; followers must join it.
+    nodes = [
+        make_node("a1", labels={"topology.kubernetes.io/zone": "za"}),
+        make_node("b1", labels={"topology.kubernetes.io/zone": "zb"}),
+        make_node("a2", labels={"topology.kubernetes.io/zone": "za"}),
+    ]
+    aff = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "topologyKey": "topology.kubernetes.io/zone",
+    }]}}
+    queue = [make_pod(f"w{i}", labels={"app": "web"}, affinity=aff) for i in range(3)]
+    feats = Featurizer().featurize(nodes, [], queue_pods=queue)
+    eng = Engine(feats, default_plugins(feats), record="selection")
+    res, _ = eng.schedule()
+    zones = {feats.nodes.names[int(s)][0] for s in res.selected[:3]}
+    assert len(zones) == 1  # all in one zone
+    assert all(int(s) >= 0 for s in res.selected[:3])
