@@ -1,0 +1,205 @@
+"""Render engine results to the reference's Pod result annotations.
+
+The recorded results ARE the product (SURVEY.md hard part 7): the reference
+wraps every plugin, records per-node per-plugin outcomes into a result
+store, and reflects them onto the scheduled Pod's annotations (reference
+simulator/scheduler/plugin/resultstore/store.go:133-198 GetStoredResult,
+simulator/scheduler/plugin/annotation/annotation.go:3-31 keys,
+simulator/scheduler/storereflector/storereflector.go:148-167 history).
+
+This module reconstructs the exact same annotation contract from the
+batched EngineResult tensors:
+
+- ``filter-result``: node -> plugin -> "passed" | reason message, with the
+  upstream framework's early-exit semantics (a node rejected by filter k
+  has no entries for filters > k — upstream RunFilterPlugins stops at the
+  first failure).
+- ``score-result``: node -> plugin -> raw score (feasible nodes only —
+  upstream only scores nodes that passed all filters).
+- ``finalscore-result``: node -> plugin -> normalized x weight
+  (resultstore/store.go:461-507: AddScoreResult seeds final with
+  raw x weight; NormalizeScore overwrites with normalized x weight).
+- ``prefilter-result`` / ``prefilter-result-status`` / ``prescore-result``:
+  per-plugin "success" for plugins whose upstream counterpart implements
+  the extension point (our kernels fold Pre* work into the fused kernels,
+  so the recorded status is always success; PreFilterResult node lists are
+  always nil upstream for the default plugins -> "{}" here).
+- ``reserve-result`` / ``permit-result`` / ``permit-result-timeout`` /
+  ``prebind-result``: "{}" — the default profile has no wrapped plugins at
+  these points in our kernel set (VolumeBinding is not yet implemented).
+- ``bind-result``: {"DefaultBinder": "success"} for scheduled pods.
+- ``selected-node``: set only when the pod was scheduled (reference
+  store.go AddSelectedNode is called at Reserve).
+
+JSON is serialized with sorted keys and compact separators to byte-match
+Go's json.Marshal of map[string]string.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Sequence
+
+from ksim_tpu.engine.core import EngineResult, ScoredPlugin
+from ksim_tpu.state.featurizer import FeaturizedSnapshot
+
+PREFIX = "kube-scheduler-simulator.sigs.k8s.io/"
+
+PRE_FILTER_STATUS_KEY = PREFIX + "prefilter-result-status"
+PRE_FILTER_RESULT_KEY = PREFIX + "prefilter-result"
+FILTER_RESULT_KEY = PREFIX + "filter-result"
+POST_FILTER_RESULT_KEY = PREFIX + "postfilter-result"
+PRE_SCORE_RESULT_KEY = PREFIX + "prescore-result"
+SCORE_RESULT_KEY = PREFIX + "score-result"
+FINAL_SCORE_RESULT_KEY = PREFIX + "finalscore-result"
+RESERVE_RESULT_KEY = PREFIX + "reserve-result"
+PERMIT_RESULT_KEY = PREFIX + "permit-result"
+PERMIT_TIMEOUT_RESULT_KEY = PREFIX + "permit-result-timeout"
+PRE_BIND_RESULT_KEY = PREFIX + "prebind-result"
+BIND_RESULT_KEY = PREFIX + "bind-result"
+SELECTED_NODE_KEY = PREFIX + "selected-node"
+RESULT_HISTORY_KEY = PREFIX + "result-history"
+
+ALL_RESULT_KEYS = (
+    PRE_FILTER_STATUS_KEY,
+    PRE_FILTER_RESULT_KEY,
+    FILTER_RESULT_KEY,
+    POST_FILTER_RESULT_KEY,
+    PRE_SCORE_RESULT_KEY,
+    SCORE_RESULT_KEY,
+    FINAL_SCORE_RESULT_KEY,
+    RESERVE_RESULT_KEY,
+    PERMIT_RESULT_KEY,
+    PERMIT_TIMEOUT_RESULT_KEY,
+    PRE_BIND_RESULT_KEY,
+    BIND_RESULT_KEY,
+    SELECTED_NODE_KEY,
+)
+
+PASSED_FILTER_MESSAGE = "passed"  # resultstore PassedFilterMessage
+SUCCESS_MESSAGE = "success"  # resultstore SuccessMessage
+POST_FILTER_NOMINATED_MESSAGE = "preemption victim"
+
+# Upstream extension points implemented by each kernel's Go counterpart
+# (v1.30 plugin sources); used to emit the per-plugin "success" statuses
+# the wrapped plugins would have recorded.
+UPSTREAM_PRE_FILTER = {
+    "NodeResourcesFit",
+    "NodeAffinity",
+    "PodTopologySpread",
+    "InterPodAffinity",
+    "NodePorts",
+    "VolumeBinding",
+    "VolumeRestrictions",
+    "NodeVolumeLimits",
+}
+UPSTREAM_PRE_SCORE = {
+    "TaintToleration",
+    "NodeAffinity",
+    "PodTopologySpread",
+    "InterPodAffinity",
+    "NodeResourcesFit",
+    "NodeResourcesBalancedAllocation",
+    "VolumeBinding",
+}
+
+
+def _marshal(obj) -> str:
+    """Byte-compatible with Go json.Marshal for string maps."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def render_pod_results(
+    feats: FeaturizedSnapshot,
+    plugins: Sequence[ScoredPlugin],
+    res: EngineResult,
+    pi: int,
+) -> dict[str, str]:
+    """The 13 result annotations for queue pod ``pi`` (all keys present,
+    empty maps as "{}", mirroring GetStoredResult's unconditional adds)."""
+    if res.reason_bits is None:
+        raise ValueError("render_pod_results needs record='full' results")
+    node_names = feats.nodes.names
+    filter_plugins = [sp for sp in plugins if sp.filter_enabled]
+    score_plugins = [sp for sp in plugins if sp.score_enabled]
+
+    filter_map: dict[str, dict[str, str]] = {}
+    feasible_nodes: list[int] = []
+    for ni, node in enumerate(node_names):
+        row: dict[str, str] = {}
+        ok = True
+        for fi, sp in enumerate(filter_plugins):
+            bits = int(res.reason_bits[pi, fi, ni])
+            if bits == 0:
+                row[sp.plugin.name] = PASSED_FILTER_MESSAGE
+            else:
+                row[sp.plugin.name] = ", ".join(sp.plugin.decode_reasons(bits))
+                ok = False
+                break  # upstream RunFilterPlugins early exit
+        filter_map[node] = row
+        if ok:
+            feasible_nodes.append(ni)
+
+    score_map: dict[str, dict[str, str]] = {}
+    final_map: dict[str, dict[str, str]] = {}
+    if res.scores is not None and score_plugins:
+        for ni in feasible_nodes:
+            node = node_names[ni]
+            score_map[node] = {
+                sp.plugin.name: str(int(res.scores[pi, si, ni]))
+                for si, sp in enumerate(score_plugins)
+            }
+            final_map[node] = {
+                sp.plugin.name: str(int(res.final_scores[pi, si, ni]))
+                for si, sp in enumerate(score_plugins)
+            }
+
+    prefilter_status = {
+        sp.plugin.name: SUCCESS_MESSAGE
+        for sp in filter_plugins
+        if sp.plugin.name in UPSTREAM_PRE_FILTER
+    }
+    prescore = {
+        sp.plugin.name: SUCCESS_MESSAGE
+        for sp in score_plugins
+        if sp.plugin.name in UPSTREAM_PRE_SCORE
+    }
+
+    selected = int(res.selected[pi])
+    out = {
+        PRE_FILTER_RESULT_KEY: _marshal({}),
+        PRE_FILTER_STATUS_KEY: _marshal(prefilter_status),
+        FILTER_RESULT_KEY: _marshal(filter_map),
+        POST_FILTER_RESULT_KEY: _marshal({}),
+        PRE_SCORE_RESULT_KEY: _marshal(prescore),
+        SCORE_RESULT_KEY: _marshal(score_map),
+        FINAL_SCORE_RESULT_KEY: _marshal(final_map),
+        RESERVE_RESULT_KEY: _marshal({}),
+        PERMIT_RESULT_KEY: _marshal({}),
+        PERMIT_TIMEOUT_RESULT_KEY: _marshal({}),
+        PRE_BIND_RESULT_KEY: _marshal({}),
+        BIND_RESULT_KEY: _marshal(
+            {"DefaultBinder": SUCCESS_MESSAGE} if selected >= 0 else {}
+        ),
+    }
+    if selected >= 0:
+        out[SELECTED_NODE_KEY] = node_names[selected]
+    return out
+
+
+def update_result_history(annotations: dict[str, str], result: dict[str, str]) -> None:
+    """Append ``result`` to the result-history annotation in place
+    (reference storereflector.go:148-167 updateResultHistory)."""
+    history = json.loads(annotations.get(RESULT_HISTORY_KEY, "[]"))
+    history.append(result)
+    annotations[RESULT_HISTORY_KEY] = _marshal(history)
+
+
+def apply_results_to_pod(
+    pod_annotations: dict[str, str], result: dict[str, str]
+) -> dict[str, str]:
+    """What storeAllResultToPodFunc does to one Pod's annotations: merge
+    the result keys, then append the same set to the history."""
+    pod_annotations.update(result)
+    update_result_history(pod_annotations, result)
+    return pod_annotations

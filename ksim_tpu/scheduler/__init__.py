@@ -1,0 +1,5 @@
+"""Scheduler management layer (reference simulator/scheduler/)."""
+
+from ksim_tpu.scheduler.service import SchedulerService
+
+__all__ = ["SchedulerService"]

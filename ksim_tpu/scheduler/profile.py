@@ -1,0 +1,331 @@
+"""KubeSchedulerConfiguration -> compiled kernel profiles.
+
+The reference "compiles" a profile by rewriting the scheduler's
+KubeSchedulerConfiguration (wrap every plugin, merge plugin sets, disable
+MultiPoint defaults) and restarting the scheduler container (reference
+simulator/scheduler/scheduler.go:141-183 ConvertConfigurationForSimulator,
+simulator/scheduler/plugin/plugins.go:174-304 ConvertForSimulator/
+mergePluginSet/getScorePluginWeight).  The TPU analogue: select + configure
+the kernel set for the Engine — "restart" is re-jitting with a new plugin
+tuple (Engine construction), with rollback on a config that fails to
+compile.
+
+Merge semantics mirror upstream default_plugins.go mergePluginSet:
+
+- start from the default MultiPoint list (order defines filter order and
+  therefore early-exit recording order);
+- ``disabled`` entries remove by name, ``"*"`` removes all defaults;
+- ``enabled`` entries already in the defaults override the weight in
+  place; new names append in declaration order;
+- the per-extension-point sets (filter/score/...) then enable/disable on
+  top, for out-of-tree or re-weighted plugins.
+
+Plugin args honored from pluginConfig (upstream *Args types):
+``NodeResourcesFitArgs.scoringStrategy`` (LeastAllocated resources),
+``NodeResourcesBalancedAllocationArgs.resources``,
+``InterPodAffinityArgs.hardPodAffinityWeight`` (threaded into the
+featurizer's inter-pod encoding).
+
+Names the upstream default profile enables that have no batched kernel
+yet are STRUCTURAL (handled by the service: PrioritySort = queue sort,
+DefaultBinder = bind, DefaultPreemption = postfilter, SchedulingGates) or
+UNIMPLEMENTED (volume family; they compile to no-ops and are listed in
+``CompiledProfile.skipped`` so callers can surface the gap).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+from ksim_tpu.engine.core import ScoredPlugin
+from ksim_tpu.state.featurizer import FeaturizedSnapshot, Featurizer
+from ksim_tpu.state.interpod import DEFAULT_HARD_POD_AFFINITY_WEIGHT
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+# Upstream v1.30 getDefaultPlugins MultiPoint order and weights
+# (pkg/scheduler/apis/config/v1/default_plugins.go).
+DEFAULT_MULTIPOINT: tuple[tuple[str, int], ...] = (
+    ("SchedulingGates", 0),
+    ("PrioritySort", 0),
+    ("NodeUnschedulable", 0),
+    ("NodeName", 0),
+    ("TaintToleration", 3),
+    ("NodeAffinity", 2),
+    ("NodePorts", 0),
+    ("NodeResourcesFit", 1),
+    ("VolumeRestrictions", 0),
+    ("NodeVolumeLimits", 0),
+    ("VolumeBinding", 0),
+    ("VolumeZone", 0),
+    ("PodTopologySpread", 2),
+    ("InterPodAffinity", 2),
+    ("DefaultPreemption", 0),
+    ("NodeResourcesBalancedAllocation", 1),
+    ("ImageLocality", 1),
+    ("DefaultBinder", 0),
+)
+
+# Plugins realized outside the kernel set.
+STRUCTURAL_PLUGINS = frozenset(
+    {"SchedulingGates", "PrioritySort", "DefaultPreemption", "DefaultBinder"}
+)
+
+# Builder: (feats, args) -> ScoredPlugin (weight filled by the compiler).
+Builder = Callable[[FeaturizedSnapshot, dict], ScoredPlugin]
+
+
+def _build_node_unschedulable(feats, args):
+    from ksim_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+    return ScoredPlugin(NodeUnschedulable(), score_enabled=False)
+
+
+def _build_fit(feats, args):
+    from ksim_tpu.plugins.noderesources import NodeResourcesFit
+
+    strategy = args.get("scoringStrategy") or {}
+    resources = strategy.get("resources") or [
+        {"name": "cpu", "weight": 1},
+        {"name": "memory", "weight": 1},
+    ]
+    stype = strategy.get("type", "LeastAllocated")
+    if stype != "LeastAllocated":
+        raise ValueError(
+            f"NodeResourcesFit scoringStrategy {stype!r} not supported "
+            "(LeastAllocated only)"
+        )
+    spec = tuple((r["name"], int(r.get("weight", 1))) for r in resources)
+    return ScoredPlugin(NodeResourcesFit(feats.resources, score_resources=spec))
+
+
+def _build_balanced(feats, args):
+    from ksim_tpu.plugins.noderesources import NodeResourcesBalancedAllocation
+
+    resources = args.get("resources") or [{"name": "cpu"}, {"name": "memory"}]
+    spec = tuple(r["name"] for r in resources)
+    return ScoredPlugin(
+        NodeResourcesBalancedAllocation(feats.resources, score_resources=spec),
+        filter_enabled=False,
+    )
+
+
+def _build_taints(feats, args):
+    from ksim_tpu.plugins.tainttoleration import TaintToleration
+
+    return ScoredPlugin(TaintToleration(feats.aux["taints"]))
+
+
+def _build_node_affinity(feats, args):
+    from ksim_tpu.plugins.nodeaffinity import NodeAffinity
+
+    if args.get("addedAffinity"):
+        raise ValueError("NodeAffinityArgs.addedAffinity is not supported yet")
+    return ScoredPlugin(NodeAffinity())
+
+
+def _build_spread(feats, args):
+    from ksim_tpu.plugins.podtopologyspread import PodTopologySpread
+
+    return ScoredPlugin(PodTopologySpread(feats.aux["spread"]))
+
+
+def _build_interpod(feats, args):
+    from ksim_tpu.plugins.interpodaffinity import InterPodAffinity
+
+    return ScoredPlugin(InterPodAffinity(feats.aux["interpod"]))
+
+
+INTREE_BUILDERS: dict[str, Builder] = {
+    "NodeUnschedulable": _build_node_unschedulable,
+    "NodeResourcesFit": _build_fit,
+    "NodeResourcesBalancedAllocation": _build_balanced,
+    "TaintToleration": _build_taints,
+    "NodeAffinity": _build_node_affinity,
+    "PodTopologySpread": _build_spread,
+    "InterPodAffinity": _build_interpod,
+}
+
+
+@dataclass
+class CompiledProfile:
+    """One profile's kernel set, ready to drive the Engine."""
+
+    scheduler_name: str
+    enabled: tuple[tuple[str, int], ...]  # (plugin, weight) in filter order
+    plugin_args: dict[str, dict]
+    skipped: tuple[str, ...]  # enabled names with no kernel (gap surface)
+    registry: dict[str, Builder] = field(default_factory=dict)
+    hard_pod_affinity_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT
+    # Per-extension-point overrides (upstream per-point PluginSets disable
+    # a plugin at ONE point, not everywhere).
+    filter_disabled: frozenset[str] = frozenset()
+    score_disabled: frozenset[str] = frozenset()
+    # Plugins added only through a per-point set: name -> points enabled.
+    point_only: dict[str, frozenset[str]] = field(default_factory=dict)
+
+    def featurizer(self) -> Featurizer:
+        return Featurizer(interpod_hard_weight=self.hard_pod_affinity_weight)
+
+    def plugins(self, feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
+        """The Engine plugin tuple — the jit-compiled unit.  Rebuilding
+        after a config change is the reference's scheduler restart."""
+        out = []
+        for name, weight in self.enabled:
+            builder = self.registry.get(name) or INTREE_BUILDERS.get(name)
+            if builder is None:
+                continue
+            sp = builder(feats, self.plugin_args.get(name, {}))
+            filter_on = sp.filter_enabled and name not in self.filter_disabled
+            score_on = sp.score_enabled and name not in self.score_disabled
+            if name in self.point_only:
+                points = self.point_only[name]
+                filter_on = filter_on and "filter" in points
+                score_on = score_on and "score" in points
+            if not filter_on and not score_on:
+                continue
+            out.append(
+                ScoredPlugin(
+                    sp.plugin,
+                    weight=weight if weight > 0 else 1,
+                    filter_enabled=filter_on,
+                    score_enabled=score_on,
+                )
+            )
+        return tuple(out)
+
+
+def _merge_plugin_set(
+    defaults: Sequence[tuple[str, int]],
+    custom: dict | None,
+) -> list[tuple[str, int]]:
+    """Upstream mergePluginSet over (name, weight) lists."""
+    custom = custom or {}
+    disabled = {p.get("name") for p in custom.get("disabled") or []}
+    enabled_custom = custom.get("enabled") or []
+    overrides = {
+        p["name"]: int(p.get("weight") or 0)
+        for p in enabled_custom
+        if p.get("name")
+    }
+    merged: list[tuple[str, int]] = []
+    replaced: set[str] = set()
+    for name, weight in defaults:
+        if "*" in disabled or name in disabled:
+            continue
+        if name in overrides:
+            # Upstream replaces the default entry with the custom one
+            # wholesale; a nil weight then defaults to 1, NOT the
+            # default-profile weight.
+            merged.append((name, overrides[name] or 1))
+            replaced.add(name)
+        else:
+            merged.append((name, weight))
+    for p in enabled_custom:
+        name = p.get("name")
+        if name and name not in replaced:
+            merged.append((name, int(p.get("weight") or 0)))
+    return merged
+
+
+def compile_profile(
+    profile_cfg: dict | None = None,
+    *,
+    registry: dict[str, Builder] | None = None,
+) -> CompiledProfile:
+    """One KubeSchedulerProfile dict -> CompiledProfile.  Raises ValueError
+    on unknown enabled plugins (reference registry behavior) unless they
+    are upstream defaults without kernels (recorded in ``skipped``)."""
+    profile_cfg = profile_cfg or {}
+    registry = registry or {}
+    plugins_cfg = profile_cfg.get("plugins") or {}
+    merged = _merge_plugin_set(DEFAULT_MULTIPOINT, plugins_cfg.get("multiPoint"))
+
+    # Per-point sets act on ONE extension point: a disable drops the
+    # plugin at that point only; an enable adds it at that point only
+    # (upstream Plugins struct per-point PluginSets).  Kernel relevance is
+    # filter/score; other points are validated but structurally inert.
+    default_names = {n for n, _ in DEFAULT_MULTIPOINT}
+    filter_off: set[str] = set()
+    score_off: set[str] = set()
+    point_only: dict[str, set[str]] = {}
+    for point in ("preFilter", "filter", "postFilter", "preScore", "score",
+                  "reserve", "permit", "preBind", "bind", "postBind"):
+        point_cfg = plugins_cfg.get(point)
+        if not point_cfg:
+            continue
+        have = {n for n, _ in merged}
+        disabled_here = {p.get("name") for p in point_cfg.get("disabled") or []}
+        if point == "filter":
+            filter_off |= have if "*" in disabled_here else disabled_here
+        elif point == "score":
+            score_off |= have if "*" in disabled_here else disabled_here
+        for p in point_cfg.get("enabled") or []:
+            name = p.get("name")
+            if not name:
+                continue
+            if name not in have and name not in default_names:
+                if name not in registry and name not in INTREE_BUILDERS:
+                    raise ValueError(f"unknown plugin {name!r} enabled at {point}")
+            if name not in have:
+                merged.append((name, int(p.get("weight") or 0)))
+                have.add(name)
+                point_only[name] = set()
+            if name in point_only:
+                point_only[name].add(point)
+            elif point == "score" and p.get("weight"):
+                # Re-weighting an already-enabled plugin at the score point.
+                merged = [
+                    (n, int(p["weight"]) if n == name else w) for n, w in merged
+                ]
+
+    plugin_args: dict[str, dict] = {}
+    for pc in profile_cfg.get("pluginConfig") or []:
+        name = pc.get("name")
+        if name:
+            plugin_args[name] = dict(pc.get("args") or {})
+
+    skipped = tuple(
+        n
+        for n, _ in merged
+        if n not in INTREE_BUILDERS
+        and n not in (registry or {})
+        and n not in STRUCTURAL_PLUGINS
+    )
+    for name in skipped:
+        if name not in default_names:
+            raise ValueError(f"unknown plugin {name!r} in profile")
+        logger.warning("plugin %s has no kernel yet; skipping", name)
+
+    hard_weight = int(
+        plugin_args.get("InterPodAffinity", {}).get(
+            "hardPodAffinityWeight", DEFAULT_HARD_POD_AFFINITY_WEIGHT
+        )
+    )
+    return CompiledProfile(
+        scheduler_name=profile_cfg.get("schedulerName") or DEFAULT_SCHEDULER_NAME,
+        enabled=tuple(merged),
+        plugin_args=plugin_args,
+        skipped=skipped,
+        registry=dict(registry or {}),
+        hard_pod_affinity_weight=hard_weight,
+        filter_disabled=frozenset(filter_off),
+        score_disabled=frozenset(score_off),
+        point_only={k: frozenset(v) for k, v in point_only.items()},
+    )
+
+
+def compile_configuration(
+    cfg: dict | None,
+    *,
+    registry: dict[str, Builder] | None = None,
+) -> list[CompiledProfile]:
+    """KubeSchedulerConfiguration dict -> compiled profiles (defaulting to
+    one default-scheduler profile, reference scheduler.go:143-150)."""
+    cfg = cfg or {}
+    profiles = cfg.get("profiles") or [{}]
+    return [compile_profile(p, registry=registry) for p in profiles]

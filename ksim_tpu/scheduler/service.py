@@ -1,0 +1,239 @@
+"""The debuggable-scheduler loop over an in-memory cluster.
+
+The reference runs a real kube-scheduler whose wrapped plugins record
+results, then a store reflector copies them onto the Pod's annotations
+(reference simulator/scheduler/plugin/wrappedplugin.go,
+simulator/scheduler/storereflector/storereflector.go:78-146).  Here the
+whole cycle is one service over the ClusterStore:
+
+- watch pods/nodes; on relevant changes collect the pending queue
+  (no ``spec.nodeName``, non-terminal, matching schedulerName — upstream
+  only schedules pods addressed to one of its profiles);
+- sort by priority desc then creation/name (upstream PrioritySort
+  queue-sort semantics);
+- featurize the snapshot, run the Engine's sequential-commit scan;
+- for each pod, bind (set ``spec.nodeName``, phase Running — what KWOK's
+  fake kubelet would do in the reference topology, compose.yml
+  simulator-cluster) and write the 13 result annotations + result-history
+  (engine/annotations.py), exactly as the reflector does.
+
+Self-triggering guard: our own pod updates emit MODIFIED events; the run
+loop skips events whose resourceVersion we just wrote, so an unschedulable
+pod doesn't retrigger an identical cycle forever (the upstream analogue is
+the scheduling queue's backoff, not event-driven retry).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Sequence
+
+import copy
+
+from ksim_tpu.engine import Engine
+from ksim_tpu.engine.annotations import apply_results_to_pod, render_pod_results
+from ksim_tpu.engine.core import ScoredPlugin
+from ksim_tpu.scheduler.profile import (
+    DEFAULT_SCHEDULER_NAME,
+    Builder,
+    CompiledProfile,
+    compile_configuration,
+)
+from ksim_tpu.state.cluster import ClusterStore, WatchEvent
+from ksim_tpu.state.featurizer import FeaturizedSnapshot, Featurizer
+from ksim_tpu.state.resources import JSON, name_of, namespace_of
+
+logger = logging.getLogger(__name__)
+
+PluginsFactory = Callable[[FeaturizedSnapshot], Sequence[ScoredPlugin]]
+
+
+def queue_sort_key(pod: JSON):
+    """Upstream PrioritySort: priority desc, then creation time asc; name
+    breaks exact ties deterministically."""
+    prio = int(pod.get("spec", {}).get("priority") or 0)
+    created = pod.get("metadata", {}).get("creationTimestamp") or ""
+    return (-prio, created, namespace_of(pod), name_of(pod))
+
+
+class SchedulerService:
+    """Batch-evaluating scheduler bound to a ClusterStore."""
+
+    def __init__(
+        self,
+        store: ClusterStore,
+        *,
+        plugins_factory: PluginsFactory | None = None,
+        config: JSON | None = None,
+        registry: dict[str, Builder] | None = None,
+        record: str = "full",
+        featurizer: Featurizer | None = None,
+    ) -> None:
+        self._store = store
+        self._registry = registry or {}
+        self._record = record
+        # Direct-factory mode (library use) bypasses profile compilation.
+        self._plugins_factory = plugins_factory
+        self._featurizer_override = featurizer
+        self._initial_config = copy.deepcopy(config) or {}
+        self._config: JSON = {}
+        self._profiles: dict[str, CompiledProfile] = {}
+        self.apply_scheduler_config(copy.deepcopy(self._initial_config))
+        self._own_rvs: set[str] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- scheduler configuration (reference scheduler.go Service) -----------
+
+    def get_scheduler_config(self) -> JSON:
+        return copy.deepcopy(self._config)
+
+    def apply_scheduler_config(self, cfg: JSON) -> None:
+        """Compile-and-swap — the reference's RestartScheduler with
+        rollback (scheduler.go:90-111): a config that fails to compile
+        leaves the previous profiles in place and raises."""
+        profiles = compile_configuration(cfg, registry=self._registry)
+        self._profiles = {p.scheduler_name: p for p in profiles}
+        self._config = copy.deepcopy(cfg) or {}
+
+    def reset_scheduler_config(self) -> None:
+        """Back to the boot-time config (reference di.go initial cfg)."""
+        self.apply_scheduler_config(copy.deepcopy(self._initial_config))
+
+    @property
+    def _scheduler_names(self) -> tuple[str, ...]:
+        if self._plugins_factory is not None:
+            return (DEFAULT_SCHEDULER_NAME,)
+        return tuple(self._profiles)
+
+    # -- queue --------------------------------------------------------------
+
+    def _is_pending(self, pod: JSON) -> bool:
+        if pod.get("spec", {}).get("nodeName"):
+            return False
+        if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+            return False
+        name = pod.get("spec", {}).get("schedulerName") or DEFAULT_SCHEDULER_NAME
+        return name in self._scheduler_names
+
+    def pending_pods(self) -> list[JSON]:
+        return sorted(
+            (p for p in self._store.list("pods") if self._is_pending(p)),
+            key=queue_sort_key,
+        )
+
+    # -- one scheduling pass ------------------------------------------------
+
+    def schedule_pending(self) -> dict[str, str | None]:
+        """Schedule every pending pod once (per profile group); returns
+        namespace/name -> node name (None = unschedulable this pass).
+        Results are recorded on the pods' annotations either way (the
+        reference records every attempt; history accumulates)."""
+        nodes = self._store.list("nodes")
+        namespaces = self._store.list("namespaces")
+        if not nodes:
+            return {}
+        placements: dict[str, str | None] = {}
+        for sched_name in self._scheduler_names:
+            # Fresh pod snapshot per profile: earlier profiles' bindings
+            # must charge their nodes before the next profile evaluates.
+            pods = self._store.list("pods")
+            queue = [
+                p
+                for p in pods
+                if self._is_pending(p)
+                and (p.get("spec", {}).get("schedulerName") or DEFAULT_SCHEDULER_NAME)
+                == sched_name
+            ]
+            if not queue:
+                continue
+            queue.sort(key=queue_sort_key)
+            if self._plugins_factory is not None:
+                featurizer = self._featurizer_override or Featurizer()
+                factory: PluginsFactory = self._plugins_factory
+            else:
+                prof = self._profiles[sched_name]
+                featurizer = self._featurizer_override or prof.featurizer()
+                factory = prof.plugins
+            feats = featurizer.featurize(
+                nodes, pods, queue_pods=queue, namespaces=namespaces
+            )
+            plugins = tuple(factory(feats))
+            eng = Engine(feats, plugins, record=self._record)
+            res, _state = eng.schedule()
+            self._bind_results(queue, feats, plugins, res, placements)
+        return placements
+
+    def _bind_results(self, queue, feats, plugins, res, placements) -> None:
+        for j, pod in enumerate(queue):
+            sel = int(res.selected[j])
+            node_name = feats.nodes.names[sel] if sel >= 0 else None
+            anno = (
+                render_pod_results(feats, plugins, res, j)
+                if self._record == "full"
+                else {}
+            )
+
+            def mutate(obj: JSON) -> None:
+                annos = obj.setdefault("metadata", {}).setdefault("annotations", {})
+                if anno:
+                    apply_results_to_pod(annos, anno)
+                if node_name:
+                    obj.setdefault("spec", {})["nodeName"] = node_name
+                    obj.setdefault("status", {})["phase"] = "Running"
+
+            updated = self._store.patch(
+                "pods", name_of(pod), namespace_of(pod), mutate
+            )
+            self._own_rvs.add(updated["metadata"]["resourceVersion"])
+            placements[f"{namespace_of(pod)}/{name_of(pod)}"] = node_name
+
+    # -- watch loop ---------------------------------------------------------
+
+    def start(self) -> "SchedulerService":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _relevant(self, ev: WatchEvent) -> bool:
+        if ev.kind == "nodes":
+            return True
+        if ev.kind != "pods":
+            return False
+        rv = ev.obj.get("metadata", {}).get("resourceVersion")
+        if rv in self._own_rvs:
+            self._own_rvs.discard(rv)
+            return False
+        # A delete frees capacity; an add/update may need scheduling.
+        return True
+
+    def _run(self) -> None:
+        stream = self._store.watch(("pods", "nodes"))
+        try:
+            self.schedule_pending()
+            while not self._stop.is_set():
+                ev = stream.next(timeout=0.1)
+                if ev is None:
+                    continue
+                if not self._relevant(ev):
+                    continue
+                # Drain whatever queued behind this event before one pass.
+                while True:
+                    nxt = stream.next(timeout=0.02)
+                    if nxt is None:
+                        break
+                    self._relevant(nxt)
+                try:
+                    self.schedule_pending()
+                except Exception:  # pragma: no cover - keep the loop alive
+                    logger.exception("scheduling pass failed")
+        finally:
+            stream.close()

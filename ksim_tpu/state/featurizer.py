@@ -129,9 +129,20 @@ def _gcd_unit(values: Sequence[int]) -> int:
 class Featurizer:
     """Lower a snapshot (lists of pod/node JSON objects) to tensors."""
 
-    def __init__(self, *, node_bucket_min: int = 8, pod_bucket_min: int = 8) -> None:
+    def __init__(
+        self,
+        *,
+        node_bucket_min: int = 8,
+        pod_bucket_min: int = 8,
+        interpod_hard_weight: int | None = None,
+    ) -> None:
+        if interpod_hard_weight is None:
+            from ksim_tpu.state.interpod import DEFAULT_HARD_POD_AFFINITY_WEIGHT
+
+            interpod_hard_weight = DEFAULT_HARD_POD_AFFINITY_WEIGHT
         self._node_bucket_min = node_bucket_min
         self._pod_bucket_min = pod_bucket_min
+        self._interpod_hard_weight = interpod_hard_weight
 
     def featurize(
         self,
@@ -265,7 +276,8 @@ class Featurizer:
             "taints": encode_taints(nodes, sched_pods, NP, PP),
             "spread": encode_topology_spread(nodes, sched_pods, bound_pods, NP, PP),
             "interpod": encode_inter_pod(
-                nodes, sched_pods, bound_pods, namespaces, NP, PP
+                nodes, sched_pods, bound_pods, namespaces, NP, PP,
+                hard_weight=self._interpod_hard_weight,
             ),
         }
 

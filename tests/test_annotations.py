@@ -1,0 +1,104 @@
+"""Result-annotation rendering: the reference's 13-key contract."""
+
+import json
+
+from ksim_tpu.engine import Engine
+from ksim_tpu.engine.annotations import (
+    ALL_RESULT_KEYS,
+    BIND_RESULT_KEY,
+    FILTER_RESULT_KEY,
+    FINAL_SCORE_RESULT_KEY,
+    RESULT_HISTORY_KEY,
+    SCORE_RESULT_KEY,
+    SELECTED_NODE_KEY,
+    apply_results_to_pod,
+    render_pod_results,
+    update_result_history,
+)
+from ksim_tpu.engine.profiles import default_plugins
+from ksim_tpu.state.featurizer import Featurizer
+from tests.helpers import make_node, make_pod
+
+
+def run(nodes, bound, queue):
+    feats = Featurizer().featurize(nodes, bound, queue_pods=queue)
+    plugins = default_plugins(feats)
+    eng = Engine(feats, plugins, record="full")
+    return feats, plugins, eng.evaluate_batch()
+
+
+def test_all_keys_present_and_json():
+    nodes = [make_node("n1"), make_node("n2")]
+    feats, plugins, res = run(nodes, [], [make_pod("p")])
+    anno = render_pod_results(feats, plugins, res, 0)
+    for key in ALL_RESULT_KEYS:
+        assert key in anno, key
+    for key, val in anno.items():
+        if key != SELECTED_NODE_KEY:
+            json.loads(val)  # every value is valid JSON
+
+
+def test_filter_result_passed_and_early_exit():
+    # n2 is cordoned: NodeUnschedulable (first filter) rejects, later
+    # filters must have NO entry for n2 (upstream early exit).
+    nodes = [make_node("n1"), make_node("n2", unschedulable=True)]
+    feats, plugins, res = run(nodes, [], [make_pod("p", cpu="100m")])
+    anno = render_pod_results(feats, plugins, res, 0)
+    fm = json.loads(anno[FILTER_RESULT_KEY])
+    assert fm["n1"]["NodeUnschedulable"] == "passed"
+    assert fm["n1"]["NodeResourcesFit"] == "passed"
+    assert fm["n2"]["NodeUnschedulable"] == "node(s) were unschedulable"
+    assert list(fm["n2"].keys()) == ["NodeUnschedulable"]
+
+
+def test_scores_only_on_feasible_nodes():
+    nodes = [make_node("big", cpu="8"), make_node("tiny", cpu="100m")]
+    feats, plugins, res = run(nodes, [], [make_pod("p", cpu="2")])
+    anno = render_pod_results(feats, plugins, res, 0)
+    sm = json.loads(anno[SCORE_RESULT_KEY])
+    assert "big" in sm and "tiny" not in sm
+    fm = json.loads(anno[FINAL_SCORE_RESULT_KEY])
+    # finalscore = normalized x weight: TaintToleration weight 3, all nodes
+    # taintless -> normalized 100 -> 300.
+    assert fm["big"]["TaintToleration"] == "300"
+    assert anno[SELECTED_NODE_KEY] == "big"
+    assert json.loads(anno[BIND_RESULT_KEY]) == {"DefaultBinder": "success"}
+
+
+def test_unschedulable_pod_has_no_selected_node():
+    nodes = [make_node("tiny", cpu="100m")]
+    feats, plugins, res = run(nodes, [], [make_pod("p", cpu="4")])
+    anno = render_pod_results(feats, plugins, res, 0)
+    assert SELECTED_NODE_KEY not in anno
+    assert json.loads(anno[BIND_RESULT_KEY]) == {}
+    assert json.loads(anno[SCORE_RESULT_KEY]) == {}
+
+
+def test_multi_reason_message_joined():
+    nodes = [make_node("small", cpu="1", memory="1Gi", pods=1)]
+    bound = [make_pod("b", cpu="500m", memory="512Mi", node_name="small")]
+    feats, plugins, res = run(nodes, bound, [make_pod("big", cpu="2", memory="2Gi")])
+    anno = render_pod_results(feats, plugins, res, 0)
+    fm = json.loads(anno[FILTER_RESULT_KEY])
+    assert fm["small"]["NodeResourcesFit"] == (
+        "Too many pods, Insufficient cpu, Insufficient memory"
+    )
+
+
+def test_result_history_appends():
+    anno = {}
+    update_result_history(anno, {"a": "1"})
+    update_result_history(anno, {"b": "2"})
+    assert json.loads(anno[RESULT_HISTORY_KEY]) == [{"a": "1"}, {"b": "2"}]
+
+
+def test_apply_results_merges_and_records_history():
+    nodes = [make_node("n1")]
+    feats, plugins, res = run(nodes, [], [make_pod("p")])
+    result = render_pod_results(feats, plugins, res, 0)
+    pod_anno = {"user-key": "untouched"}
+    apply_results_to_pod(pod_anno, result)
+    assert pod_anno["user-key"] == "untouched"
+    assert pod_anno[SELECTED_NODE_KEY] == "n1"
+    hist = json.loads(pod_anno[RESULT_HISTORY_KEY])
+    assert len(hist) == 1 and hist[0][SELECTED_NODE_KEY] == "n1"

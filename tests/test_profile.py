@@ -1,0 +1,149 @@
+"""Profile compilation: KubeSchedulerConfiguration -> kernel sets."""
+
+import pytest
+
+from ksim_tpu.engine.core import ScoredPlugin
+from ksim_tpu.scheduler import SchedulerService
+from ksim_tpu.scheduler.profile import (
+    compile_configuration,
+    compile_profile,
+)
+from ksim_tpu.state.cluster import ClusterStore
+from ksim_tpu.state.featurizer import Featurizer
+from tests.helpers import make_node, make_pod
+
+
+def names(profile):
+    return [n for n, _ in profile.enabled]
+
+
+def test_default_profile_matches_upstream_multipoint():
+    prof = compile_profile()
+    assert prof.scheduler_name == "default-scheduler"
+    got = dict(prof.enabled)
+    assert got["TaintToleration"] == 3
+    assert got["NodeAffinity"] == 2
+    assert got["PodTopologySpread"] == 2
+    assert got["InterPodAffinity"] == 2
+    assert got["NodeResourcesFit"] == 1
+    # Unimplemented volume family surfaces as skipped, not an error.
+    assert "VolumeBinding" in prof.skipped
+
+
+def test_disable_and_reweight():
+    prof = compile_profile({
+        "plugins": {"multiPoint": {
+            "disabled": [{"name": "InterPodAffinity"}],
+            "enabled": [{"name": "TaintToleration", "weight": 9}],
+        }},
+    })
+    got = dict(prof.enabled)
+    assert "InterPodAffinity" not in got
+    assert got["TaintToleration"] == 9
+
+
+def test_disable_star_drops_all_defaults():
+    prof = compile_profile({
+        "plugins": {"multiPoint": {
+            "disabled": [{"name": "*"}],
+            "enabled": [{"name": "NodeResourcesFit", "weight": 5}],
+        }},
+    })
+    assert prof.enabled == (("NodeResourcesFit", 5),)
+
+
+def test_unknown_plugin_rejected():
+    with pytest.raises(ValueError, match="unknown plugin"):
+        compile_profile({
+            "plugins": {"score": {"enabled": [{"name": "NoSuchPlugin"}]}},
+        })
+
+
+def test_plugin_args_threaded():
+    prof = compile_profile({
+        "pluginConfig": [
+            {"name": "InterPodAffinity", "args": {"hardPodAffinityWeight": 7}},
+            {"name": "NodeResourcesFit", "args": {"scoringStrategy": {
+                "type": "LeastAllocated",
+                "resources": [{"name": "cpu", "weight": 3}],
+            }}},
+        ],
+    })
+    assert prof.hard_pod_affinity_weight == 7
+    feats = Featurizer().featurize([make_node("n")], [], queue_pods=[make_pod("p")])
+    plugins = prof.plugins(feats)
+    by_name = {sp.plugin.name: sp for sp in plugins}
+    assert "NodeResourcesFit" in by_name
+    assert by_name["TaintToleration"].weight == 3
+
+
+def test_multi_profile_configuration():
+    profs = compile_configuration({
+        "profiles": [
+            {"schedulerName": "default-scheduler"},
+            {"schedulerName": "gpu-sched", "plugins": {"multiPoint": {
+                "disabled": [{"name": "PodTopologySpread"}]}}},
+        ],
+    })
+    assert [p.scheduler_name for p in profs] == ["default-scheduler", "gpu-sched"]
+    assert "PodTopologySpread" not in names(profs[1])
+
+
+def test_service_config_apply_and_rollback():
+    store = ClusterStore()
+    store.create("nodes", make_node("n1"))
+    svc = SchedulerService(store)
+    assert svc.get_scheduler_config() == {}
+    good = {"profiles": [{"plugins": {"multiPoint": {
+        "disabled": [{"name": "InterPodAffinity"}]}}}]}
+    svc.apply_scheduler_config(good)
+    assert svc.get_scheduler_config() == good
+    bad = {"profiles": [{"plugins": {"score": {
+        "enabled": [{"name": "Bogus"}]}}}]}
+    with pytest.raises(ValueError):
+        svc.apply_scheduler_config(bad)
+    # Rollback: previous config still active.
+    assert svc.get_scheduler_config() == good
+    svc.reset_scheduler_config()
+    assert svc.get_scheduler_config() == {}
+
+
+def test_service_schedules_by_profile_name():
+    store = ClusterStore()
+    store.create("nodes", make_node("n1"))
+    pod_default = make_pod("p-default")
+    pod_gpu = make_pod("p-gpu")
+    pod_gpu["spec"]["schedulerName"] = "gpu-sched"
+    store.create("pods", pod_default)
+    store.create("pods", pod_gpu)
+    svc = SchedulerService(store, config={
+        "profiles": [
+            {"schedulerName": "default-scheduler"},
+            {"schedulerName": "gpu-sched"},
+        ],
+    })
+    placements = svc.schedule_pending()
+    assert placements == {"default/p-default": "n1", "default/p-gpu": "n1"}
+
+
+def test_out_of_tree_registry():
+    # The WithPlugin analogue: a custom score kernel registered by name.
+    class ConstantScore:
+        name = "ConstantScore"
+
+        def score(self, state, pod, aux, ok=None):
+            import jax.numpy as jnp
+
+            return jnp.full(state.valid.shape[0], 7, dtype=jnp.int32)
+
+    def build(feats, args):
+        return ScoredPlugin(ConstantScore(), filter_enabled=False)
+
+    prof = compile_profile(
+        {"plugins": {"score": {"enabled": [{"name": "ConstantScore", "weight": 2}]}}},
+        registry={"ConstantScore": build},
+    )
+    assert ("ConstantScore", 2) in prof.enabled
+    feats = Featurizer().featurize([make_node("n")], [], queue_pods=[make_pod("p")])
+    plugins = prof.plugins(feats)
+    assert any(sp.plugin.name == "ConstantScore" for sp in plugins)

@@ -1,0 +1,96 @@
+"""SchedulerService: bind + annotate loop over the ClusterStore."""
+
+import json
+import time
+
+from ksim_tpu.engine.annotations import (
+    RESULT_HISTORY_KEY,
+    SELECTED_NODE_KEY,
+)
+from ksim_tpu.scheduler import SchedulerService
+from ksim_tpu.state.cluster import ClusterStore
+from tests.helpers import make_node, make_pod
+
+
+def make_store(nodes=(), pods=()):
+    store = ClusterStore()
+    for n in nodes:
+        store.create("nodes", n)
+    for p in pods:
+        store.create("pods", p)
+    return store
+
+
+def test_schedule_pending_binds_and_annotates():
+    store = make_store([make_node("n1"), make_node("n2")], [make_pod("p1"), make_pod("p2")])
+    svc = SchedulerService(store)
+    placements = svc.schedule_pending()
+    assert set(placements) == {"default/p1", "default/p2"}
+    for key, node in placements.items():
+        assert node in ("n1", "n2")
+    p1 = store.get("pods", "p1", "default")
+    assert p1["spec"]["nodeName"] == placements["default/p1"]
+    assert p1["status"]["phase"] == "Running"
+    annos = p1["metadata"]["annotations"]
+    assert annos[SELECTED_NODE_KEY] == placements["default/p1"]
+    assert len(json.loads(annos[RESULT_HISTORY_KEY])) == 1
+
+
+def test_priority_order_wins_contended_capacity():
+    # One slot; the high-priority pod (created later) must get it.
+    store = make_store(
+        [make_node("n1", cpu="1", memory="1Gi")],
+        [
+            make_pod("low", cpu="800m", priority=1),
+            make_pod("high", cpu="800m", priority=100),
+        ],
+    )
+    placements = SchedulerService(store).schedule_pending()
+    assert placements["default/high"] == "n1"
+    assert placements["default/low"] is None
+    low = store.get("pods", "low", "default")
+    assert "nodeName" not in low["spec"]
+    # Unschedulable attempt still recorded.
+    assert RESULT_HISTORY_KEY in low["metadata"]["annotations"]
+
+
+def test_retry_history_accumulates():
+    store = make_store([make_node("tiny", cpu="100m")], [make_pod("big", cpu="2")])
+    svc = SchedulerService(store)
+    assert svc.schedule_pending()["default/big"] is None
+    assert svc.schedule_pending()["default/big"] is None
+    annos = store.get("pods", "big", "default")["metadata"]["annotations"]
+    assert len(json.loads(annos[RESULT_HISTORY_KEY])) == 2
+
+
+def test_foreign_scheduler_name_ignored():
+    pod = make_pod("other")
+    pod["spec"]["schedulerName"] = "my-custom-scheduler"
+    store = make_store([make_node("n1")], [pod])
+    assert SchedulerService(store).schedule_pending() == {}
+
+
+def test_watch_loop_schedules_new_pods_and_reacts_to_new_nodes():
+    store = make_store([make_node("tiny", cpu="100m")])
+    svc = SchedulerService(store).start()
+    try:
+        store.create("pods", make_pod("big", cpu="2"))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            annos = store.get("pods", "big", "default")["metadata"].get("annotations", {})
+            if RESULT_HISTORY_KEY in annos:
+                break
+            time.sleep(0.05)
+        assert RESULT_HISTORY_KEY in annos  # attempted, unschedulable
+        assert "nodeName" not in store.get("pods", "big", "default")["spec"]
+        # Capacity arrives: the loop reschedules and binds.
+        store.create("nodes", make_node("roomy", cpu="8"))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            pod = store.get("pods", "big", "default")
+            if pod["spec"].get("nodeName"):
+                break
+            time.sleep(0.05)
+        assert pod["spec"].get("nodeName") == "roomy"
+    finally:
+        svc.stop()
