@@ -140,9 +140,15 @@ def render_pod_results(
         if ok:
             feasible_nodes.append(ni)
 
+    # Upstream schedulePod returns right after filtering when exactly one
+    # node is feasible (schedule_one.go findNodesThatFitPod early return):
+    # PreScore/Score/NormalizeScore never run, so the reference records
+    # empty score maps.  Zero feasible nodes goes to PostFilter, likewise
+    # without scoring.
+    ran_scoring = len(feasible_nodes) > 1
     score_map: dict[str, dict[str, str]] = {}
     final_map: dict[str, dict[str, str]] = {}
-    if res.scores is not None and score_plugins:
+    if res.scores is not None and score_plugins and ran_scoring:
         for ni in feasible_nodes:
             node = node_names[ni]
             score_map[node] = {
@@ -159,11 +165,15 @@ def render_pod_results(
         for sp in filter_plugins
         if sp.plugin.name in UPSTREAM_PRE_FILTER
     }
-    prescore = {
-        sp.plugin.name: SUCCESS_MESSAGE
-        for sp in score_plugins
-        if sp.plugin.name in UPSTREAM_PRE_SCORE
-    }
+    prescore = (
+        {
+            sp.plugin.name: SUCCESS_MESSAGE
+            for sp in score_plugins
+            if sp.plugin.name in UPSTREAM_PRE_SCORE
+        }
+        if ran_scoring
+        else {}
+    )
 
     selected = int(res.selected[pi])
     out = {

@@ -25,16 +25,20 @@ def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
     """Upstream default-profile weights: BalancedAllocation 1, Fit 1,
     NodeAffinity 2, PodTopologySpread 2, InterPodAffinity 2,
     TaintToleration 3 (default_plugins.go)."""
+    # Filter order follows upstream MultiPoint registration order
+    # (default_plugins.go): NodeUnschedulable, TaintToleration,
+    # NodeAffinity, NodeResourcesFit, PodTopologySpread, InterPodAffinity —
+    # early-exit filter-result recording depends on it.
     return (
         ScoredPlugin(NodeUnschedulable(), score_enabled=False),
+        ScoredPlugin(TaintToleration(feats.aux["taints"]), weight=3),
+        ScoredPlugin(NodeAffinity(), weight=2),
         ScoredPlugin(NodeResourcesFit(feats.resources), weight=1),
         ScoredPlugin(
             NodeResourcesBalancedAllocation(feats.resources),
             weight=1,
             filter_enabled=False,
         ),
-        ScoredPlugin(TaintToleration(feats.aux["taints"]), weight=3),
-        ScoredPlugin(NodeAffinity(), weight=2),
         ScoredPlugin(PodTopologySpread(feats.aux["spread"]), weight=2),
         ScoredPlugin(InterPodAffinity(feats.aux["interpod"]), weight=2),
     )

@@ -114,8 +114,21 @@ class InterPodAffinity:
 
         # (1) required affinity: all topology keys present AND every term's
         # domain count > 0 — or the global-empty + self-match escape.
+        # Upstream keys affinityCounts by topologyPair (key, value) SHARED
+        # across all of the pod's required terms (filtering.go
+        # topologyToMatchedTermCount.update): two required terms with the
+        # same topologyKey read one combined count, so a domain satisfying
+        # either term satisfies both.  Aggregate this pod's per-term counts
+        # over terms sharing a topology key before the <=0 check.
         missing_any = jnp.dot((dom_t < 0).astype(i32), raff) > 0  # [N]
-        no_pods_any = jnp.dot((cnt <= 0).astype(i32), raff) > 0
+        n_tk = a["node_dom"].shape[1]
+        tk_onehot = (
+            a["term_tk"][:, None] == jnp.arange(n_tk, dtype=a["term_tk"].dtype)[None, :]
+        ).astype(i32)  # [T, TK]
+        cnt_req = cnt * raff[None, :]  # this pod's required terms only
+        key_cnt = cnt_req @ tk_onehot  # [N, TK] per-key totals
+        need_key = (raff @ tk_onehot) > 0  # [TK] keys with required terms
+        no_pods_any = jnp.any((key_cnt <= 0) & need_key[None, :], axis=1)
         total_t = jnp.sum(jnp.where(dom_t >= 0, mc_t, 0), axis=0)  # [T]
         escape = (jnp.dot(total_t, raff) == 0) & a["self_aff"][j]
         pass_aff = ~missing_any & (~no_pods_any | escape)

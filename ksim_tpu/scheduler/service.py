@@ -48,6 +48,10 @@ logger = logging.getLogger(__name__)
 
 PluginsFactory = Callable[[FeaturizedSnapshot], Sequence[ScoredPlugin]]
 
+# Self-triggered-event suppression set cap (resourceVersions are numeric
+# strings from ClusterStore; keep the newest).
+_OWN_RV_LIMIT = 4096
+
 
 def queue_sort_key(pod: JSON):
     """Upstream PrioritySort: priority desc, then creation time asc; name
@@ -81,6 +85,7 @@ class SchedulerService:
         self._profiles: dict[str, CompiledProfile] = {}
         self.apply_scheduler_config(copy.deepcopy(self._initial_config))
         self._own_rvs: set[str] = set()
+        self._own_rvs_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -163,6 +168,15 @@ class SchedulerService:
             eng = Engine(feats, plugins, record=self._record)
             res, _state = eng.schedule()
             self._bind_results(queue, feats, plugins, res, placements)
+        # Bound _own_rvs growth for library use (schedule_pending without
+        # the watch loop draining events).  The limit scales with the pass
+        # size so one large pass never trims its own still-queued events
+        # out of the suppression set (that would retrigger endless passes).
+        with self._own_rvs_lock:
+            limit = max(_OWN_RV_LIMIT, 2 * len(placements))
+            if len(self._own_rvs) > limit:
+                for rv in sorted(self._own_rvs, key=int)[:-limit]:
+                    self._own_rvs.discard(rv)
         return placements
 
     def _bind_results(self, queue, feats, plugins, res, placements) -> None:
@@ -186,7 +200,8 @@ class SchedulerService:
             updated = self._store.patch(
                 "pods", name_of(pod), namespace_of(pod), mutate
             )
-            self._own_rvs.add(updated["metadata"]["resourceVersion"])
+            with self._own_rvs_lock:
+                self._own_rvs.add(updated["metadata"]["resourceVersion"])
             placements[f"{namespace_of(pod)}/{name_of(pod)}"] = node_name
 
     # -- watch loop ---------------------------------------------------------
@@ -209,9 +224,10 @@ class SchedulerService:
         if ev.kind != "pods":
             return False
         rv = ev.obj.get("metadata", {}).get("resourceVersion")
-        if rv in self._own_rvs:
-            self._own_rvs.discard(rv)
-            return False
+        with self._own_rvs_lock:
+            if rv in self._own_rvs:
+                self._own_rvs.discard(rv)
+                return False
         # A delete frees capacity; an add/update may need scheduling.
         return True
 

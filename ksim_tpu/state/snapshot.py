@@ -119,7 +119,10 @@ class SnapshotService:
             and not ignore_scheduler_configuration
             and self._scheduler_service is not None
         ):
-            self._scheduler_service.restart_scheduler(cfg)
+            # apply_scheduler_config is the restart analogue: compile-and-
+            # swap with rollback (reference snapshot.go:202-219 calls
+            # RestartScheduler after load).
+            self._scheduler_service.apply_scheduler_config(cfg)
 
     def _fix_claim_ref(self, pv: JSON) -> JSON:
         """Re-resolve a Bound PV's claimRef UID to the freshly-loaded PVC —

@@ -8,6 +8,7 @@ from ksim_tpu.engine.annotations import (
     BIND_RESULT_KEY,
     FILTER_RESULT_KEY,
     FINAL_SCORE_RESULT_KEY,
+    PRE_SCORE_RESULT_KEY,
     RESULT_HISTORY_KEY,
     SCORE_RESULT_KEY,
     SELECTED_NODE_KEY,
@@ -52,15 +53,33 @@ def test_filter_result_passed_and_early_exit():
 
 
 def test_scores_only_on_feasible_nodes():
-    nodes = [make_node("big", cpu="8"), make_node("tiny", cpu="100m")]
+    nodes = [
+        make_node("big", cpu="8"),
+        make_node("big2", cpu="8"),
+        make_node("tiny", cpu="100m"),
+    ]
     feats, plugins, res = run(nodes, [], [make_pod("p", cpu="2")])
     anno = render_pod_results(feats, plugins, res, 0)
     sm = json.loads(anno[SCORE_RESULT_KEY])
-    assert "big" in sm and "tiny" not in sm
+    assert "big" in sm and "big2" in sm and "tiny" not in sm
     fm = json.loads(anno[FINAL_SCORE_RESULT_KEY])
     # finalscore = normalized x weight: TaintToleration weight 3, all nodes
     # taintless -> normalized 100 -> 300.
     assert fm["big"]["TaintToleration"] == "300"
+    assert anno[SELECTED_NODE_KEY] == "big"
+    assert json.loads(anno[BIND_RESULT_KEY]) == {"DefaultBinder": "success"}
+
+
+def test_one_feasible_node_skips_scoring():
+    # Upstream schedulePod early-returns when exactly one node passes
+    # filtering: Score/PreScore never run, the recorded maps are empty,
+    # but the pod is still bound to that node.
+    nodes = [make_node("big", cpu="8"), make_node("tiny", cpu="100m")]
+    feats, plugins, res = run(nodes, [], [make_pod("p", cpu="2")])
+    anno = render_pod_results(feats, plugins, res, 0)
+    assert json.loads(anno[SCORE_RESULT_KEY]) == {}
+    assert json.loads(anno[FINAL_SCORE_RESULT_KEY]) == {}
+    assert json.loads(anno[PRE_SCORE_RESULT_KEY]) == {}
     assert anno[SELECTED_NODE_KEY] == "big"
     assert json.loads(anno[BIND_RESULT_KEY]) == {"DefaultBinder": "success"}
 

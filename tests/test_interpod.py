@@ -240,3 +240,50 @@ def test_sequential_affinity_follows_first_placement():
     zones = {feats.nodes.names[int(s)][0] for s in res.selected[:3]}
     assert len(zones) == 1  # all in one zone
     assert all(int(s) >= 0 for s in res.selected[:3])
+
+
+def test_required_terms_sharing_topology_key_share_counts():
+    # Upstream keys affinityCounts by topologyPair shared across ALL of the
+    # pod's required terms (filtering.go topologyToMatchedTermCount): with
+    # two required terms on the same topologyKey, a domain with pods
+    # matching only ONE term still satisfies both checks (the shared
+    # (key, value) count is > 0).  Advisor round-1 high finding.
+    zone = "topology.kubernetes.io/zone"
+    nodes = [make_node("n0", labels={zone: "za"})]
+    existing = make_pod("db0", labels={"app": "db"}, node_name="n0")
+    aff = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+        {"labelSelector": {"matchLabels": {"app": "db"}}, "topologyKey": zone},
+        {"labelSelector": {"matchLabels": {"tier": "cache"}}, "topologyKey": zone},
+    ]}}
+    q = make_pod("q", labels={"app": "web"}, affinity=aff)
+    feats, res = run_batch(nodes, [existing], [q])
+    ipa = InterPodAffinity(feats.aux["interpod"])
+    f_i = res.filter_plugin_names.index("InterPodAffinity")
+    got = ipa.decode_reasons(int(res.reason_bits[0, f_i, 0]))
+    infos = oracle.build_node_infos(nodes, [existing])
+    want = oracle.inter_pod_affinity_filter_all(q, infos, pods_by_node([existing]))
+    assert want[0] == []  # oracle (upstream) accepts
+    assert got == want[0]
+
+
+def test_required_terms_distinct_topology_keys_stay_independent():
+    # Terms on DIFFERENT topology keys must still be checked independently:
+    # a domain satisfying the zone term does not satisfy a hostname term
+    # with no matching pods.
+    zone = "topology.kubernetes.io/zone"
+    host = "kubernetes.io/hostname"
+    nodes = [make_node("n0", labels={zone: "za", host: "n0"})]
+    existing = make_pod("db0", labels={"app": "db"}, node_name="n0")
+    aff = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+        {"labelSelector": {"matchLabels": {"app": "db"}}, "topologyKey": zone},
+        {"labelSelector": {"matchLabels": {"tier": "cache"}}, "topologyKey": host},
+    ]}}
+    q = make_pod("q", labels={"app": "web"}, affinity=aff)
+    feats, res = run_batch(nodes, [existing], [q])
+    ipa = InterPodAffinity(feats.aux["interpod"])
+    f_i = res.filter_plugin_names.index("InterPodAffinity")
+    got = ipa.decode_reasons(int(res.reason_bits[0, f_i, 0]))
+    infos = oracle.build_node_infos(nodes, [existing])
+    want = oracle.inter_pod_affinity_filter_all(q, infos, pods_by_node([existing]))
+    assert want[0] == ["node(s) didn't match pod affinity rules"]
+    assert got == want[0]
