@@ -268,11 +268,37 @@ class Engine:
 
         return jax.vmap(per_pod)(pods)
 
-    def evaluate_batch(self) -> EngineResult:
-        """All pods x nodes against the fixed snapshot (no state commit)."""
-        return self._to_result(
-            self._batch_fn(self._node_state, self._pods, self._aux, self._init_carries())
+    def evaluate_batch_chunks(self, *, chunk: int | None = None):
+        """Yield (start, device_out) per pod chunk — the streaming form of
+        ``evaluate_batch``.  Each ``device_out`` is the device-resident
+        result pytree for pods [start, start+chunk); callers decode or
+        transfer it before the next iteration if they want bounded device
+        memory (record="full" at 16k x 8k is ~9GB of result tensors —
+        far more than it costs to recompute, so nothing is retained)."""
+        P = int(self._pods.valid.shape[0])
+        if chunk is None:
+            chunk = min(P, self.SCHEDULE_CHUNK)
+        carries = self._init_carries()
+        for s in range(0, P, chunk):
+            pods_c = jax.tree_util.tree_map(
+                lambda x: x[s : s + chunk], self._pods
+            )
+            yield s, self._batch_fn(self._node_state, pods_c, self._aux, carries)
+
+    def evaluate_batch(self, *, chunk: int | None = None) -> EngineResult:
+        """All pods x nodes against the fixed snapshot (no state commit).
+
+        Pod-chunked like ``schedule`` so the recorded result tensors
+        ([P, plugins, N] in record="full") never exceed one chunk's worth
+        of device memory; chunks stream to host and concatenate."""
+        outs = [
+            jax.tree_util.tree_map(np.asarray, out)
+            for _s, out in self.evaluate_batch_chunks(chunk=chunk)
+        ]
+        merged = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *outs
         )
+        return self._to_result(merged)
 
     # -- sequential scheduling (lax.scan with commit) ----------------------
 
@@ -299,14 +325,36 @@ class Engine:
         (final_state, final_carries), out = jax.lax.scan(body, (state, carries), pods)
         return final_state, final_carries, out
 
-    def schedule(self) -> tuple[EngineResult, NodeStateView]:
+    # Default pod-axis chunk for the sequential scan.  One device program
+    # per chunk bounds both the compiled scan length and the live result
+    # buffers (full [P,*,N] stacks at 16k x 8k exceed a v5e chip); the
+    # carries thread through unchanged so chunking is semantically
+    # invisible.
+    SCHEDULE_CHUNK = 2048
+
+    def schedule(self, *, chunk: int | None = None) -> tuple[EngineResult, NodeStateView]:
         """Greedy sequential scheduling of the pod queue with capacity
         commit; pod order is queue order (upstream pops by priority —
-        callers sort the queue before featurizing)."""
-        state, _carries, out = self._schedule_fn(
-            self._node_state, self._pods, self._aux, self._init_carries()
+        callers sort the queue before featurizing).
+
+        The scan runs in ``chunk``-sized pod segments (host loop, one
+        compiled program reused across segments); results are concatenated
+        host-side."""
+        P = int(self._pods.valid.shape[0])
+        if chunk is None:
+            chunk = min(P, self.SCHEDULE_CHUNK)
+        state, carries = self._node_state, self._init_carries()
+        outs = []
+        for s in range(0, P, chunk):
+            pods_c = jax.tree_util.tree_map(
+                lambda x: x[s : s + chunk], self._pods
+            )
+            state, carries, out = self._schedule_fn(state, pods_c, self._aux, carries)
+            outs.append(jax.tree_util.tree_map(np.asarray, out))
+        merged = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *outs
         )
-        return self._to_result(out), jax.tree_util.tree_map(np.asarray, state)
+        return self._to_result(merged), jax.tree_util.tree_map(np.asarray, state)
 
     # -- decode -------------------------------------------------------------
 

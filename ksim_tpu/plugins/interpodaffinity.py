@@ -21,11 +21,14 @@ wrappedplugin.go:420-548):
   ``int(100 * (s - min) / (max - min))`` over feasible nodes, all zeros
   when max == min.
 
-Tensorization: domain match counts are segment sums over the node axis
-(one per (context, topologyKey) term, batched via a flattened segment id
-space); each per-pod check is then a ``[N,T] x [T]`` matvec, which vmap
-turns into ``[P,T] x [T,N]`` MXU matmuls.  The [N,T] count tensors depend
-only on the scan carry, so XLA hoists them out of the vmapped pod batch.
+Tensorization: the scan carry IS the per-node domain-count view
+(state/interpod.py ``cnt_node``/``ecnt_node``/``ew_node`` [N,T] plus the
+cluster-wide ``total`` [T]), so filter and score read it directly and
+every per-pod check is a ``[N,T] x [T]`` matvec — vmapped over pods these
+become ``[P,T] x [T,N]`` MXU matmuls.  Committing a pod is an elementwise
+same-domain-mask outer-product add: the entire scan step contains no
+gather, scatter, or segment reduction (each of those costs ~50us inside a
+compiled TPU loop; elementwise [N,T] ops are effectively free).
 """
 
 from __future__ import annotations
@@ -49,68 +52,56 @@ ANTI_BIT = 2
 EXISTING_ANTI_BIT = 4
 
 
-def _domain_counts(cols: jnp.ndarray, dom_t: jnp.ndarray, n_dom: int) -> jnp.ndarray:
-    """Per-(node, term) domain totals: out[n,t] = sum over nodes n' in the
-    same t-domain as n of cols[n',t]; 0 where the node lacks the key.
-
-    One flattened segment_sum covers all T terms (term t's ids live in
-    [t*(Dom+1), (t+1)*(Dom+1)); slot Dom collects the key-missing rows)."""
-    t = cols.shape[1]
-    ids = jnp.where(dom_t >= 0, dom_t, n_dom) + jnp.arange(t, dtype=dom_t.dtype)[None, :] * (
-        n_dom + 1
-    )
-    flat = jax.ops.segment_sum(
-        cols.reshape(-1), ids.reshape(-1), num_segments=t * (n_dom + 1)
-    )
-    out = flat[ids.reshape(-1)].reshape(cols.shape)
-    return jnp.where(dom_t >= 0, out, 0)
-
-
 class InterPodAffinity:
     name = NAME
 
     def __init__(self, ipa: InterPodTensors) -> None:
-        self._dom = ipa.n_domains  # static for segment ops
+        del ipa  # all state flows through aux/carry
 
     # -- carried state ------------------------------------------------------
 
     def carry_init(self, aux) -> dict:
         a = aux["interpod"]
         return {
-            "match": a["match_counts"],
-            "ranti": a["ranti_counts"],
-            "ew": a["ew_counts"],
+            "cnt": a["cnt_node"],
+            "ecnt": a["ecnt_node"],
+            "ew": a["ew_node"],
+            "total": a["total"],
         }
 
     def carry_commit(self, carry, aux, pod: PodView, best) -> dict:
         a = aux["interpod"]
         j = pod.index
-        n = carry["match"].shape[0]
-        onehot = ((jnp.arange(n) == best) & (best >= 0)).astype(jnp.int32)
+        placed = best >= 0
+        b = jnp.maximum(best, 0)
+        # Per-term same-domain mask [N, T]: node n is in the placed node's
+        # domain for term t's topology key — one elementwise compare
+        # against the placed node's row of the precomputed per-term domain
+        # view (no gather/scatter in the scan step).
+        doms_t = a["dom_t"][b]  # [T] the placed node's domain per term
+        key_present = (doms_t >= 0) & placed  # [T]
+        mask_t = (
+            (a["dom_t"] == doms_t[None, :]) & key_present[None, :]
+        ).astype(jnp.int32)  # [N, T] 0/1
+        qm_t = a["pod_term_match"][j].astype(jnp.int32)  # [T]
         return {
-            "match": carry["match"] + onehot[:, None] * a["pod_ctx_match"][j].astype(jnp.int32),
-            "ranti": carry["ranti"] + onehot[:, None] * a["pod_eat"][j],
-            "ew": carry["ew"] + onehot[:, None] * a["pod_vw"][j],
+            "cnt": carry["cnt"] + mask_t * qm_t[None, :],
+            "ecnt": carry["ecnt"] + mask_t * a["pod_eat"][j][None, :],
+            "ew": carry["ew"] + mask_t * a["pod_vw"][j][None, :],
+            "total": carry["total"] + jnp.where(key_present, qm_t, 0),
         }
-
-    # -- shared pod-independent tensors -------------------------------------
-
-    def _shared(self, aux, carry):
-        a = aux["interpod"]
-        dom_t = jnp.take(a["node_dom"], a["term_tk"], axis=1)  # [N, T]
-        mc_t = jnp.take(carry["match"], a["term_u"], axis=1)  # [N, T]
-        cnt = _domain_counts(mc_t, dom_t, self._dom)  # [N, T]
-        return a, dom_t, mc_t, cnt
 
     # -- filter -------------------------------------------------------------
 
     def filter(self, state: NodeStateView, pod: PodView, aux, carry) -> FilterOutput:
-        a, dom_t, mc_t, cnt = self._shared(aux, carry)
+        a = aux["interpod"]
         j = pod.index
         i32 = jnp.int32
+        dom_t = a["dom_t"]  # [N, T] constant
+        cnt = carry["cnt"]  # [N, T]
         raff = a["req_aff"][j].astype(i32)  # [T]
         ranti = a["req_anti"][j].astype(i32)
-        qm_t = jnp.take(a["pod_ctx_match"][j], a["term_u"]).astype(i32)  # [T]
+        qm_t = a["pod_term_match"][j].astype(i32)  # [T]
 
         # (1) required affinity: all topology keys present AND every term's
         # domain count > 0 — or the global-empty + self-match escape.
@@ -129,14 +120,12 @@ class InterPodAffinity:
         key_cnt = cnt_req @ tk_onehot  # [N, TK] per-key totals
         need_key = (raff @ tk_onehot) > 0  # [TK] keys with required terms
         no_pods_any = jnp.any((key_cnt <= 0) & need_key[None, :], axis=1)
-        total_t = jnp.sum(jnp.where(dom_t >= 0, mc_t, 0), axis=0)  # [T]
-        escape = (jnp.dot(total_t, raff) == 0) & a["self_aff"][j]
+        escape = (jnp.dot(carry["total"], raff) == 0) & a["self_aff"][j]
         pass_aff = ~missing_any & (~no_pods_any | escape)
         # (2) incoming required anti-affinity (missing key = satisfied).
         viol_anti = jnp.dot((cnt > 0).astype(i32), ranti) > 0
         # (3) existing pods' required anti-affinity vs this pod.
-        ecnt = _domain_counts(carry["ranti"], dom_t, self._dom)
-        viol_existing = jnp.dot((ecnt > 0).astype(i32), qm_t) > 0
+        viol_existing = jnp.dot((carry["ecnt"] > 0).astype(i32), qm_t) > 0
 
         code = jnp.where(
             ~pass_aff,
@@ -157,11 +146,12 @@ class InterPodAffinity:
     # -- score --------------------------------------------------------------
 
     def score(self, state: NodeStateView, pod: PodView, aux, ok=None, carry=None) -> jnp.ndarray:
-        a, dom_t, _mc_t, cnt = self._shared(aux, carry)
+        a = aux["interpod"]
         j = pod.index
-        ew_c = _domain_counts(carry["ew"], dom_t, self._dom)
-        qm_t = jnp.take(a["pod_ctx_match"][j], a["term_u"]).astype(jnp.int32)
-        return (jnp.dot(cnt, a["pref_w"][j]) + jnp.dot(ew_c, qm_t)).astype(jnp.int32)
+        qm_t = a["pod_term_match"][j].astype(jnp.int32)
+        return (
+            jnp.dot(carry["cnt"], a["pref_w"][j]) + jnp.dot(carry["ew"], qm_t)
+        ).astype(jnp.int32)
 
     def normalize(self, scores: jnp.ndarray, ok: jnp.ndarray) -> jnp.ndarray:
         big = jnp.iinfo(jnp.int32).max

@@ -22,8 +22,21 @@ MinDomains honored for DoNotSchedule constraints:
   upstream's PreScore-Skip path: final contribution 0.
 
 The scan-carried state is the per-node matching-pod count per selector
-context (``[N, S]``); per-pod, per-constraint domain statistics are
-segment reductions over the global domain vocabulary (Dom axis).
+context (``[N, S]``); committing a pod is an elementwise outer-product
+add.  Per-pod domain statistics are computed with a compile-time dispatch
+over the (tiny, host-known) topology-key vocabulary:
+
+- **singleton keys** (every domain holds exactly one node — hostname):
+  the domain sum IS the per-node value, the domain count IS the eligible-
+  node count, the domain min IS a plain axis reduce — all elementwise;
+- **small dense keys** (zone-like): one [N,Dk] one-hot, built elementwise
+  per step, carries all constraints at once through two narrow matmuls
+  ``[Dk,N] x [N,MC]`` and back;
+- **large many-node keys** (rare): per-key segment_sum/segment_max
+  fallback.
+
+This keeps the sequential scan step free of gathers and scatters (each
+costs ~50us inside a compiled TPU loop) for the common key shapes.
 
 Known divergence (documented): upstream's *system default* constraints
 derive selectors from owning Services/ReplicaSets via DefaultSelector;
@@ -52,14 +65,24 @@ _BIG = jnp.iinfo(jnp.int32).max
 SKEW_BIT = 1
 MISSING_LABEL_BIT = 2
 
+# Largest per-key domain count that still uses the dense one-hot matmul
+# path; beyond this the [N, Dk] one-hot outweighs a segment reduction.
+DENSE_MAX = 256
+
+
+def _ftype():
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
 
 class PodTopologySpread:
     name = NAME
     normalize_needs_ctx = True
 
     def __init__(self, spread: SpreadTensors) -> None:
-        self._dom = spread.n_domains  # static for segment ops
         self._mc = spread.con_valid.shape[1]
+        self._n_tk = spread.node_ldom.shape[1]
+        self._sizes = spread.tk_sizes
+        self._singleton = spread.tk_singleton
 
     # -- carried state ------------------------------------------------------
 
@@ -88,69 +111,123 @@ class PodTopologySpread:
             "honor_taints": a["con_honor_taints"][j],
         }
 
-    def _eligibility(self, state, pod, aux, honor_aff, honor_taints):
+    def _ldom_mc(self, aux, con) -> jnp.ndarray:
+        """[N, MC] each constraint's local domain id per node (-1 = key
+        missing), assembled with a static unroll over the key vocab."""
+        ldom = aux["spread"]["node_ldom"]  # [N, TK]
+        out = jnp.full((ldom.shape[0], self._mc), -1, dtype=jnp.int32)
+        for k in range(self._n_tk):
+            out = jnp.where((con["tk"] == k)[None, :], ldom[:, k : k + 1], out)
+        return out
+
+    def _policy_elig(self, state, pod, aux, con) -> jnp.ndarray:
+        """[N, MC] inclusion-policy eligibility per constraint."""
         aff = required_affinity_match(aux, pod)
         tnt = forbidding_taints_tolerated(aux, pod)
-        e = state.valid
-        e = e & jnp.where(honor_aff, aff, True)
-        e = e & jnp.where(honor_taints, tnt, True)
+        e = state.valid[:, None]
+        e = e & jnp.where(con["honor_aff"][None, :], aff[:, None], True)
+        e = e & jnp.where(con["honor_taints"][None, :], tnt[:, None], True)
         return e
 
-    def _has_all_keys(self, aux, con, mode_val) -> jnp.ndarray:
-        """bool [N]: node has every topology key of the pod's constraints
-        with the given mode."""
-        a = aux["spread"]
-        node_dom = a["node_dom"]  # [N, TK]
-        ok = jnp.ones(node_dom.shape[0], dtype=bool)
-        for ci in range(self._mc):
-            active = con["valid"][ci] & (con["mode"][ci] == mode_val)
-            has = jnp.take(node_dom, con["tk"][ci], axis=1) >= 0
-            ok = ok & jnp.where(active, has, True)
-        return ok
+    def _sel_counts(self, carry, con) -> jnp.ndarray:
+        """[N, MC] the carried matching-pod count for each constraint's
+        selector context (one narrow matmul instead of per-ci gathers)."""
+        s = carry.shape[1]
+        ft = _ftype()
+        sel_oh = (con["sel"][None, :] == jnp.arange(s)[:, None]).astype(ft)  # [S, MC]
+        return (carry.astype(ft) @ sel_oh).astype(jnp.int32)
+
+    def _per_key_stats(self, aux, con, pres_mask, cnt_for):
+        """Domain statistics for every constraint at once, via the static
+        per-key dispatch (singleton / dense one-hot / segment fallback).
+
+        pres_mask: bool [N, MC] — nodes whose domain counts as present
+        (filter: stat-eligible; score: registered = filtered & keyed).
+        cnt_for(reg_at): -> i32 [N, MC] per-node contributions given
+        reg_at (bool [N, MC]: node's domain is present) — score gates
+        contributors on registration, filter ignores the argument.
+
+        Returns (seg_at [N,MC] domain sum at each node (0 where the node
+        misses the key), dom_num [MC] present-domain count, min_match [MC]
+        min present-domain sum, _BIG when none present).
+        """
+        ldom = aux["spread"]["node_ldom"]
+        ft = _ftype()
+        n = ldom.shape[0]
+        seg_at = jnp.zeros((n, self._mc), jnp.int32)
+        dom_num = jnp.zeros((self._mc,), jnp.int32)
+        minm = jnp.full((self._mc,), _BIG)
+        for k in range(self._n_tk):  # static unroll over the key vocab
+            g = con["tk"] == k  # [MC]
+            if self._singleton[k]:
+                contrib = cnt_for(pres_mask)  # own domain == the node
+                seg_k = contrib
+                dn_k = jnp.sum(pres_mask, axis=0).astype(jnp.int32)
+                mm_k = jnp.min(jnp.where(pres_mask, contrib, _BIG), axis=0)
+            elif self._sizes[k] <= DENSE_MAX:
+                oh = (
+                    ldom[:, k][:, None] == jnp.arange(self._sizes[k])[None, :]
+                ).astype(ft)  # [N, Dk]
+                pres = (oh.T @ pres_mask.astype(ft)) > 0  # [Dk, MC]
+                reg_at = (oh @ pres.astype(ft)) > 0  # [N, MC]
+                seg_d = oh.T @ cnt_for(reg_at).astype(ft)  # [Dk, MC]
+                seg_k = (oh @ seg_d).astype(jnp.int32)
+                dn_k = jnp.sum(pres, axis=0).astype(jnp.int32)
+                mm_k = jnp.min(
+                    jnp.where(pres, seg_d, _BIG), axis=0
+                ).astype(jnp.int32)
+            else:
+                ids = jnp.maximum(ldom[:, k], 0)
+                haskey = (ldom[:, k] >= 0)[:, None]
+                pres = (
+                    jax.ops.segment_max(
+                        jnp.where(haskey & pres_mask, 1, 0), ids,
+                        num_segments=self._sizes[k],
+                    )
+                    > 0
+                )  # [Dk, MC]
+                reg_at = haskey & pres[ids]
+                seg_d = jax.ops.segment_sum(
+                    jnp.where(haskey, cnt_for(reg_at), 0), ids,
+                    num_segments=self._sizes[k],
+                )
+                seg_k = jnp.where(haskey, seg_d[ids], 0)
+                dn_k = jnp.sum(pres, axis=0).astype(jnp.int32)
+                mm_k = jnp.min(jnp.where(pres, seg_d, _BIG), axis=0)
+            seg_at = jnp.where(g[None, :], seg_k, seg_at)
+            dom_num = jnp.where(g, dn_k, dom_num)
+            minm = jnp.where(g, mm_k, minm)
+        return seg_at, dom_num, minm
 
     # -- filter -------------------------------------------------------------
 
     def filter(self, state: NodeStateView, pod: PodView, aux, carry) -> FilterOutput:
-        a = aux["spread"]
         con = self._constraint_arrays(aux, pod)
-        node_dom = a["node_dom"]
-        n = node_dom.shape[0]
-        allkeys = self._has_all_keys(aux, con, 0)
-
-        code = jnp.zeros(n, dtype=jnp.int32)
+        active = con["valid"] & (con["mode"] == 0)  # [MC]
+        l_mc = self._ldom_mc(aux, con)  # [N, MC]
+        haskey = l_mc >= 0
+        allkeys = jnp.all(haskey | ~active[None, :], axis=1)  # [N]
+        elig = self._policy_elig(state, pod, aux, con) & allkeys[:, None]
+        stat = elig & haskey  # [N, MC]
+        cnt_mc = self._sel_counts(carry, con)
+        x = jnp.where(stat, cnt_mc, 0)
+        seg_at, dom_num, min_match = self._per_key_stats(
+            aux, con, stat, lambda _reg_at: x
+        )
+        min_match = jnp.where(dom_num > 0, min_match, 0)
+        min_match = jnp.where(
+            (con["min_domains"] > 0) & (dom_num < con["min_domains"]), 0, min_match
+        )
+        match_num = jnp.where(haskey, seg_at, 0)
+        skew = match_num + con["self"].astype(jnp.int32)[None, :] - min_match[None, :]
+        viol = skew > con["max_skew"][None, :]
+        code_mc = jnp.where(
+            ~haskey, MISSING_LABEL_BIT, jnp.where(viol, SKEW_BIT, 0)
+        ).astype(jnp.int32)
+        # First failing active constraint wins (upstream constraint order).
+        code = jnp.zeros(l_mc.shape[0], dtype=jnp.int32)
         for ci in range(self._mc):
-            active = con["valid"][ci] & (con["mode"][ci] == 0)
-            d = jnp.take(node_dom, con["tk"][ci], axis=1)  # [N]
-            elig = (
-                self._eligibility(state, pod, aux, con["honor_aff"][ci], con["honor_taints"][ci])
-                & allkeys
-            )
-            cnt_node = jnp.take(carry, con["sel"][ci], axis=1)  # [N]
-            d_safe = jnp.maximum(d, 0)
-            stat = elig & (d >= 0)
-            seg = jax.ops.segment_sum(
-                jnp.where(stat, cnt_node, 0), d_safe, num_segments=self._dom
-            )
-            present = (
-                jax.ops.segment_max(
-                    jnp.where(stat, 1, 0), d_safe, num_segments=self._dom
-                )
-                > 0
-            )
-            domains_num = present.sum()
-            min_match = jnp.min(jnp.where(present, seg, _BIG))
-            min_match = jnp.where(domains_num > 0, min_match, 0)
-            min_match = jnp.where(
-                (con["min_domains"][ci] > 0) & (domains_num < con["min_domains"][ci]),
-                0,
-                min_match,
-            )
-            match_num = jnp.where(d >= 0, seg[d_safe], 0)
-            skew = match_num + con["self"][ci].astype(jnp.int32) - min_match
-            viol = skew > con["max_skew"][ci]
-            missing = d < 0
-            this_code = jnp.where(missing, MISSING_LABEL_BIT, jnp.where(viol, SKEW_BIT, 0))
-            code = jnp.where(active & (code == 0), this_code, code)
+            code = jnp.where(active[ci] & (code == 0), code_mc[:, ci], code)
         return FilterOutput(ok=code == 0, reason_bits=code)
 
     def decode_reasons(self, bits: int) -> list[str]:
@@ -162,57 +239,43 @@ class PodTopologySpread:
 
     # -- score --------------------------------------------------------------
 
-    def _ignored(self, aux, con, pod: PodView) -> jnp.ndarray:
-        """Nodes missing any ScheduleAnyway key while the pod has
-        constraints (requireAllTopologies -> IgnoredNodes)."""
-        a = aux["spread"]
-        has_con = a["has_score_con"][pod.index]
-        return has_con & ~self._has_all_keys(aux, con, 1)
+    def _score_parts(self, aux, con, pod: PodView):
+        """(active [MC], l_mc [N,MC], ignored [N]) for ScheduleAnyway."""
+        active = con["valid"] & (con["mode"] == 1)
+        l_mc = self._ldom_mc(aux, con)
+        haskey = l_mc >= 0
+        allkeys = jnp.all(haskey | ~active[None, :], axis=1)
+        has_con = aux["spread"]["has_score_con"][pod.index]
+        ignored = has_con & ~allkeys
+        return active, l_mc, haskey, ignored
 
     def score(self, state: NodeStateView, pod: PodView, aux, ok=None, carry=None) -> jnp.ndarray:
-        a = aux["spread"]
         con = self._constraint_arrays(aux, pod)
-        node_dom = a["node_dom"]
-        n = node_dom.shape[0]
-        ignored = self._ignored(aux, con, pod)
-        filtered = ok & ~ignored
+        active, l_mc, haskey, ignored = self._score_parts(aux, con, pod)
+        filtered = ok & ~ignored  # [N]
 
-        # float64 under x64 (exact vs the float64 oracle/upstream);
-        # float32 on TPU (documented rounding tolerance at .5 boundaries).
-        ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        total = jnp.zeros(n, dtype=ftype)
-        for ci in range(self._mc):
-            active = con["valid"][ci] & (con["mode"][ci] == 1)
-            d = jnp.take(node_dom, con["tk"][ci], axis=1)
-            d_safe = jnp.maximum(d, 0)
-            # Registered domains: present among framework-feasible,
-            # non-ignored nodes (upstream calPreScoreState filteredNodes).
-            reg = (
-                jax.ops.segment_max(
-                    jnp.where(filtered & (d >= 0), 1, 0), d_safe, num_segments=self._dom
-                )
-                > 0
-            )
-            elig = (
-                self._eligibility(state, pod, aux, con["honor_aff"][ci], con["honor_taints"][ci])
-                & (d >= 0)
-                & reg[d_safe]
-            )
-            cnt_node = jnp.take(carry, con["sel"][ci], axis=1)
-            seg = jax.ops.segment_sum(
-                jnp.where(elig, cnt_node, 0), d_safe, num_segments=self._dom
-            )
-            domains_num = reg.sum()
-            tp_weight = jnp.log(domains_num.astype(ftype) + 2.0)
-            contrib = seg[d_safe].astype(ftype) * tp_weight + (
-                con["max_skew"][ci].astype(ftype) - 1.0
-            )
-            total = total + jnp.where(active & filtered, contrib, 0.0)
+        # Registered domains: present among framework-feasible, non-ignored
+        # nodes (upstream calPreScoreState filteredNodes); contributors are
+        # policy-passing nodes whose domain is registered.
+        fd = filtered[:, None] & haskey  # [N, MC]
+        elig0 = self._policy_elig(state, pod, aux, con) & haskey
+        cnt_mc = self._sel_counts(carry, con)
+        seg_at, dom_num, _mm = self._per_key_stats(
+            aux, con, fd, lambda reg_at: jnp.where(elig0 & reg_at, cnt_mc, 0)
+        )
+
+        ft = _ftype()
+        tp_weight = jnp.log(dom_num.astype(ft) + 2.0)  # [MC]
+        contrib = seg_at.astype(ft) * tp_weight[None, :] + (
+            con["max_skew"].astype(ft)[None, :] - 1.0
+        )
+        gate = active[None, :] & filtered[:, None]
+        total = jnp.sum(jnp.where(gate, contrib, 0.0), axis=1)
         return jnp.round(total).astype(jnp.int32)
 
     def normalize(self, scores, ok, *, state=None, pod=None, aux=None, carry=None):
         con = self._constraint_arrays(aux, pod)
-        ignored = self._ignored(aux, con, pod)
+        _active, _l_mc, _haskey, ignored = self._score_parts(aux, con, pod)
         scoreable = ok & ~ignored
         has_con = aux["spread"]["has_score_con"][pod.index]
         mx = jnp.max(jnp.where(scoreable, scores, jnp.iinfo(jnp.int32).min))

@@ -281,6 +281,7 @@ class SpreadTensors:
 
     AXES = {
         "node_dom": "node",
+        "node_ldom": "node",
         "init_counts": "node",
         "pod_sel_match": "pod",
         "con_valid": "pod",
@@ -296,7 +297,10 @@ class SpreadTensors:
     }
 
     n_domains: int  # static Dom size (for segment ops)
+    tk_sizes: tuple  # static per-key local-domain counts (>=1 each)
+    tk_singleton: tuple  # static per-key: every domain holds <=1 node
     node_dom: np.ndarray  # int32 [N, TK], domain id or -1
+    node_ldom: np.ndarray  # int32 [N, TK], per-key LOCAL domain id or -1
     init_counts: np.ndarray  # int32 [N, S] matching bound pods per node
     pod_sel_match: np.ndarray  # bool [P, S] queue pod matches context
     con_valid: np.ndarray  # bool [P, MC]
@@ -376,6 +380,11 @@ def encode_topology_spread(
 
     TK = max(len(tk_vocab), 1)
     node_dom = np.full((n_padded, TK), -1, dtype=np.int32)
+    node_ldom = np.full((n_padded, TK), -1, dtype=np.int32)
+    tk_sizes = [1] * TK
+    tk_singleton = [True] * TK
+    per_key_loc: list[dict[str, int]] = [{} for _ in range(TK)]
+    per_key_cnt: list[dict[int, int]] = [{} for _ in range(TK)]
     for ni, node in enumerate(nodes):
         lbls = labels_of(node)
         for k, ki in tk_vocab.items():
@@ -384,6 +393,12 @@ def encode_topology_spread(
                 if dk not in dom_vocab:
                     dom_vocab[dk] = len(dom_vocab)
                 node_dom[ni, ki] = dom_vocab[dk]
+                li = per_key_loc[ki].setdefault(lbls[k], len(per_key_loc[ki]))
+                node_ldom[ni, ki] = li
+                per_key_cnt[ki][li] = per_key_cnt[ki].get(li, 0) + 1
+    for ki in range(TK):
+        tk_sizes[ki] = max(len(per_key_loc[ki]), 1)
+        tk_singleton[ki] = all(c <= 1 for c in per_key_cnt[ki].values())
 
     S = max(len(sel_list), 1)
     init_counts = np.zeros((n_padded, S), dtype=np.int32)
@@ -432,7 +447,10 @@ def encode_topology_spread(
 
     return SpreadTensors(
         n_domains=max(len(dom_vocab), 1),
+        tk_sizes=tuple(tk_sizes),
+        tk_singleton=tuple(tk_singleton),
         node_dom=node_dom,
+        node_ldom=node_ldom,
         init_counts=init_counts,
         pod_sel_match=pod_sel_match,
         con_valid=con_valid,

@@ -13,17 +13,25 @@ The same host/device split as the other affinity-family encoders
   (namespaces + namespaceSelector + labelSelector — the part of an affinity
   term that matches *pods*) and *terms* (context x topologyKey).  Evaluate
   every bound and queue pod against every context once in exact Python.
-- **Device side** (plugins/interpodaffinity.py): per-topology-domain match
-  counts via segment sums over the node axis, then every per-pod check is a
+- **Device side** (plugins/interpodaffinity.py): per-node domain-count
+  tensors are the scan carry itself, so every per-pod check is a
   ``[N,T] x [T]`` matvec — vmapped over pods these become ``[P,T] x [T,N]``
   MXU matmuls.
 
-Scan-carried state (so later queue pods see earlier placements):
-``match_counts`` [N,U] (pods matching context u on node n), ``ranti_counts``
-[N,T] (pods on n having required anti-affinity term t), ``ew_counts`` [N,T]
-(signed score weight of existing pods' terms on n: required-affinity terms
-count HardPodAffinityWeight each, preferred affinity +w, preferred
-anti-affinity -w — upstream scoring.go processExistingPod).
+Scan-carried state (so later queue pods see earlier placements) is kept in
+NODE space with the domain aggregation PRE-APPLIED: ``cnt_node`` [N,T]
+(pods matching term t's context anywhere in node n's t-domain),
+``ecnt_node`` [N,T] (pods with required anti-affinity term t in n's
+t-domain), ``ew_node`` [N,T] (signed score weight of existing pods' terms
+in n's t-domain: required-affinity terms count HardPodAffinityWeight each,
+preferred affinity +w, preferred anti-affinity -w — upstream scoring.go
+processExistingPod), ``total`` [T] (cluster-wide matches on key-carrying
+nodes, the first-pod-escape check).  Committing a pod to node b updates
+all nodes sharing b's domain with an elementwise same-domain mask — no
+gather, scatter, or segment reduction anywhere in the scan step (TPU
+gathers cost ~50us inside a compiled loop; elementwise [N,T] ops are
+effectively free).  The domain-space tables built here exist only to
+initialize those carries host-side.
 """
 
 from __future__ import annotations
@@ -56,12 +64,15 @@ class InterPodTensors:
 
     AXES = {
         "node_dom": "node",
-        "match_counts": "node",
-        "ranti_counts": "node",
-        "ew_counts": "node",
+        "dom_t": "node",
+        "cnt_node": "node",
+        "ecnt_node": "node",
+        "ew_node": "node",
+        "total": None,
         "term_u": None,
         "term_tk": None,
         "pod_ctx_match": "pod",
+        "pod_term_match": "pod",
         "req_aff": "pod",
         "req_anti": "pod",
         "self_aff": "pod",
@@ -70,15 +81,18 @@ class InterPodTensors:
         "pod_eat": "pod",
     }
 
-    n_domains: int  # static Dom size (for segment ops)
+    n_domains: int  # static Dom size
     hard_weight: int  # HardPodAffinityWeight folded into ew/pod_vw
     node_dom: np.ndarray  # i32 [N, TK] domain id or -1 (key absent)
+    dom_t: np.ndarray  # i32 [N, T] == node_dom[:, term_tk] (per-term view)
+    cnt_node: np.ndarray  # i32 [N, T] initial t-domain ctx matches per node
+    ecnt_node: np.ndarray  # i32 [N, T] initial t-domain required-anti counts
+    ew_node: np.ndarray  # i32 [N, T] initial t-domain signed score weight
+    total: np.ndarray  # i32 [T] initial cluster-wide matches (escape check)
     term_u: np.ndarray  # i32 [T] term -> context id
     term_tk: np.ndarray  # i32 [T] term -> topology-key id
-    match_counts: np.ndarray  # i32 [N, U] bound pods matching ctx u on node
-    ranti_counts: np.ndarray  # i32 [N, T] bound pods with required-anti term t
-    ew_counts: np.ndarray  # i32 [N, T] signed existing-term score weight
     pod_ctx_match: np.ndarray  # bool [P, U] queue pod matches ctx u
+    pod_term_match: np.ndarray  # bool [P, T] == pod_ctx_match[:, term_u]
     req_aff: np.ndarray  # bool [P, T] pod's required affinity terms
     req_anti: np.ndarray  # bool [P, T] pod's required anti-affinity terms
     self_aff: np.ndarray  # bool [P] pod matches ALL its own required aff terms
@@ -231,26 +245,47 @@ def encode_inter_pod(
                     dom_vocab[dk] = len(dom_vocab)
                 node_dom[ni, ki] = dom_vocab[dk]
 
-    # Existing-pod state (the carry init).
-    match_counts = np.zeros((n_padded, U), dtype=np.int32)
-    ranti_counts = np.zeros((n_padded, T), dtype=np.int32)
-    ew_counts = np.zeros((n_padded, T), dtype=np.int32)
+    n_domains = max(len(dom_vocab), 1)
+    D1 = n_domains + 1  # +1 write-only junk row
+    dom_tk = np.full(D1, -1, dtype=np.int32)
+    for (ki, _val), d in dom_vocab.items():
+        dom_tk[d] = ki
+
+    # Existing-pod state (the carry init), accumulated in domain space: a
+    # bound pod on node ni contributes to ni's domain for EVERY topology
+    # key (match counts) / for its term's topology key (term counts); a
+    # node missing the key contributes nowhere (no topologyPair exists —
+    # upstream filtering.go only counts nodes that carry the key).
+    match_dom = np.zeros((D1, U), dtype=np.int32)
+    ranti_dom = np.zeros((D1, T), dtype=np.int32)
+    ew_dom = np.zeros((D1, T), dtype=np.int32)
     node_index = {name_of(n): i for i, n in enumerate(nodes)}
     for bp, terms in zip(bound_pods, bound_terms):
         ni = node_index.get(bp.get("spec", {}).get("nodeName", ""))
         if ni is None:
             continue
+        doms = node_dom[ni]  # [TK]
         for ui, ctx in enumerate(vocab.ctxs):
             if context_matches(ctx, bp, ns_labels):
-                match_counts[ni, ui] += 1
+                for d in doms:
+                    if d >= 0:
+                        match_dom[d, ui] += 1
         for t, _u, _w in terms["req_anti"]:
-            ranti_counts[ni, t] += 1
+            d = doms[term_tk[t]]
+            if d >= 0:
+                ranti_dom[d, t] += 1
         for t, _u, _w in terms["req_aff"]:
-            ew_counts[ni, t] += hard_weight
+            d = doms[term_tk[t]]
+            if d >= 0:
+                ew_dom[d, t] += hard_weight
         for t, _u, w in terms["pref_aff"]:
-            ew_counts[ni, t] += w
+            d = doms[term_tk[t]]
+            if d >= 0:
+                ew_dom[d, t] += w
         for t, _u, w in terms["pref_anti"]:
-            ew_counts[ni, t] -= w
+            d = doms[term_tk[t]]
+            if d >= 0:
+                ew_dom[d, t] -= w
 
     # Queue-pod tables.
     pod_ctx_match = np.zeros((p_padded, U), dtype=bool)
@@ -279,16 +314,32 @@ def encode_inter_pod(
             pref_w[j, t] -= w
             pod_vw[j, t] -= w
 
+    # Node-space carry initialization: pre-apply the domain aggregation so
+    # the device never has to (see module docstring).
+    dom_t = node_dom[:, term_tk]  # [N, T]
+    safe = np.maximum(dom_t, 0)
+    t_cols = np.arange(T)[None, :]
+    cnt_node = np.where(dom_t >= 0, match_dom[safe, term_u[None, :]], 0).astype(np.int32)
+    ecnt_node = np.where(dom_t >= 0, ranti_dom[safe, t_cols], 0).astype(np.int32)
+    ew_node = np.where(dom_t >= 0, ew_dom[safe, t_cols], 0).astype(np.int32)
+    total = np.array(
+        [match_dom[dom_tk == term_tk[t], term_u[t]].sum() for t in range(T)],
+        dtype=np.int32,
+    )
+
     return InterPodTensors(
-        n_domains=max(len(dom_vocab), 1),
+        n_domains=n_domains,
         hard_weight=hard_weight,
         node_dom=node_dom,
+        dom_t=dom_t,
+        cnt_node=cnt_node,
+        ecnt_node=ecnt_node,
+        ew_node=ew_node,
+        total=total,
         term_u=term_u,
         term_tk=term_tk,
-        match_counts=match_counts,
-        ranti_counts=ranti_counts,
-        ew_counts=ew_counts,
         pod_ctx_match=pod_ctx_match,
+        pod_term_match=pod_ctx_match[:, term_u],
         req_aff=req_aff,
         req_anti=req_anti,
         self_aff=self_aff,

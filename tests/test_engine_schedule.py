@@ -124,3 +124,24 @@ def test_padding_pods_not_scheduled():
     queue = [make_pod("p0")]
     feats, res, _ = run_engine(nodes, [], queue)
     assert [int(x) for x in res.selected[1:]] == [-1] * (len(res.selected) - 1)
+
+
+def test_chunked_schedule_and_batch_match_unchunked():
+    """Chunk boundaries must be semantically invisible: the carries thread
+    through the host loop unchanged (engine/core.py schedule chunking)."""
+    nodes, pods = random_cluster(3, n_nodes=16, n_pods=60, bound_fraction=0.2)
+    queue = [p for p in pods if not p["spec"].get("nodeName")]
+    feats = Featurizer().featurize(nodes, pods, queue_pods=queue)
+    eng = Engine(feats, default_plugins(feats), record="full")
+    whole, state_whole = eng.schedule(chunk=int(feats.pods.valid.shape[0]))
+    parts, state_parts = eng.schedule(chunk=17)
+    for field in ("reason_bits", "scores", "final_scores", "total", "feasible", "selected"):
+        a, b = getattr(whole, field), getattr(parts, field)
+        assert np.array_equal(a, b), field
+    assert np.array_equal(state_whole.requested, state_parts.requested)
+    assert np.array_equal(state_whole.pod_count, state_parts.pod_count)
+
+    bwhole = eng.evaluate_batch(chunk=int(feats.pods.valid.shape[0]))
+    bparts = eng.evaluate_batch(chunk=13)
+    for field in ("reason_bits", "scores", "final_scores", "total", "feasible", "selected"):
+        assert np.array_equal(getattr(bwhole, field), getattr(bparts, field)), field
