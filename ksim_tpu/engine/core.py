@@ -22,6 +22,15 @@ deterministic choice keeps replays reproducible).  Unschedulable pods
 Every pod x node result the reference records is preserved (the recorded
 results ARE the product — SURVEY.md hard part 7); ``record`` modes bound
 result-tensor memory for the 10k x 5k configs.
+
+Compiled-program reuse: the jitted programs live on ``_Program``, a small
+static object keyed by (record mode, plugin static signatures).  Engines
+built for re-featurized snapshots share programs whenever the signatures
+and array shapes match — the analogue of NOT restarting the reference's
+scheduler container when nothing about the profile changed
+(scheduler.go:58-111).  The jit cache pins only the ``_Program`` (plugins
+hold vocab-sized statics, never snapshot tensors), so dropping an Engine
+frees its device arrays.
 """
 
 from __future__ import annotations
@@ -118,64 +127,41 @@ def _final_from_raw(
     return raw * weight
 
 
-class Engine:
-    """Compiled filter/score programs for one profile + featurized snapshot.
+def _plugin_sig(plugin: Any) -> tuple:
+    """Hashable jit-cache key component for one plugin: its declared
+    static_sig, or object identity for plugins that don't implement one
+    (no cross-instance program reuse, but always safe)."""
+    try:
+        sig = plugin.static_sig()
+    except (AttributeError, NotImplementedError):
+        sig = None
+    if sig is None:
+        return ("@id", id(plugin))
+    return tuple(sig)
 
-    Building an Engine is the analogue of the reference's scheduler restart
-    on config change (simulator/scheduler/scheduler.go:58-111): the plugin
-    set and snapshot shapes are baked into the jitted programs.
-    """
 
-    def __init__(
-        self,
-        feats: FeaturizedSnapshot,
-        plugins: Sequence[ScoredPlugin],
-        *,
-        record: str = "full",  # full | final | selection
-        device_put: bool = True,
-    ) -> None:
-        if record not in ("full", "final", "selection"):
-            raise ValueError(f"unknown record mode {record!r}")
-        self._feats = feats
-        self._plugins = tuple(plugins)
-        self._record = record
-        n = feats.nodes
-        p = feats.pods
-        arrays = dict(
-            allocatable=jnp.asarray(n.allocatable),
-            allowed_pods=jnp.asarray(n.allowed_pods),
-            valid=jnp.asarray(n.valid),
-            unschedulable=jnp.asarray(n.unschedulable),
-            requested=jnp.asarray(n.requested),
-            nonzero_requested=jnp.asarray(n.nonzero_requested),
-            pod_count=jnp.asarray(n.pod_count),
+class _Program:
+    """The static half of an Engine: plugin set + record mode, hashable by
+    signature.  jax.jit keys its cache on this object (static argnum 0),
+    so equal-signature programs share compiled code while the cache entry
+    retains only vocab-sized plugin statics — never snapshot tensors."""
+
+    def __init__(self, plugins: tuple[ScoredPlugin, ...], record: str) -> None:
+        self.plugins = plugins
+        self.record = record
+        self._sig = (
+            record,
+            tuple(
+                (_plugin_sig(sp.plugin), sp.weight, sp.filter_enabled, sp.score_enabled)
+                for sp in plugins
+            ),
         )
-        self._node_state = NodeStateView(**arrays)
-        self._pods = PodBatch(
-            requests=jnp.asarray(p.requests),
-            nonzero_requests=jnp.asarray(p.nonzero_requests),
-            valid=jnp.asarray(p.valid),
-            tolerates_unschedulable=jnp.asarray(p.tolerates_unschedulable),
-            has_requests=jnp.asarray(p.has_requests),
-            index=jnp.asarray(p.index),
-        )
-        self._aux, self._aux_axes = _device_aux(feats.aux)
 
-    def shard(self, mesh) -> "Engine":
-        """Lay the engine's arrays out over a device mesh: node axis over
-        "tp", pod batch over "dp" (see engine/sharding.py).  GSPMD inserts
-        the node-axis collectives (any/argmax reductions) over ICI.
+    def __hash__(self) -> int:
+        return hash(self._sig)
 
-        Note: the sequential ``schedule`` path wants replicated pod arrays
-        (lax.scan consumes one row per step); ``evaluate_batch`` benefits
-        from the dp sharding.  Shard for the path you will run.
-        """
-        from ksim_tpu.engine import sharding as shlib
-
-        self._node_state = shlib.shard_node_state(self._node_state, mesh)
-        self._pods = shlib.shard_pod_batch(self._pods, mesh)
-        self._aux = shlib.shard_aux(self._aux, self._aux_axes, mesh)
-        return self
+    def __eq__(self, other) -> bool:
+        return isinstance(other, _Program) and self._sig == other._sig
 
     # -- shared per-pod evaluation -----------------------------------------
 
@@ -188,7 +174,7 @@ class Engine:
         """
         reason_bits = []
         filter_ok = state.valid
-        for sp in self._plugins:
+        for sp in self.plugins:
             if not sp.filter_enabled:
                 continue
             kw = {"carry": carries[sp.plugin.name]} if sp.plugin.name in carries else {}
@@ -198,7 +184,7 @@ class Engine:
         raw_scores = []
         final_scores = []
         total = jnp.zeros(state.valid.shape[0], dtype=jnp.int32)
-        for sp in self._plugins:
+        for sp in self.plugins:
             if not sp.score_enabled:
                 continue
             kw = {"carry": carries[sp.plugin.name]} if sp.plugin.name in carries else {}
@@ -209,16 +195,16 @@ class Engine:
             total = total + final.astype(jnp.int32)
         return filter_ok, reason_bits, raw_scores, final_scores, total
 
-    def _init_carries(self) -> dict:
+    def init_carries(self, aux: dict) -> dict:
         return {
-            sp.plugin.name: sp.plugin.carry_init(self._aux)
-            for sp in self._plugins
+            sp.plugin.name: sp.plugin.carry_init(aux)
+            for sp in self.plugins
             if hasattr(sp.plugin, "carry_init")
         }
 
     def _commit_carries(self, carries: dict, pod: PodView, best, aux: dict) -> dict:
         out = dict(carries)
-        for sp in self._plugins:
+        for sp in self.plugins:
             if sp.plugin.name in carries and hasattr(sp.plugin, "carry_commit"):
                 out[sp.plugin.name] = sp.plugin.carry_commit(
                     carries[sp.plugin.name], aux, pod, best
@@ -231,26 +217,18 @@ class Engine:
         best = jnp.argmax(masked).astype(jnp.int32)
         return feasible, jnp.where(feasible, best, -1)
 
-    # -- one-shot batch (no commit) ----------------------------------------
-
     def _pod_outputs(self, pv, feasible, best, bits, raw, final, total) -> dict:
         out = dict(feasible=feasible & pv, selected=jnp.where(pv, best, -1))
         n = total.shape[0]
-        if self._record in ("full", "final"):
+        if self.record in ("full", "final"):
             out["total"] = total
             out["final"] = jnp.stack(final) if final else jnp.zeros((0, n), jnp.int32)
-        if self._record == "full":
+        if self.record == "full":
             out["bits"] = jnp.stack(bits) if bits else jnp.zeros((0, n), jnp.int32)
             out["raw"] = jnp.stack(raw) if raw else jnp.zeros((0, n), jnp.int32)
         return out
 
-    def batch_step(self, state, pods: PodBatch, aux: dict, carries: dict):
-        """Pure jittable batch-evaluation step (un-jitted public form)."""
-        return self._batch_fn.__wrapped__(self, state, pods, aux, carries)
-
-    @property
-    def example_args(self):
-        return (self._node_state, self._pods, self._aux, self._init_carries())
+    # -- compiled entry points ----------------------------------------------
 
     @partial(jax.jit, static_argnums=0)
     def _batch_fn(self, state, pods: PodBatch, aux: dict, carries: dict):
@@ -267,40 +245,6 @@ class Engine:
             return self._pod_outputs(pb.valid, feasible, best, bits, raw, final, total)
 
         return jax.vmap(per_pod)(pods)
-
-    def evaluate_batch_chunks(self, *, chunk: int | None = None):
-        """Yield (start, device_out) per pod chunk — the streaming form of
-        ``evaluate_batch``.  Each ``device_out`` is the device-resident
-        result pytree for pods [start, start+chunk); callers decode or
-        transfer it before the next iteration if they want bounded device
-        memory (record="full" at 16k x 8k is ~9GB of result tensors —
-        far more than it costs to recompute, so nothing is retained)."""
-        P = int(self._pods.valid.shape[0])
-        if chunk is None:
-            chunk = min(P, self.SCHEDULE_CHUNK)
-        carries = self._init_carries()
-        for s in range(0, P, chunk):
-            pods_c = jax.tree_util.tree_map(
-                lambda x: x[s : s + chunk], self._pods
-            )
-            yield s, self._batch_fn(self._node_state, pods_c, self._aux, carries)
-
-    def evaluate_batch(self, *, chunk: int | None = None) -> EngineResult:
-        """All pods x nodes against the fixed snapshot (no state commit).
-
-        Pod-chunked like ``schedule`` so the recorded result tensors
-        ([P, plugins, N] in record="full") never exceed one chunk's worth
-        of device memory; chunks stream to host and concatenate."""
-        outs = [
-            jax.tree_util.tree_map(np.asarray, out)
-            for _s, out in self.evaluate_batch_chunks(chunk=chunk)
-        ]
-        merged = jax.tree_util.tree_map(
-            lambda *xs: np.concatenate(xs, axis=0), *outs
-        )
-        return self._to_result(merged)
-
-    # -- sequential scheduling (lax.scan with commit) ----------------------
 
     @partial(jax.jit, static_argnums=0)
     def _schedule_fn(self, state, pods: PodBatch, aux: dict, carries: dict):
@@ -325,6 +269,113 @@ class Engine:
         (final_state, final_carries), out = jax.lax.scan(body, (state, carries), pods)
         return final_state, final_carries, out
 
+
+class Engine:
+    """Compiled filter/score programs for one profile + featurized snapshot.
+
+    Building an Engine binds a snapshot's device arrays to a ``_Program``
+    (the static plugin set + record mode); the heavy compilation caches on
+    the program signature and array shapes, so rebuilding an Engine for a
+    fresh same-shaped snapshot costs only the host->device transfer.
+    """
+
+    def __init__(
+        self,
+        feats: FeaturizedSnapshot,
+        plugins: Sequence[ScoredPlugin],
+        *,
+        record: str = "full",  # full | final | selection
+    ) -> None:
+        if record not in ("full", "final", "selection"):
+            raise ValueError(f"unknown record mode {record!r}")
+        self._feats = feats
+        self._prog = _Program(tuple(plugins), record)
+        n = feats.nodes
+        p = feats.pods
+        arrays = dict(
+            allocatable=jnp.asarray(n.allocatable),
+            allowed_pods=jnp.asarray(n.allowed_pods),
+            valid=jnp.asarray(n.valid),
+            unschedulable=jnp.asarray(n.unschedulable),
+            requested=jnp.asarray(n.requested),
+            nonzero_requested=jnp.asarray(n.nonzero_requested),
+            pod_count=jnp.asarray(n.pod_count),
+        )
+        self._node_state = NodeStateView(**arrays)
+        self._pods = PodBatch(
+            requests=jnp.asarray(p.requests),
+            nonzero_requests=jnp.asarray(p.nonzero_requests),
+            valid=jnp.asarray(p.valid),
+            tolerates_unschedulable=jnp.asarray(p.tolerates_unschedulable),
+            has_requests=jnp.asarray(p.has_requests),
+            index=jnp.asarray(p.index),
+        )
+        self._aux, self._aux_axes = _device_aux(feats.aux)
+
+    @property
+    def _plugins(self) -> tuple[ScoredPlugin, ...]:
+        return self._prog.plugins
+
+    @property
+    def _record(self) -> str:
+        return self._prog.record
+
+    def shard(self, mesh) -> "Engine":
+        """Lay the engine's arrays out over a device mesh: node axis over
+        "tp", pod batch over "dp" (see engine/sharding.py).  GSPMD inserts
+        the node-axis collectives (any/argmax reductions) over ICI.
+
+        Note: the sequential ``schedule`` path wants replicated pod arrays
+        (lax.scan consumes one row per step); ``evaluate_batch`` benefits
+        from the dp sharding.  Shard for the path you will run.
+        """
+        from ksim_tpu.engine import sharding as shlib
+
+        self._node_state = shlib.shard_node_state(self._node_state, mesh)
+        self._pods = shlib.shard_pod_batch(self._pods, mesh)
+        self._aux = shlib.shard_aux(self._aux, self._aux_axes, mesh)
+        return self
+
+    def batch_step(self, state, pods: PodBatch, aux: dict, carries: dict):
+        """Pure jittable batch-evaluation step (un-jitted public form)."""
+        return _Program._batch_fn.__wrapped__(self._prog, state, pods, aux, carries)
+
+    @property
+    def example_args(self):
+        return (self._node_state, self._pods, self._aux, self._prog.init_carries(self._aux))
+
+    def evaluate_batch_chunks(self, *, chunk: int | None = None):
+        """Yield (start, device_out) per pod chunk — the streaming form of
+        ``evaluate_batch``.  Each ``device_out`` is the device-resident
+        result pytree for pods [start, start+chunk); callers decode or
+        transfer it before the next iteration if they want bounded device
+        memory (record="full" at 16k x 8k is ~9GB of result tensors —
+        far more than it costs to recompute, so nothing is retained)."""
+        P = int(self._pods.valid.shape[0])
+        if chunk is None:
+            chunk = min(P, self.SCHEDULE_CHUNK)
+        carries = self._prog.init_carries(self._aux)
+        for s in range(0, P, chunk):
+            pods_c = jax.tree_util.tree_map(
+                lambda x: x[s : s + chunk], self._pods
+            )
+            yield s, self._prog._batch_fn(self._node_state, pods_c, self._aux, carries)
+
+    def evaluate_batch(self, *, chunk: int | None = None) -> EngineResult:
+        """All pods x nodes against the fixed snapshot (no state commit).
+
+        Pod-chunked like ``schedule`` so the recorded result tensors
+        ([P, plugins, N] in record="full") never exceed one chunk's worth
+        of device memory; chunks stream to host and concatenate."""
+        outs = [
+            jax.tree_util.tree_map(np.asarray, out)
+            for _s, out in self.evaluate_batch_chunks(chunk=chunk)
+        ]
+        merged = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *outs
+        )
+        return self._to_result(merged)
+
     # Default pod-axis chunk for the sequential scan.  One device program
     # per chunk bounds both the compiled scan length and the live result
     # buffers (full [P,*,N] stacks at 16k x 8k exceed a v5e chip); the
@@ -343,13 +394,13 @@ class Engine:
         P = int(self._pods.valid.shape[0])
         if chunk is None:
             chunk = min(P, self.SCHEDULE_CHUNK)
-        state, carries = self._node_state, self._init_carries()
+        state, carries = self._node_state, self._prog.init_carries(self._aux)
         outs = []
         for s in range(0, P, chunk):
             pods_c = jax.tree_util.tree_map(
                 lambda x: x[s : s + chunk], self._pods
             )
-            state, carries, out = self._schedule_fn(state, pods_c, self._aux, carries)
+            state, carries, out = self._prog._schedule_fn(state, pods_c, self._aux, carries)
             outs.append(jax.tree_util.tree_map(np.asarray, out))
         merged = jax.tree_util.tree_map(
             lambda *xs: np.concatenate(xs, axis=0), *outs

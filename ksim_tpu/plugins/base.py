@@ -108,3 +108,12 @@ class BatchPlugin(Protocol):
     def score(
         self, state: NodeStateView, pod: PodView, aux: dict, ok=None, **kw
     ) -> jnp.ndarray: ...
+
+    def static_sig(self) -> tuple:
+        """Hashable signature of everything that shapes the TRACED
+        computation (not host-side decode tables).  Two plugin instances
+        with equal signatures must trace identically; the Engine keys its
+        jit cache on these so re-featurizing a same-shaped snapshot reuses
+        compiled programs.  Plugins that don't implement it are keyed by
+        object identity (no cross-instance cache reuse)."""
+        ...

@@ -58,6 +58,9 @@ class InterPodAffinity:
     def __init__(self, ipa: InterPodTensors) -> None:
         del ipa  # all state flows through aux/carry
 
+    def static_sig(self) -> tuple:
+        return (NAME,)
+
     # -- carried state ------------------------------------------------------
 
     def carry_init(self, aux) -> dict:

@@ -60,6 +60,9 @@ class NodeAffinity:
     def decode_reasons(self, bits: int) -> list[str]:
         return [ERR_REASON_POD] if bits else []
 
+    def static_sig(self) -> tuple:
+        return (NAME,)
+
     def score(self, state: NodeStateView, pod: PodView, aux, ok=None) -> jnp.ndarray:
         a = aux["affinity"]
         term_ok = _term_matches(aux)

@@ -65,6 +65,9 @@ class NodeResourcesFit:
             (idx[r], w) for r, w in score_resources if r in idx
         )
 
+    def static_sig(self) -> tuple:
+        return (FIT_NAME, self._base_count, self._score_spec)
+
     # -- filter -------------------------------------------------------------
 
     def filter(self, state: NodeStateView, pod: PodView, aux=None) -> FilterOutput:
@@ -133,6 +136,9 @@ class NodeResourcesBalancedAllocation:
     ) -> None:
         idx = {r: i for i, r in enumerate(resources)}
         self._spec = tuple(idx[r] for r in score_resources if r in idx)
+
+    def static_sig(self) -> tuple:
+        return (BALANCED_NAME, self._spec)
 
     def filter(self, state: NodeStateView, pod: PodView, aux=None) -> FilterOutput:
         n = state.pod_count.shape[0]

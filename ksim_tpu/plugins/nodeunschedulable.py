@@ -29,3 +29,6 @@ class NodeUnschedulable:
 
     def decode_reasons(self, bits: int) -> list[str]:
         return [ERR_REASON_UNSCHEDULABLE] if bits else []
+
+    def static_sig(self) -> tuple:
+        return (NAME,)

@@ -84,6 +84,9 @@ class PodTopologySpread:
         self._sizes = spread.tk_sizes
         self._singleton = spread.tk_singleton
 
+    def static_sig(self) -> tuple:
+        return (NAME, self._mc, self._n_tk, self._sizes, self._singleton)
+
     # -- carried state ------------------------------------------------------
 
     def carry_init(self, aux) -> jnp.ndarray:

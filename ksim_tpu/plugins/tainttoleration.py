@@ -42,6 +42,9 @@ class TaintToleration:
     def __init__(self, taints: TaintTensors) -> None:
         self._taints = taints  # host-side vocab for decode
 
+    def static_sig(self) -> tuple:
+        return (NAME,)  # the vocab only feeds host-side decode
+
     def filter(self, state: NodeStateView, pod: PodView, aux) -> FilterOutput:
         a = aux["taints"]
         order = a["node_taint_order"]  # [N, W]

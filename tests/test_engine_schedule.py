@@ -145,3 +145,35 @@ def test_chunked_schedule_and_batch_match_unchunked():
     bparts = eng.evaluate_batch(chunk=13)
     for field in ("reason_bits", "scores", "final_scores", "total", "feasible", "selected"):
         assert np.array_equal(getattr(bwhole, field), getattr(bparts, field)), field
+
+
+def test_engine_jit_cache_reused_across_instances():
+    """Re-featurizing a same-shaped snapshot must NOT recompile: Engine
+    hashes by (record, plugin static signatures) and shapes key the rest
+    (engine/core.py _sig) — the watch-driven service builds a fresh Engine
+    per pass and relies on this."""
+    from ksim_tpu.engine.core import _Program
+
+    nodes, pods = random_cluster(11, n_nodes=10, n_pods=30, bound_fraction=0.2)
+    queue = [p for p in pods if not p["spec"].get("nodeName")]
+    feats1 = Featurizer().featurize(nodes, pods, queue_pods=queue)
+    eng1 = Engine(feats1, default_plugins(feats1), record="full")
+    res1, _ = eng1.schedule()
+    eng1.evaluate_batch()
+    size_sched = _Program._schedule_fn._cache_size()
+    size_batch = _Program._batch_fn._cache_size()
+
+    # Mutate one pod's requests (same shapes/vocabs), re-featurize: the
+    # compiled programs must be reused AND produce the new values.
+    import copy
+
+    queue2 = copy.deepcopy(queue)
+    queue2[0]["spec"]["containers"][0]["resources"] = {"requests": {"cpu": "3"}}
+    feats2 = Featurizer().featurize(nodes, pods, queue_pods=queue2)
+    eng2 = Engine(feats2, default_plugins(feats2), record="full")
+    assert eng2._prog == eng1._prog and hash(eng2._prog) == hash(eng1._prog)
+    res2, _ = eng2.schedule()
+    eng2.evaluate_batch()
+    assert _Program._schedule_fn._cache_size() == size_sched
+    assert _Program._batch_fn._cache_size() == size_batch
+    assert not np.array_equal(res1.total, res2.total)  # new values flowed
