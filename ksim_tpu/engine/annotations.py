@@ -114,9 +114,13 @@ def render_pod_results(
     plugins: Sequence[ScoredPlugin],
     res: EngineResult,
     pi: int,
+    *,
+    postfilter: dict | None = None,
 ) -> dict[str, str]:
     """The 13 result annotations for queue pod ``pi`` (all keys present,
-    empty maps as "{}", mirroring GetStoredResult's unconditional adds)."""
+    empty maps as "{}", mirroring GetStoredResult's unconditional adds).
+    ``postfilter`` is the {node: {plugin: msg}} map recorded by the
+    PostFilter wrapper when preemption ran (wrappedplugin.go:550-577)."""
     if res.reason_bits is None:
         raise ValueError("render_pod_results needs record='full' results")
     node_names = feats.nodes.names
@@ -180,7 +184,7 @@ def render_pod_results(
         PRE_FILTER_RESULT_KEY: _marshal({}),
         PRE_FILTER_STATUS_KEY: _marshal(prefilter_status),
         FILTER_RESULT_KEY: _marshal(filter_map),
-        POST_FILTER_RESULT_KEY: _marshal({}),
+        POST_FILTER_RESULT_KEY: _marshal(postfilter or {}),
         PRE_SCORE_RESULT_KEY: _marshal(prescore),
         SCORE_RESULT_KEY: _marshal(score_map),
         FINAL_SCORE_RESULT_KEY: _marshal(final_map),

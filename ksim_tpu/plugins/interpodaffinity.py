@@ -61,6 +61,12 @@ class InterPodAffinity:
     def static_sig(self) -> tuple:
         return (NAME,)
 
+    def failure_unresolvable(self, bits: int) -> bool:
+        # Upstream: unmatched required affinity is UnschedulableAndUnresolvable
+        # (removing pods can't create matches); anti-affinity violations are
+        # Unschedulable (victims can clear them).
+        return bool(bits & AFFINITY_BIT)
+
     # -- carried state ------------------------------------------------------
 
     def carry_init(self, aux) -> dict:

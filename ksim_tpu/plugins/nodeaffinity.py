@@ -63,6 +63,11 @@ class NodeAffinity:
     def static_sig(self) -> tuple:
         return (NAME,)
 
+    def failure_unresolvable(self, bits: int) -> bool:
+        # Upstream returns UnschedulableAndUnresolvable: labels don't
+        # change when pods are preempted.
+        return True
+
     def score(self, state: NodeStateView, pod: PodView, aux, ok=None) -> jnp.ndarray:
         a = aux["affinity"]
         term_ok = _term_matches(aux)

@@ -68,6 +68,10 @@ class NodeResourcesFit:
     def static_sig(self) -> tuple:
         return (FIT_NAME, self._base_count, self._score_spec)
 
+    def failure_unresolvable(self, bits: int) -> bool:
+        # Upstream returns Unschedulable: preempting pods frees resources.
+        return False
+
     # -- filter -------------------------------------------------------------
 
     def filter(self, state: NodeStateView, pod: PodView, aux=None) -> FilterOutput:

@@ -32,3 +32,8 @@ class NodeUnschedulable:
 
     def static_sig(self) -> tuple:
         return (NAME,)
+
+    def failure_unresolvable(self, bits: int) -> bool:
+        # Upstream returns UnschedulableAndUnresolvable: removing pods
+        # cannot un-cordon a node.
+        return True

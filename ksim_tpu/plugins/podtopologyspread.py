@@ -87,6 +87,11 @@ class PodTopologySpread:
     def static_sig(self) -> tuple:
         return (NAME, self._mc, self._n_tk, self._sizes, self._singleton)
 
+    def failure_unresolvable(self, bits: int) -> bool:
+        # Upstream: missing topology label is UnschedulableAndUnresolvable;
+        # a skew violation is plain Unschedulable (victims can fix it).
+        return bits == MISSING_LABEL_BIT
+
     # -- carried state ------------------------------------------------------
 
     def carry_init(self, aux) -> jnp.ndarray:

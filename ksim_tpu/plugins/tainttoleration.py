@@ -45,6 +45,11 @@ class TaintToleration:
     def static_sig(self) -> tuple:
         return (NAME,)  # the vocab only feeds host-side decode
 
+    def failure_unresolvable(self, bits: int) -> bool:
+        # Upstream returns UnschedulableAndUnresolvable for untolerated
+        # NoSchedule/NoExecute taints.
+        return True
+
     def filter(self, state: NodeStateView, pod: PodView, aux) -> FilterOutput:
         a = aux["taints"]
         order = a["node_taint_order"]  # [N, W]

@@ -73,10 +73,12 @@ class SchedulerService:
         registry: dict[str, Builder] | None = None,
         record: str = "full",
         featurizer: Featurizer | None = None,
+        preemption: bool = True,
     ) -> None:
         self._store = store
         self._registry = registry or {}
         self._record = record
+        self._preemption = preemption
         # Direct-factory mode (library use) bypasses profile compilation.
         self._plugins_factory = plugins_factory
         self._featurizer_override = featurizer
@@ -183,8 +185,13 @@ class SchedulerService:
         for j, pod in enumerate(queue):
             sel = int(res.selected[j])
             node_name = feats.nodes.names[sel] if sel >= 0 else None
+            nominated, victims, postfilter = None, [], None
+            if node_name is None and self._preemption:
+                nominated, victims, postfilter = self._attempt_preemption(
+                    pod, feats, plugins, res, j
+                )
             anno = (
-                render_pod_results(feats, plugins, res, j)
+                render_pod_results(feats, plugins, res, j, postfilter=postfilter)
                 if self._record == "full"
                 else {}
             )
@@ -196,13 +203,81 @@ class SchedulerService:
                 if node_name:
                     obj.setdefault("spec", {})["nodeName"] = node_name
                     obj.setdefault("status", {})["phase"] = "Running"
+                    # The apiserver clears any earlier nomination on bind.
+                    obj.get("status", {}).pop("nominatedNodeName", None)
+                elif nominated:
+                    obj.setdefault("status", {})["nominatedNodeName"] = nominated
 
             updated = self._store.patch(
                 "pods", name_of(pod), namespace_of(pod), mutate
             )
             with self._own_rvs_lock:
                 self._own_rvs.add(updated["metadata"]["resourceVersion"])
+            # Evict the victims (the debuggable scheduler deletes them via
+            # the apiserver; KWOK terminates immediately).  The DELETED
+            # events trigger the next pass, which schedules the preemptor.
+            for v in victims:
+                try:
+                    self._store.delete("pods", name_of(v), namespace_of(v))
+                except Exception:
+                    logger.exception("failed to evict victim %s", name_of(v))
             placements[f"{namespace_of(pod)}/{name_of(pod)}"] = node_name
+
+    def _attempt_preemption(self, pod, feats, plugins, res, j):
+        """DefaultPreemption for one unschedulable pod (PostFilter).
+        Returns (nominated_node, victims, postfilter_annotation_map)."""
+        from ksim_tpu.scheduler import preemption as pre
+
+        n_valid = feats.nodes.count
+        failed_nodes = feats.nodes.names[:n_valid]
+        live_mask = None
+        if res.reason_bits is not None:
+            mask = self._resolvable_mask(plugins, res.reason_bits[j], n_valid)
+            if not mask.any():
+                return None, [], pre.render_postfilter_result(failed_nodes, None)
+            # feats node order == store list order at featurize time; nodes
+            # may have changed since — map the mask by name.
+            mask_by_name = {
+                feats.nodes.names[i]: bool(mask[i]) for i in range(n_valid)
+            }
+        # Preemption dry-runs against the LIVE store (upstream uses the
+        # live cache in PostFilter) — earlier preemptions in this pass
+        # already removed their victims.
+        nodes = self._store.list("nodes")
+        cluster_pods = self._store.list("pods")
+        namespaces = self._store.list("namespaces")
+        if res.reason_bits is not None:
+            live_mask = [mask_by_name.get(name_of(n), False) for n in nodes]
+        decision = pre.find_preemption(
+            pod, nodes, cluster_pods, candidate_mask=live_mask, namespaces=namespaces
+        )
+        post = pre.render_postfilter_result(failed_nodes, decision.nominated_node)
+        return decision.nominated_node, decision.victims, post
+
+    @staticmethod
+    def _resolvable_mask(plugins, bits, n_valid):
+        """bool [N]: nodes whose FIRST failing filter plugin (upstream
+        Filter chains stop there) reports a preemption-resolvable failure."""
+        import numpy as np
+
+        filter_plugins = [sp for sp in plugins if sp.filter_enabled]
+        failing = bits != 0  # [F, N]
+        fail_any = failing.any(axis=0)
+        first = np.argmax(failing, axis=0)
+        mask = np.zeros(bits.shape[1], dtype=bool)
+        for fi, sp in enumerate(filter_plugins):
+            sel = fail_any & (first == fi)
+            if not sel.any():
+                continue
+            rule = getattr(sp.plugin, "failure_unresolvable", None)
+            if rule is None:
+                continue  # unknown plugin: conservatively unresolvable
+            resolvable = {
+                int(b): not rule(int(b)) for b in np.unique(bits[fi, sel])
+            }
+            mask[sel] = [resolvable[int(b)] for b in bits[fi, sel]]
+        mask[n_valid:] = False
+        return mask
 
     # -- watch loop ---------------------------------------------------------
 

@@ -1,0 +1,142 @@
+"""DefaultPreemption (PostFilter) tests — victim selection semantics and
+the service-level eviction + annotation flow (reference records at
+simulator/scheduler/plugin/wrappedplugin.go:550-577)."""
+
+from __future__ import annotations
+
+import json
+
+from ksim_tpu.engine.annotations import POST_FILTER_RESULT_KEY, SELECTED_NODE_KEY
+from ksim_tpu.scheduler.preemption import (
+    find_preemption,
+    render_postfilter_result,
+)
+from ksim_tpu.scheduler.service import SchedulerService
+from ksim_tpu.state.cluster import ClusterStore
+from tests.helpers import make_node, make_pod
+
+
+def _bound(name, node, cpu, prio, ts="2024-01-01T00:00:00Z"):
+    p = make_pod(name, cpu=cpu, memory=None, node_name=node, priority=prio)
+    p["metadata"]["creationTimestamp"] = ts
+    return p
+
+
+def test_find_preemption_minimal_victims():
+    # Node full: 4 x 1cpu victims (prio 1,2,3,4); preemptor needs 2cpu.
+    nodes = [make_node("n0", cpu="4", memory="8Gi")]
+    pods = [_bound(f"v{i}", "n0", "1", i + 1) for i in range(4)]
+    preemptor = make_pod("big", cpu="2", memory=None, priority=10)
+    d = find_preemption(preemptor, nodes, pods)
+    assert d.nominated_node == "n0"
+    # Reprieve order keeps the most important victims: prio 4 and 3 are
+    # re-added (2cpu free suffices), prio 2 and 1 are evicted.
+    assert sorted(v["metadata"]["name"] for v in d.victims) == ["v0", "v1"]
+
+
+def test_find_preemption_respects_priority_and_policy():
+    nodes = [make_node("n0", cpu="2", memory="8Gi")]
+    pods = [_bound("equal", "n0", "2", 10)]
+    # Same priority -> no potential victims.
+    preemptor = make_pod("p", cpu="1", memory=None, priority=10)
+    assert find_preemption(preemptor, nodes, pods).nominated_node is None
+    # preemptionPolicy Never opts out entirely.
+    lower = [_bound("low", "n0", "2", 1)]
+    never = make_pod("p2", cpu="1", memory=None, priority=10)
+    never["spec"]["preemptionPolicy"] = "Never"
+    assert find_preemption(never, nodes, lower).nominated_node is None
+    # Default policy preempts the lower-priority pod.
+    ok = make_pod("p3", cpu="1", memory=None, priority=10)
+    d = find_preemption(ok, nodes, lower)
+    assert d.nominated_node == "n0"
+    assert [v["metadata"]["name"] for v in d.victims] == ["low"]
+
+
+def test_pick_node_prefers_lower_priority_victims():
+    # Two candidate nodes; n1's victim has lower priority -> chosen.
+    nodes = [make_node("n0", cpu="1", memory="8Gi"), make_node("n1", cpu="1", memory="8Gi")]
+    pods = [_bound("hi", "n0", "1", 5), _bound("lo", "n1", "1", 2)]
+    preemptor = make_pod("p", cpu="1", memory=None, priority=10)
+    d = find_preemption(preemptor, nodes, pods)
+    assert d.nominated_node == "n1"
+    assert [v["metadata"]["name"] for v in d.victims] == ["lo"]
+
+
+def test_render_postfilter_shape():
+    out = render_postfilter_result(["a", "b"], "b")
+    assert out == {"a": {}, "b": {"DefaultPreemption": "preemption victim"}}
+    assert render_postfilter_result(["a"], None) == {"a": {}}
+
+
+def test_service_preempts_and_reschedules():
+    store = ClusterStore()
+    store.create("nodes", make_node("n0", cpu="2", memory="8Gi"))
+    for i in range(2):
+        store.create("pods", _bound(f"low{i}", "n0", "1", 1))
+    svc = SchedulerService(store)
+    # High-priority pod cannot fit -> preemption evicts a victim.
+    store.create("pods", make_pod("crit", cpu="1", memory=None, priority=100))
+    placements = svc.schedule_pending()
+    assert placements == {"default/crit": None}
+    crit = store.get("pods", "crit")
+    post = json.loads(crit["metadata"]["annotations"][POST_FILTER_RESULT_KEY])
+    assert post == {"n0": {"DefaultPreemption": "preemption victim"}}
+    assert crit["status"]["nominatedNodeName"] == "n0"
+    # Exactly one victim evicted (minimal set).
+    remaining = [p["metadata"]["name"] for p in store.list("pods")]
+    assert len(remaining) == 2 and "crit" in remaining
+    # Next pass binds the preemptor onto the freed capacity.
+    placements = svc.schedule_pending()
+    assert placements == {"default/crit": "n0"}
+    crit = store.get("pods", "crit")
+    assert crit["spec"]["nodeName"] == "n0"
+    assert crit["metadata"]["annotations"][SELECTED_NODE_KEY] == "n0"
+
+
+def test_service_no_preemption_when_unresolvable():
+    # Unschedulable node: failure is UnschedulableAndUnresolvable -> no
+    # candidates, postfilter records the failed node with no nomination.
+    store = ClusterStore()
+    store.create("nodes", make_node("n0", cpu="2", memory="8Gi", unschedulable=True))
+    store.create("pods", _bound("low", "n0", "1", 1, ts="2024-01-01T00:00:01Z"))
+    svc = SchedulerService(store)
+    store.create("pods", make_pod("crit", cpu="1", memory=None, priority=100))
+    placements = svc.schedule_pending()
+    assert placements == {"default/crit": None}
+    crit = store.get("pods", "crit")
+    post = json.loads(crit["metadata"]["annotations"][POST_FILTER_RESULT_KEY])
+    assert post == {"n0": {}}
+    assert "nominatedNodeName" not in crit.get("status", {})
+    assert len(store.list("pods")) == 2  # nothing evicted
+
+
+def test_pick_node_latest_high_priority_victim_start():
+    # Tie on priority/sum/count; upstream compares the earliest start of
+    # the HIGHEST-priority victims and picks the latest such node.
+    nodes = [make_node("a", cpu="2", memory="8Gi"), make_node("b", cpu="2", memory="8Gi")]
+    pods = [
+        _bound("a-hi", "a", "1", 5, ts="2024-01-05T00:00:00Z"),
+        _bound("a-lo", "a", "1", 1, ts="2024-01-01T00:00:00Z"),
+        _bound("b-hi", "b", "1", 5, ts="2024-01-03T00:00:00Z"),
+        _bound("b-lo", "b", "1", 1, ts="2024-01-02T00:00:00Z"),
+    ]
+    preemptor = make_pod("p", cpu="2", memory=None, priority=10)
+    d = find_preemption(preemptor, nodes, pods)
+    assert d.nominated_node == "a"  # 01-05 > 01-03 among prio-5 victims
+
+
+def test_service_preemption_without_full_record():
+    # record="final" has no reason bits; preemption still runs with an
+    # unrestricted candidate mask (no annotations in this mode).
+    store = ClusterStore()
+    store.create("nodes", make_node("n0", cpu="2", memory="8Gi"))
+    store.create("pods", _bound("low", "n0", "2", 1))
+    svc = SchedulerService(store, record="final")
+    store.create("pods", make_pod("crit", cpu="1", memory=None, priority=100))
+    assert svc.schedule_pending() == {"default/crit": None}
+    crit = store.get("pods", "crit")
+    assert crit["status"]["nominatedNodeName"] == "n0"
+    assert [p["metadata"]["name"] for p in store.list("pods")] == ["crit"]
+    assert svc.schedule_pending() == {"default/crit": "n0"}
+    # Binding clears the nomination, like the apiserver does.
+    assert "nominatedNodeName" not in store.get("pods", "crit")["status"]
