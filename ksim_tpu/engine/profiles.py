@@ -9,8 +9,11 @@ KubeSchedulerConfiguration-driven profile compiler lives in sched/config.
 from __future__ import annotations
 
 from ksim_tpu.engine.core import ScoredPlugin
+from ksim_tpu.plugins.imagelocality import ImageLocality
 from ksim_tpu.plugins.interpodaffinity import InterPodAffinity
 from ksim_tpu.plugins.nodeaffinity import NodeAffinity
+from ksim_tpu.plugins.nodename import NodeName
+from ksim_tpu.plugins.nodeports import NodePorts
 from ksim_tpu.plugins.nodeunschedulable import NodeUnschedulable
 from ksim_tpu.plugins.noderesources import (
     NodeResourcesBalancedAllocation,
@@ -23,16 +26,18 @@ from ksim_tpu.state.featurizer import FeaturizedSnapshot
 
 def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
     """Upstream default-profile weights: BalancedAllocation 1, Fit 1,
-    NodeAffinity 2, PodTopologySpread 2, InterPodAffinity 2,
-    TaintToleration 3 (default_plugins.go)."""
+    ImageLocality 1, NodeAffinity 2, PodTopologySpread 2,
+    InterPodAffinity 2, TaintToleration 3 (default_plugins.go)."""
     # Filter order follows upstream MultiPoint registration order
-    # (default_plugins.go): NodeUnschedulable, TaintToleration,
-    # NodeAffinity, NodeResourcesFit, PodTopologySpread, InterPodAffinity —
-    # early-exit filter-result recording depends on it.
+    # (default_plugins.go): NodeUnschedulable, NodeName, TaintToleration,
+    # NodeAffinity, NodePorts, NodeResourcesFit, PodTopologySpread,
+    # InterPodAffinity — early-exit filter-result recording depends on it.
     return (
         ScoredPlugin(NodeUnschedulable(), score_enabled=False),
+        ScoredPlugin(NodeName(), score_enabled=False),
         ScoredPlugin(TaintToleration(feats.aux["taints"]), weight=3),
         ScoredPlugin(NodeAffinity(), weight=2),
+        ScoredPlugin(NodePorts(), score_enabled=False),
         ScoredPlugin(NodeResourcesFit(feats.resources), weight=1),
         ScoredPlugin(
             NodeResourcesBalancedAllocation(feats.resources),
@@ -41,4 +46,9 @@ def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
         ),
         ScoredPlugin(PodTopologySpread(feats.aux["spread"]), weight=2),
         ScoredPlugin(InterPodAffinity(feats.aux["interpod"]), weight=2),
+        ScoredPlugin(
+            ImageLocality(feats.aux["imagelocality"]),
+            weight=1,
+            filter_enabled=False,
+        ),
     )

@@ -1,0 +1,64 @@
+"""ImageLocality score plugin.
+
+Upstream kube-scheduler v1.30 ``plugins/imagelocality/image_locality.go``:
+
+- per container image present on the node, ``scaledImageScore`` =
+  ``int64(size * numNodes / totalNodes)`` (image-spread discount);
+- ``calculatePriority``: clamp the sum to [23MB, 1000MB * containers] and
+  map linearly onto [0, MaxNodeScore] with int64 truncation.
+
+No NormalizeScore (upstream registers Score only).  float64 under x64
+matches Go exactly; float32 on TPU carries a documented ±1 rounding
+tolerance at truncation boundaries (same caveat as the other float-path
+scores).  Encoding: state/extras.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ksim_tpu.plugins.base import MAX_NODE_SCORE, NodeStateView, PodView
+from ksim_tpu.state.extras import ImageTensors
+
+NAME = "ImageLocality"
+
+MB = 1024 * 1024
+MIN_THRESHOLD = 23 * MB
+MAX_CONTAINER_THRESHOLD = 1000 * MB
+
+
+class ImageLocality:
+    name = NAME
+
+    def __init__(self, img: ImageTensors) -> None:
+        del img  # all state flows through aux
+
+    def static_sig(self) -> tuple:
+        return (NAME,)
+
+    # Score-only plugin: every registration site disables the filter
+    # point, so no filter method exists.
+
+    def score(self, state: NodeStateView, pod: PodView, aux, ok=None) -> jnp.ndarray:
+        a = aux["imagelocality"]
+        ft = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        j = pod.index
+        # scaledImageScore per vocab image (int64 truncation per image).
+        spread = a["image_num_nodes"].astype(ft) / a["total_nodes_f"].astype(ft)
+        scaled = jnp.trunc(a["image_size"].astype(ft) * spread)  # [I]
+        counts = a["pod_image_count"][j].astype(ft)  # [I]
+        sum_scores = jnp.dot(a["node_has_image"].astype(ft), scaled * counts)  # [N]
+        n_cont = a["pod_num_containers"][j].astype(ft)
+        max_threshold = ft(MAX_CONTAINER_THRESHOLD) * n_cont
+        clamped = jnp.clip(
+            sum_scores,
+            ft(MIN_THRESHOLD),
+            jnp.maximum(max_threshold, ft(MIN_THRESHOLD)),
+        )
+        val = (
+            ft(MAX_NODE_SCORE)
+            * (clamped - ft(MIN_THRESHOLD))
+            / jnp.maximum(max_threshold - ft(MIN_THRESHOLD), 1.0)
+        )
+        return jnp.trunc(val).astype(jnp.int32)

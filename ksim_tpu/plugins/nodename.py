@@ -1,0 +1,37 @@
+"""NodeName filter plugin.
+
+Upstream kube-scheduler v1.30 ``plugins/nodename/node_name.go``: a pod
+naming a specific node in ``spec.nodeName`` fails every other node with
+``node(s) didn't match the requested node name``; pods without a request
+pass everywhere.  Encoding: state/extras.py (requested node index, -2 for
+a name not in the snapshot).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ksim_tpu.plugins.base import FilterOutput, NodeStateView, PodView
+
+NAME = "NodeName"
+ERR_REASON = "node(s) didn't match the requested node name"
+
+
+class NodeName:
+    name = NAME
+
+    def static_sig(self) -> tuple:
+        return (NAME,)
+
+    def failure_unresolvable(self, bits: int) -> bool:
+        # Upstream returns UnschedulableAndUnresolvable.
+        return True
+
+    def filter(self, state: NodeStateView, pod: PodView, aux) -> FilterOutput:
+        req = aux["nodename"]["pod_req_node"][pod.index]  # scalar
+        n = state.valid.shape[0]
+        ok = (req == -1) | (jnp.arange(n) == req)
+        return FilterOutput(ok=ok, reason_bits=jnp.where(ok, 0, 1).astype(jnp.int32))
+
+    def decode_reasons(self, bits: int) -> list[str]:
+        return [ERR_REASON] if bits else []

@@ -1,0 +1,52 @@
+"""NodePorts filter plugin.
+
+Upstream kube-scheduler v1.30 ``plugins/nodeports/node_ports.go``: each of
+the pod's requested host ports must be free on the node; conflicts follow
+(protocol, port, hostIP-with-0.0.0.0-wildcard) semantics.  Failure reason:
+``node(s) didn't have free ports for the requested pod ports``.
+
+Encoding: state/extras.py builds a vocabulary of the queue pods' wanted
+(ip, proto, port) triples; the scan carry is the per-node conflict count
+per vocab entry, committed with an elementwise outer-product add (same
+no-gather/no-scatter scheme as the other carried plugins).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ksim_tpu.plugins.base import FilterOutput, NodeStateView, PodView
+
+NAME = "NodePorts"
+ERR_REASON = "node(s) didn't have free ports for the requested pod ports"
+
+
+class NodePorts:
+    name = NAME
+
+    def static_sig(self) -> tuple:
+        return (NAME,)
+
+    def failure_unresolvable(self, bits: int) -> bool:
+        # Upstream returns Unschedulable: evicting the conflicting pod
+        # frees the port.
+        return False
+
+    def carry_init(self, aux) -> jnp.ndarray:
+        return aux["nodeports"]["conflict_counts"]  # i32 [N, V]
+
+    def carry_commit(self, carry, aux, pod: PodView, best) -> jnp.ndarray:
+        adds = aux["nodeports"]["pod_adds"][pod.index]  # [V]
+        onehot = (jnp.arange(carry.shape[0]) == best) & (best >= 0)
+        return carry + onehot.astype(carry.dtype)[:, None] * adds[None, :]
+
+    def filter(self, state: NodeStateView, pod: PodView, aux, carry) -> FilterOutput:
+        wants = aux["nodeports"]["pod_wants"][pod.index]  # bool [V]
+        conflict = jnp.dot(
+            (carry > 0).astype(jnp.int32), wants.astype(jnp.int32)
+        )  # [N]
+        ok = conflict == 0
+        return FilterOutput(ok=ok, reason_bits=jnp.where(ok, 0, 1).astype(jnp.int32))
+
+    def decode_reasons(self, bits: int) -> list[str]:
+        return [ERR_REASON] if bits else []

@@ -638,3 +638,101 @@ def balanced_allocation_score(
         mean = total / len(fractions)
         std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
     return int((1 - std) * float(MAX_NODE_SCORE))
+
+
+# -- NodeName ---------------------------------------------------------------
+
+
+ERR_NODE_NAME = "node(s) didn't match the requested node name"
+
+
+def node_name_filter(pod: JSON, info: NodeInfo) -> list[str]:
+    """Upstream nodename/node_name.go Fits."""
+    want = pod.get("spec", {}).get("nodeName") or ""
+    if not want or want == info["name"]:
+        return []
+    return [ERR_NODE_NAME]
+
+
+# -- NodePorts --------------------------------------------------------------
+
+
+ERR_NODE_PORTS = "node(s) didn't have free ports for the requested pod ports"
+
+
+def node_ports_filter(pod: JSON, pods_on_node: Sequence[JSON]) -> list[str]:
+    """Upstream nodeports/node_ports.go Fits over the node's existing
+    pods' (hostIP, protocol, hostPort) triples."""
+    from ksim_tpu.state.extras import _host_ports, ports_conflict
+
+    wants = _host_ports(pod)
+    if not wants:
+        return []
+    existing = [t for p in pods_on_node for t in _host_ports(p)]
+    for w in wants:
+        for e in existing:
+            if ports_conflict(w, e):
+                return [ERR_NODE_PORTS]
+    return []
+
+
+# -- ImageLocality ----------------------------------------------------------
+
+
+IL_MB = 1024 * 1024
+IL_MIN_THRESHOLD = 23 * IL_MB
+IL_MAX_CONTAINER_THRESHOLD = 1000 * IL_MB
+
+
+def build_image_states(nodes: Sequence[JSON]) -> dict[str, tuple[int, int]]:
+    """normalized image name -> (sizeBytes, numNodes) — the scheduler
+    cache's ImageStateSummary."""
+    from ksim_tpu.state.extras import normalized_image_name
+
+    sizes: dict[str, int] = {}
+    num: dict[str, int] = {}
+    for node in nodes:
+        seen: set[str] = set()
+        for img in node.get("status", {}).get("images") or []:
+            sz = int(img.get("sizeBytes") or 0)
+            for nm in img.get("names") or []:
+                key = normalized_image_name(nm)
+                if key in seen:
+                    continue
+                seen.add(key)
+                sizes[key] = max(sizes.get(key, 0), sz)
+                num[key] = num.get(key, 0) + 1
+    return {k: (sizes[k], num[k]) for k in sizes}
+
+
+def image_locality_score(
+    pod: JSON,
+    node: JSON,
+    image_states: dict[str, tuple[int, int]],
+    total_nodes: int,
+) -> int:
+    """Upstream imagelocality/image_locality.go Score (sumImageScores +
+    calculatePriority), float64 exact."""
+    from ksim_tpu.state.extras import normalized_image_name
+
+    node_images = {
+        normalized_image_name(nm)
+        for img in node.get("status", {}).get("images") or []
+        for nm in img.get("names") or []
+    }
+    containers = pod.get("spec", {}).get("containers") or []
+    sum_scores = 0
+    for c in containers:
+        name = normalized_image_name(c.get("image") or "")
+        if name in node_images and name in image_states:
+            size, nn = image_states[name]
+            # Go evaluates size * (nn/total): the spread ratio FIRST, so
+            # the float64 rounding point matches (int(size*nn/total) can
+            # differ by 1 at ~1-in-4000 triples).
+            sum_scores += int(float(size) * (float(nn) / float(total_nodes)))
+    max_threshold = IL_MAX_CONTAINER_THRESHOLD * len(containers)
+    clamped = min(max(sum_scores, IL_MIN_THRESHOLD), max(max_threshold, IL_MIN_THRESHOLD))
+    denom = max_threshold - IL_MIN_THRESHOLD
+    if denom <= 0:
+        return 0
+    return int(100 * (clamped - IL_MIN_THRESHOLD) / denom)

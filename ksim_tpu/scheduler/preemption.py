@@ -127,9 +127,13 @@ class _FitState:
         info = self.infos[node_idx]
         if oracle.node_unschedulable_filter(pod, info):
             return False
+        if oracle.node_name_filter(pod, info):
+            return False
         if oracle.taint_toleration_filter(pod, info):
             return False
         if oracle.node_affinity_filter(pod, info):
+            return False
+        if oracle.node_ports_filter(pod, self.pbn.get(info["name"], [])):
             return False
         if oracle.fit_filter(pod, info):
             return False

@@ -140,14 +140,37 @@ def _build_interpod(feats, args):
     return ScoredPlugin(InterPodAffinity(feats.aux["interpod"]))
 
 
+def _build_node_name(feats, args):
+    from ksim_tpu.plugins.nodename import NodeName
+
+    return ScoredPlugin(NodeName(), score_enabled=False)
+
+
+def _build_node_ports(feats, args):
+    from ksim_tpu.plugins.nodeports import NodePorts
+
+    return ScoredPlugin(NodePorts(), score_enabled=False)
+
+
+def _build_image_locality(feats, args):
+    from ksim_tpu.plugins.imagelocality import ImageLocality
+
+    return ScoredPlugin(
+        ImageLocality(feats.aux["imagelocality"]), filter_enabled=False
+    )
+
+
 INTREE_BUILDERS: dict[str, Builder] = {
     "NodeUnschedulable": _build_node_unschedulable,
+    "NodeName": _build_node_name,
     "NodeResourcesFit": _build_fit,
     "NodeResourcesBalancedAllocation": _build_balanced,
     "TaintToleration": _build_taints,
     "NodeAffinity": _build_node_affinity,
+    "NodePorts": _build_node_ports,
     "PodTopologySpread": _build_spread,
     "InterPodAffinity": _build_interpod,
+    "ImageLocality": _build_image_locality,
 }
 
 

@@ -121,6 +121,10 @@ class SchedulerService:
             return False
         if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
             return False
+        # SchedulingGates (upstream PreEnqueue): gated pods never enter
+        # the scheduling queue until every gate is removed.
+        if pod.get("spec", {}).get("schedulingGates"):
+            return False
         name = pod.get("spec", {}).get("schedulerName") or DEFAULT_SCHEDULER_NAME
         return name in self._scheduler_names
 

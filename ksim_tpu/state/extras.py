@@ -1,0 +1,211 @@
+"""Encodings for the lighter default-profile plugins: NodeName, NodePorts,
+ImageLocality.
+
+Same host/device split as the other encoders (state/encoding.py): exact
+vocabulary construction and matching in Python, fixed-shape int/bool
+tensors for the kernels (the reference exercises these plugins through its
+wrapped-plugin recording, reference simulator/scheduler/plugin/
+wrappedplugin.go:420-548; semantics re-derived from upstream
+kube-scheduler v1.30 plugins/{nodename,nodeports,imagelocality}).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from ksim_tpu.state.resources import JSON, name_of
+
+# Upstream nodeports: empty hostIP means "bind all".
+BIND_ALL_IP = "0.0.0.0"
+DEFAULT_PROTOCOL = "TCP"
+
+
+@dataclass
+class NodeNameTensors:
+    """pod_req_node: requested node's index, -1 = no request, -2 = the
+    requested node is not in the snapshot (always fails)."""
+
+    AXES = {"pod_req_node": "pod"}
+
+    pod_req_node: np.ndarray  # i32 [P]
+
+
+def encode_node_name(
+    nodes: Sequence[JSON], pods: Sequence[JSON], p_padded: int
+) -> NodeNameTensors:
+    index = {name_of(n): i for i, n in enumerate(nodes)}
+    out = np.full(p_padded, -1, dtype=np.int32)
+    for j, p in enumerate(pods):
+        want = p.get("spec", {}).get("nodeName") or ""
+        if want:
+            out[j] = index.get(want, -2)
+    return NodeNameTensors(pod_req_node=out)
+
+
+def _host_ports(pod: JSON) -> list[tuple[str, str, int]]:
+    """The pod's (hostIP, protocol, hostPort) triples, upstream
+    getContainerPorts (hostPort == 0 entries are ignored)."""
+    out = []
+    for c in pod.get("spec", {}).get("containers") or []:
+        for port in c.get("ports") or []:
+            hp = int(port.get("hostPort") or 0)
+            if hp <= 0:
+                continue
+            out.append(
+                (
+                    port.get("hostIP") or BIND_ALL_IP,
+                    port.get("protocol") or DEFAULT_PROTOCOL,
+                    hp,
+                )
+            )
+    return out
+
+
+def ports_conflict(a: tuple[str, str, int], b: tuple[str, str, int]) -> bool:
+    """Upstream nodeports Fits / schedutil.PortsConflict semantics."""
+    if a[1] != b[1] or a[2] != b[2]:
+        return False
+    return a[0] == b[0] or a[0] == BIND_ALL_IP or b[0] == BIND_ALL_IP
+
+
+@dataclass
+class NodePortTensors:
+    """V = distinct wanted-port triples across queue pods.
+
+    ``conflict_counts`` [N, V] counts existing (bound) pod ports on each
+    node conflicting with vocab entry v — the scan carry.  ``pod_wants``
+    marks the pod's own triples; ``pod_adds`` counts how many of the
+    pod's triples conflict with each vocab entry (the commit delta)."""
+
+    AXES = {
+        "conflict_counts": "node",
+        "pod_wants": "pod",
+        "pod_adds": "pod",
+    }
+
+    conflict_counts: np.ndarray  # i32 [N, V]
+    pod_wants: np.ndarray  # bool [P, V]
+    pod_adds: np.ndarray  # i32 [P, V]
+
+
+def encode_node_ports(
+    nodes: Sequence[JSON],
+    pods: Sequence[JSON],
+    bound_pods: Sequence[JSON],
+    n_padded: int,
+    p_padded: int,
+) -> NodePortTensors:
+    vocab: dict[tuple[str, str, int], int] = {}
+    pod_ports = [_host_ports(p) for p in pods]
+    for ports in pod_ports:
+        for t in ports:
+            vocab.setdefault(t, len(vocab))
+    v = max(len(vocab), 1)
+    entries = list(vocab)
+
+    conflict_counts = np.zeros((n_padded, v), dtype=np.int32)
+    node_index = {name_of(n): i for i, n in enumerate(nodes)}
+    for bp in bound_pods:
+        ni = node_index.get(bp.get("spec", {}).get("nodeName", ""))
+        if ni is None:
+            continue
+        for t in _host_ports(bp):
+            for vi, entry in enumerate(entries):
+                if ports_conflict(t, entry):
+                    conflict_counts[ni, vi] += 1
+
+    pod_wants = np.zeros((p_padded, v), dtype=bool)
+    pod_adds = np.zeros((p_padded, v), dtype=np.int32)
+    for j, ports in enumerate(pod_ports):
+        for t in ports:
+            pod_wants[j, vocab[t]] = True
+            for vi, entry in enumerate(entries):
+                if ports_conflict(t, entry):
+                    pod_adds[j, vi] += 1
+    return NodePortTensors(
+        conflict_counts=conflict_counts, pod_wants=pod_wants, pod_adds=pod_adds
+    )
+
+
+def normalized_image_name(name: str) -> str:
+    """Upstream imagelocality normalizedImageName: append :latest when no
+    tag/digest is present."""
+    if ":" not in name.rsplit("/", 1)[-1]:
+        name = name + ":latest"
+    return name
+
+
+@dataclass
+class ImageTensors:
+    """I = distinct (normalized) images across queue pods' containers.
+
+    Sizes/spread come from node.status.images summaries; scores follow
+    upstream scaledImageScore + calculatePriority."""
+
+    AXES = {
+        "node_has_image": "node",
+        "image_size": None,
+        "image_num_nodes": None,
+        "total_nodes_f": None,
+        "pod_image_count": "pod",
+        "pod_num_containers": "pod",
+    }
+
+    total_nodes: int  # real node count (info; device reads total_nodes_f)
+    total_nodes_f: np.ndarray  # f64 scalar (traced so churn reuses programs)
+    node_has_image: np.ndarray  # bool [N, I]
+    image_size: np.ndarray  # f64 [I] bytes (sizeBytes summary)
+    image_num_nodes: np.ndarray  # i32 [I] nodes reporting the image
+    pod_image_count: np.ndarray  # i32 [P, I] containers using image i
+    pod_num_containers: np.ndarray  # i32 [P]
+
+
+def encode_image_locality(
+    nodes: Sequence[JSON],
+    pods: Sequence[JSON],
+    n_padded: int,
+    p_padded: int,
+) -> ImageTensors:
+    vocab: dict[str, int] = {}
+    pod_imgs: list[list[int]] = []
+    n_containers = np.zeros(p_padded, dtype=np.int32)
+    for j, p in enumerate(pods):
+        containers = p.get("spec", {}).get("containers") or []
+        n_containers[j] = len(containers)
+        imgs = []
+        for c in containers:
+            img = c.get("image") or ""
+            if img:
+                imgs.append(vocab.setdefault(normalized_image_name(img), len(vocab)))
+        pod_imgs.append(imgs)
+
+    i = max(len(vocab), 1)
+    node_has = np.zeros((n_padded, i), dtype=bool)
+    size = np.zeros(i, dtype=np.float64)
+    num_nodes = np.zeros(i, dtype=np.int32)
+    for ni, node in enumerate(nodes):
+        for img in node.get("status", {}).get("images") or []:
+            sz = float(img.get("sizeBytes") or 0)
+            for nm in img.get("names") or []:
+                vi = vocab.get(normalized_image_name(nm))
+                if vi is not None and not node_has[ni, vi]:
+                    node_has[ni, vi] = True
+                    num_nodes[vi] += 1
+                    size[vi] = max(size[vi], sz)
+
+    pod_image_count = np.zeros((p_padded, i), dtype=np.int32)
+    for j, imgs in enumerate(pod_imgs):
+        for vi in imgs:
+            pod_image_count[j, vi] += 1
+    return ImageTensors(
+        total_nodes=max(len(nodes), 1),
+        total_nodes_f=np.asarray(float(max(len(nodes), 1))),
+        node_has_image=node_has,
+        image_size=size,
+        image_num_nodes=num_nodes,
+        pod_image_count=pod_image_count,
+        pod_num_containers=n_containers,
+    )

@@ -269,6 +269,11 @@ class Featurizer:
             encode_taints,
             encode_topology_spread,
         )
+        from ksim_tpu.state.extras import (
+            encode_image_locality,
+            encode_node_name,
+            encode_node_ports,
+        )
         from ksim_tpu.state.interpod import encode_inter_pod
 
         aux = {
@@ -279,6 +284,9 @@ class Featurizer:
                 nodes, sched_pods, bound_pods, namespaces, NP, PP,
                 hard_weight=self._interpod_hard_weight,
             ),
+            "nodename": encode_node_name(nodes, sched_pods, PP),
+            "nodeports": encode_node_ports(nodes, sched_pods, bound_pods, NP, PP),
+            "imagelocality": encode_image_locality(nodes, sched_pods, NP, PP),
         }
 
         return FeaturizedSnapshot(

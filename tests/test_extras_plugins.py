@@ -1,0 +1,147 @@
+"""NodeName, NodePorts, ImageLocality kernels vs the oracle, plus
+SchedulingGates enforcement and the skipped-plugin surface."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ksim_tpu.engine import Engine
+from ksim_tpu.engine.profiles import default_plugins
+from ksim_tpu.plugins import oracle
+from ksim_tpu.scheduler.profile import compile_profile
+from ksim_tpu.scheduler.service import SchedulerService
+from ksim_tpu.state.cluster import ClusterStore
+from ksim_tpu.state.featurizer import Featurizer
+from tests.helpers import make_node, make_pod, random_cluster
+
+
+def _with_ports(pod, ports):
+    pod["spec"]["containers"][0]["ports"] = ports
+    return pod
+
+
+def _with_images(node, images):
+    node["status"]["images"] = images
+    return node
+
+
+def _run(nodes, pods, queue):
+    feats = Featurizer().featurize(nodes, pods, queue_pods=queue)
+    eng = Engine(feats, default_plugins(feats), record="full")
+    res, _ = eng.schedule()
+    return feats, res
+
+
+def _plugin_col(res, name, kind="filter"):
+    names = res.filter_plugin_names if kind == "filter" else res.plugin_names
+    return names.index(name)
+
+
+def test_node_name_filter():
+    nodes = [make_node("a"), make_node("b")]
+    q1 = make_pod("wants-b")
+    q1["spec"]["nodeName"] = ""  # no request
+    q2 = make_pod("explicit")
+    feats = Featurizer().featurize(nodes, [], queue_pods=[q1, q2])
+    # Simulate a queue pod carrying a node request through aux encoding.
+    q3 = make_pod("ghost")
+    q3["spec"]["nodeName"] = "missing"
+    feats2 = Featurizer().featurize(nodes, [], queue_pods=[q3])
+    assert feats.aux["nodename"].pod_req_node[0] == -1
+    assert feats2.aux["nodename"].pod_req_node[0] == -2
+    eng = Engine(feats2, default_plugins(feats2), record="full")
+    res = eng.evaluate_batch()
+    fi = _plugin_col(res, "NodeName")
+    assert (res.reason_bits[0, fi, :2] != 0).all()  # fails everywhere
+    assert int(res.selected[0]) == -1
+
+
+def test_node_ports_conflict_and_commit():
+    nodes = [make_node("a"), make_node("b")]
+    bound = _with_ports(
+        make_pod("existing", node_name="a"), [{"hostPort": 8080, "protocol": "TCP"}]
+    )
+    q1 = _with_ports(make_pod("q1"), [{"hostPort": 8080}])  # TCP default
+    q2 = _with_ports(make_pod("q2"), [{"hostPort": 8080}])
+    feats, res = _run(nodes, [bound], [q1, q2])
+    # q1 conflicts on a (existing pod), lands on b; q2 then conflicts on
+    # BOTH (scan carry commit) -> unschedulable.
+    assert feats.nodes.names[int(res.selected[0])] == "b"
+    assert int(res.selected[1]) == -1
+    fi = _plugin_col(res, "NodePorts")
+    assert int(res.reason_bits[1, fi, 0]) != 0 and int(res.reason_bits[1, fi, 1]) != 0
+    # Oracle agreement.
+    assert oracle.node_ports_filter(q1, [bound]) == [oracle.ERR_NODE_PORTS]
+    assert oracle.node_ports_filter(q1, []) == []
+
+
+def test_node_ports_wildcard_ip_semantics():
+    a = {"hostPort": 80, "protocol": "TCP", "hostIP": "10.0.0.1"}
+    b = {"hostPort": 80, "protocol": "TCP", "hostIP": "10.0.0.2"}
+    wild = {"hostPort": 80, "protocol": "TCP"}
+    udp = {"hostPort": 80, "protocol": "UDP"}
+    p_a = _with_ports(make_pod("pa", node_name="n"), [a])
+    # Different specific IPs do not conflict; wildcard conflicts with any.
+    assert oracle.node_ports_filter(_with_ports(make_pod("x"), [b]), [p_a]) == []
+    assert oracle.node_ports_filter(_with_ports(make_pod("y"), [wild]), [p_a]) != []
+    assert oracle.node_ports_filter(_with_ports(make_pod("z"), [udp]), [p_a]) == []
+
+
+def test_image_locality_score_parity():
+    mb = 1024 * 1024
+    img_big = {"names": ["repo/app:v1"], "sizeBytes": 500 * mb}
+    img_small = {"names": ["repo/side"], "sizeBytes": 100 * mb}  # :latest normalized
+    nodes = [
+        _with_images(make_node("a"), [img_big, img_small]),
+        _with_images(make_node("b"), [img_big]),
+        make_node("c"),
+    ]
+    q = make_pod("p")
+    q["spec"]["containers"] = [
+        {"name": "c1", "image": "repo/app:v1", "resources": {}},
+        {"name": "c2", "image": "repo/side:latest", "resources": {}},
+    ]
+    feats, res = _run(nodes, [], [q])
+    si = _plugin_col(res, "ImageLocality", kind="score")
+    states = oracle.build_image_states(nodes)
+    for ni, node in enumerate(nodes):
+        want = oracle.image_locality_score(q, node, states, total_nodes=3)
+        assert int(res.scores[0, si, ni]) == want, node["metadata"]["name"]
+    # Node a has both images -> strictly best score.
+    assert int(res.scores[0, si, 0]) > int(res.scores[0, si, 1]) > 0
+    assert int(res.scores[0, si, 2]) == 0
+
+
+def test_scheduling_gates_enforced():
+    store = ClusterStore()
+    store.create("nodes", make_node("n0"))
+    gated = make_pod("gated")
+    gated["spec"]["schedulingGates"] = [{"name": "example.com/gate"}]
+    store.create("pods", gated)
+    svc = SchedulerService(store)
+    assert svc.schedule_pending() == {}  # gated pod never enters the queue
+    assert store.get("pods", "gated")["spec"].get("nodeName") is None
+    # Removing the gates makes it schedulable.
+    store.patch("pods", "gated", "default", lambda o: o["spec"].pop("schedulingGates"))
+    assert svc.schedule_pending() == {"default/gated": "n0"}
+
+
+def test_volume_plugins_surface_in_skipped():
+    prof = compile_profile({})
+    assert "VolumeBinding" in prof.skipped
+    assert "VolumeRestrictions" in prof.skipped
+    # The new kernels are no longer skipped.
+    for name in ("NodeName", "NodePorts", "ImageLocality"):
+        assert name not in prof.skipped
+
+
+def test_new_plugins_neutral_on_plain_clusters():
+    # Pods without ports/images/node requests: new plugins must not
+    # change selections vs the six-plugin oracle in test_engine_schedule.
+    nodes, pods = random_cluster(5, n_nodes=8, n_pods=30, bound_fraction=0.2)
+    queue = [p for p in pods if not p["spec"].get("nodeName")]
+    feats, res = _run(nodes, pods, queue)
+    si = _plugin_col(res, "ImageLocality", kind="score")
+    assert (res.scores[: len(queue), si, :8] == 0).all()
+    fi = _plugin_col(res, "NodePorts")
+    assert (res.reason_bits[: len(queue), fi, :8] == 0).all()

@@ -140,3 +140,21 @@ def test_service_preemption_without_full_record():
     assert svc.schedule_pending() == {"default/crit": "n0"}
     # Binding clears the nomination, like the apiserver does.
     assert "nominatedNodeName" not in store.get("pods", "crit")["status"]
+
+
+def test_preemption_rechecks_port_conflicts():
+    # Victim search must re-check NodePorts: the port is held by a
+    # LOW-priority pod, so evicting it resolves the conflict.
+    node = make_node("n0", cpu="8", memory="16Gi")
+    low = _bound("low", "n0", "1", 1)
+    low["spec"]["containers"][0]["ports"] = [{"hostPort": 8080}]
+    preemptor = make_pod("p", cpu="1", memory=None, priority=10)
+    preemptor["spec"]["containers"][0]["ports"] = [{"hostPort": 8080}]
+    d = find_preemption(preemptor, [node], [low])
+    assert d.nominated_node == "n0"
+    assert [v["metadata"]["name"] for v in d.victims] == ["low"]
+    # Held by a HIGHER-priority pod instead: no preemption can help.
+    hi = _bound("hi", "n0", "1", 100)
+    hi["spec"]["containers"][0]["ports"] = [{"hostPort": 8080}]
+    d2 = find_preemption(preemptor, [node], [hi, _bound("low2", "n0", "1", 1)])
+    assert d2.nominated_node is None
