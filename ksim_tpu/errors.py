@@ -15,3 +15,8 @@ class ConflictError(SimulatorError):
 
 class InvalidConfigError(SimulatorError):
     """Configuration failed validation."""
+
+
+class ExpiredError(SimulatorError):
+    """A watch resume point fell out of the event history — the "410
+    Gone" etcd compaction analogue; the client must relist."""

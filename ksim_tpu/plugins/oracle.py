@@ -643,26 +643,23 @@ def balanced_allocation_score(
 # -- NodeName ---------------------------------------------------------------
 
 
-ERR_NODE_NAME = "node(s) didn't match the requested node name"
-
-
 def node_name_filter(pod: JSON, info: NodeInfo) -> list[str]:
     """Upstream nodename/node_name.go Fits."""
+    from ksim_tpu.plugins.nodename import ERR_REASON
+
     want = pod.get("spec", {}).get("nodeName") or ""
     if not want or want == info["name"]:
         return []
-    return [ERR_NODE_NAME]
+    return [ERR_REASON]
 
 
 # -- NodePorts --------------------------------------------------------------
 
 
-ERR_NODE_PORTS = "node(s) didn't have free ports for the requested pod ports"
-
-
 def node_ports_filter(pod: JSON, pods_on_node: Sequence[JSON]) -> list[str]:
     """Upstream nodeports/node_ports.go Fits over the node's existing
     pods' (hostIP, protocol, hostPort) triples."""
+    from ksim_tpu.plugins.nodeports import ERR_REASON
     from ksim_tpu.state.extras import _host_ports, ports_conflict
 
     wants = _host_ports(pod)
@@ -672,16 +669,11 @@ def node_ports_filter(pod: JSON, pods_on_node: Sequence[JSON]) -> list[str]:
     for w in wants:
         for e in existing:
             if ports_conflict(w, e):
-                return [ERR_NODE_PORTS]
+                return [ERR_REASON]
     return []
 
 
 # -- ImageLocality ----------------------------------------------------------
-
-
-IL_MB = 1024 * 1024
-IL_MIN_THRESHOLD = 23 * IL_MB
-IL_MAX_CONTAINER_THRESHOLD = 1000 * IL_MB
 
 
 def build_image_states(nodes: Sequence[JSON]) -> dict[str, tuple[int, int]]:
@@ -730,9 +722,11 @@ def image_locality_score(
             # the float64 rounding point matches (int(size*nn/total) can
             # differ by 1 at ~1-in-4000 triples).
             sum_scores += int(float(size) * (float(nn) / float(total_nodes)))
-    max_threshold = IL_MAX_CONTAINER_THRESHOLD * len(containers)
-    clamped = min(max(sum_scores, IL_MIN_THRESHOLD), max(max_threshold, IL_MIN_THRESHOLD))
-    denom = max_threshold - IL_MIN_THRESHOLD
+    from ksim_tpu.plugins.imagelocality import MAX_CONTAINER_THRESHOLD, MIN_THRESHOLD
+
+    max_threshold = MAX_CONTAINER_THRESHOLD * len(containers)
+    clamped = min(max(sum_scores, MIN_THRESHOLD), max(max_threshold, MIN_THRESHOLD))
+    denom = max_threshold - MIN_THRESHOLD
     if denom <= 0:
         return 0
-    return int(100 * (clamped - IL_MIN_THRESHOLD) / denom)
+    return int(MAX_NODE_SCORE * (clamped - MIN_THRESHOLD) / denom)

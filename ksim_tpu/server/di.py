@@ -1,0 +1,42 @@
+"""DI container: the single place services are constructed and wired,
+mirroring the reference (reference simulator/server/di/di.go:24-71)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ksim_tpu.scheduler.service import SchedulerService
+from ksim_tpu.server.reset import ResetService
+from ksim_tpu.state.cluster import ClusterStore
+from ksim_tpu.state.snapshot import SnapshotService
+
+
+class DIContainer:
+    def __init__(
+        self,
+        store: ClusterStore | None = None,
+        *,
+        scheduler_config: dict | None = None,
+        registry: dict | None = None,
+        record: str = "full",
+        start_scheduler: bool = False,
+    ) -> None:
+        self.store = store if store is not None else ClusterStore()
+        self.scheduler_service = SchedulerService(
+            self.store,
+            config=scheduler_config,
+            registry=registry,
+            record=record,
+        )
+        self.snapshot_service = SnapshotService(
+            self.store, scheduler_service=self.scheduler_service
+        )
+        self.reset_service = ResetService(self.store, self.scheduler_service)
+        # Placeholder until the extender webhook proxy lands; the HTTP
+        # routes exist either way (reference server.go:88-93).
+        self.extender_service: Any = None
+        if start_scheduler:
+            self.scheduler_service.start()
+
+    def shutdown(self) -> None:
+        self.scheduler_service.stop()

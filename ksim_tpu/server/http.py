@@ -1,0 +1,280 @@
+"""The simulator HTTP server (stdlib ThreadingHTTPServer).
+
+Routes mirror the reference echo server exactly (reference
+simulator/server/server.go:44-54, handlers under server/handler/):
+
+    GET  /api/v1/schedulerconfiguration      -> current KubeSchedulerConfiguration
+    POST /api/v1/schedulerconfiguration      -> apply (only .profiles/.extenders
+                                                taken, schedulerconfig.go:42-64),
+                                                202 on success, 500 on failure
+    PUT  /api/v1/reset                       -> restore boot state, 202
+    GET  /api/v1/export                      -> snapshot JSON (ResourcesForSnap)
+    POST /api/v1/import                      -> load snapshot, 200
+    GET  /api/v1/listwatchresources          -> streaming watch: newline-delimited
+                                                {"Kind","EventType","Obj"} JSON
+                                                (streamwriter.go:41-50); per-kind
+                                                ?XXXlastResourceVersion= resumes
+                                                (watcher.go:23-46)
+    POST /api/v1/extender/{filter,prioritize,preempt,bind}/:id
+                                             -> extender webhook proxy
+                                                (server.go:88-93)
+
+CORS headers come from ``cors_allowed_origins`` (the reference reads them
+from config, server.go:28-32)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ksim_tpu.server.di import DIContainer
+
+logger = logging.getLogger(__name__)
+
+# Query-parameter names per kind (reference handler/watcher.go:26-34 —
+# note the singular "namespace" prefix).
+LRV_PARAMS = {
+    "pods": "podsLastResourceVersion",
+    "nodes": "nodesLastResourceVersion",
+    "persistentvolumes": "pvsLastResourceVersion",
+    "persistentvolumeclaims": "pvcsLastResourceVersion",
+    "storageclasses": "scsLastResourceVersion",
+    "priorityclasses": "pcsLastResourceVersion",
+    "namespaces": "namespaceLastResourceVersion",
+}
+
+EXTENDER_VERBS = ("filter", "prioritize", "preempt", "bind")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "SimulatorServer"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        logger.debug("%s %s", self.address_string(), fmt % args)
+
+    def _cors(self) -> None:
+        origins = self.server.cors_allowed_origins
+        origin = self.headers.get("Origin")
+        if origins and origin and (origin in origins or "*" in origins):
+            self.send_header("Access-Control-Allow-Origin", origin)
+            self.send_header("Access-Control-Allow-Credentials", "true")
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self._cors()
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _no_content(self, code: int) -> None:
+        self.send_response(code)
+        self._cors()
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        return json.loads(raw) if raw else {}
+
+    # -- routing ------------------------------------------------------------
+
+    def do_OPTIONS(self) -> None:  # CORS preflight
+        self.send_response(204)
+        self._cors()
+        self.send_header("Access-Control-Allow-Methods", "GET, POST, PUT, OPTIONS")
+        self.send_header("Access-Control-Allow-Headers", "Content-Type")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self) -> None:
+        url = urlparse(self.path)
+        if url.path == "/api/v1/schedulerconfiguration":
+            self._json(200, self.server.di.scheduler_service.get_scheduler_config())
+        elif url.path == "/api/v1/export":
+            self._json(200, self.server.di.snapshot_service.snap())
+        elif url.path == "/api/v1/listwatchresources":
+            self._list_watch(parse_qs(url.query))
+        else:
+            self._json(404, {"message": "Not Found"})
+
+    def do_POST(self) -> None:
+        url = urlparse(self.path)
+        if url.path == "/api/v1/schedulerconfiguration":
+            self._apply_scheduler_config()
+        elif url.path == "/api/v1/import":
+            try:
+                self.server.di.snapshot_service.load(self._body())
+            except Exception:
+                logger.exception("failed to load snapshot")
+                self._json(400, {"message": "Bad Request"})
+                return
+            self._no_content(200)
+        elif url.path.startswith("/api/v1/extender/"):
+            self._extender(url.path)
+        else:
+            self._json(404, {"message": "Not Found"})
+
+    def do_PUT(self) -> None:
+        if urlparse(self.path).path == "/api/v1/reset":
+            try:
+                self.server.di.reset_service.reset()
+            except Exception:
+                logger.exception("failed to reset")
+                self._json(500, {"message": "Internal Server Error"})
+                return
+            self._no_content(202)
+        else:
+            self._json(404, {"message": "Not Found"})
+
+    # -- handlers -----------------------------------------------------------
+
+    def _apply_scheduler_config(self) -> None:
+        """Only .profiles and .extenders are taken from the payload
+        (reference handler/schedulerconfig.go:42-64); failure to compile
+        keeps the old config (RestartScheduler rollback) and returns 500."""
+        try:
+            req = self._body()
+        except Exception:
+            self._json(400, {"message": "Bad Request"})
+            return
+        svc = self.server.di.scheduler_service
+        cfg = svc.get_scheduler_config()
+        cfg["profiles"] = req.get("profiles") or []
+        cfg["extenders"] = req.get("extenders") or []
+        try:
+            svc.apply_scheduler_config(cfg)
+        except Exception:
+            logger.exception("failed to apply scheduler config")
+            self._json(500, {"message": "Internal Server Error"})
+            return
+        self._no_content(202)
+
+    def _extender(self, path: str) -> None:
+        parts = path.split("/")  # ['', 'api', 'v1', 'extender', verb, id]
+        if len(parts) != 6 or parts[4] not in EXTENDER_VERBS:
+            self._json(404, {"message": "Not Found"})
+            return
+        svc = self.server.di.extender_service
+        if svc is None:
+            self._json(400, {"message": "no extenders configured"})
+            return
+        try:
+            idx = int(parts[5])
+            out = getattr(svc, parts[4])(idx, self._body())
+        except (IndexError, ValueError):
+            self._json(400, {"message": "Bad Request"})
+            return
+        except Exception:
+            logger.exception("extender %s failed", parts[4])
+            self._json(500, {"message": "Internal Server Error"})
+            return
+        self._json(200, out)
+
+    def _list_watch(self, query: dict[str, list[str]]) -> None:
+        """Server push: initial LIST as ADDED events for kinds without a
+        lastResourceVersion, then live events, as newline-delimited JSON
+        on a flushed chunked response (reference eventproxy.go:66-80,
+        streamwriter.go:41-50)."""
+        store = self.server.di.store
+        since: dict[str, int] = {}
+        listed: list[str] = []
+        from ksim_tpu.state.cluster import KINDS, WatchEvent
+
+        for kind in KINDS:
+            raw = (query.get(LRV_PARAMS[kind]) or [""])[0]
+            if raw:
+                try:
+                    since[kind] = int(raw)
+                except ValueError:
+                    listed.append(kind)
+            else:
+                listed.append(kind)
+
+        # Atomic list+replay+subscribe under the store lock — no gap or
+        # duplicate between the initial events and the live stream.  This
+        # must happen BEFORE the 200 status goes out: a compacted resume
+        # point answers 410 Gone (client drops its cache and relists).
+        from ksim_tpu.errors import ExpiredError
+
+        try:
+            stream = store.watch(since=since, list_first=tuple(listed))
+        except ExpiredError as e:
+            self._json(410, {"message": str(e)})
+            return
+
+        self.send_response(200)
+        self._cors()
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def write_event(ev: WatchEvent) -> bool:
+            data = json.dumps(ev.to_json()).encode() + b"\n"
+            try:
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionResetError):
+                return False
+
+        try:
+            while not self.server.stopping.is_set():
+                ev = stream.next(timeout=0.25)
+                if ev is None:
+                    continue
+                if not write_event(ev):
+                    return
+            # Graceful end-of-stream on server shutdown.
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        finally:
+            stream.close()
+
+
+class SimulatorServer(ThreadingHTTPServer):
+    """The simulator's HTTP front end; serve_forever in a daemon thread
+    via start(), stoppable via shutdown_server()."""
+
+    daemon_threads = True
+
+    def __init__(
+        self,
+        di: DIContainer,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 1212,
+        cors_allowed_origins: tuple[str, ...] = (),
+    ) -> None:
+        super().__init__((host, port), _Handler)
+        self.di = di
+        self.cors_allowed_origins = tuple(cors_allowed_origins)
+        self.stopping = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start(self) -> "SimulatorServer":
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown_server(self) -> None:
+        self.stopping.set()
+        self.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.server_close()

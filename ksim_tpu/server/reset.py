@@ -1,0 +1,30 @@
+"""Reset service: restore the boot-time cluster state and scheduler config.
+
+The reference snapshots every etcd KV under its prefix at boot and
+restores them (deleting everything else) on Reset, then resets the
+scheduler configuration (reference simulator/reset/reset.go:33-85).  Here
+the "etcd prefix" is the whole ClusterStore."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ksim_tpu.state.cluster import ClusterStore
+
+
+class ResetService:
+    def __init__(self, store: ClusterStore, scheduler_service: Any = None) -> None:
+        self._store = store
+        self._sched = scheduler_service
+        # Captured once at construction — the DI container builds this
+        # after any one-shot import, like the reference's boot order
+        # (cmd/simulator/simulator.go:104-113 imports BEFORE the DI
+        # container snapshot is used... the reference snapshots at
+        # NewResetService time, di.go:24-31).
+        self._initial = store.dump()
+
+    def reset(self) -> None:
+        """Restore initial resources and reset the scheduler config."""
+        self._store.restore(self._initial)
+        if self._sched is not None:
+            self._sched.reset_scheduler_config()

@@ -12,6 +12,7 @@ simulator/reset/reset.go:33-85 snapshots the etcd prefix the same way).
 
 from __future__ import annotations
 
+import collections
 import copy
 import itertools
 import queue
@@ -19,7 +20,7 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator
 
-from ksim_tpu.errors import ConflictError, NotFoundError
+from ksim_tpu.errors import ConflictError, ExpiredError, NotFoundError
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
 
 # Kind names follow the reference's watcher kinds
@@ -68,11 +69,18 @@ def _key(kind: str, obj_or_name: JSON | str, namespace: str = "") -> str:
 class ClusterStore:
     """Thread-safe versioned store of cluster objects with watch streams."""
 
+    # Watch-resume history depth: older lastResourceVersions trigger a
+    # relist, like an etcd compaction would.
+    HISTORY_DEPTH = 8192
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._rv = itertools.count(1)
         self._objects: dict[str, dict[str, JSON]] = {k: {} for k in KINDS}
         self._watchers: list[tuple[queue.SimpleQueue, frozenset[str]]] = []
+        self._history: "collections.deque[tuple[int, WatchEvent]]" = (
+            collections.deque(maxlen=self.HISTORY_DEPTH)
+        )
 
     # -- CRUD ---------------------------------------------------------------
 
@@ -155,6 +163,10 @@ class ClusterStore:
             obj = self._objects[kind].pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{kind} {key!r} not found")
+            # A delete is a new store event: stamp a fresh resourceVersion
+            # (like the apiserver) so watch-resume replay — which filters
+            # history on rv > lastResourceVersion — never drops it.
+            obj["metadata"]["resourceVersion"] = str(next(self._rv))
             self._notify(WatchEvent(kind, DELETED, obj))
 
     def apply(self, kind: str, obj: JSON) -> JSON:
@@ -169,11 +181,51 @@ class ClusterStore:
 
     # -- watch --------------------------------------------------------------
 
-    def watch(self, kinds: tuple[str, ...] = KINDS) -> "WatchStream":
+    def watch(
+        self,
+        kinds: tuple[str, ...] = KINDS,
+        *,
+        since: dict[str, int] | None = None,
+        list_first: tuple[str, ...] = (),
+    ) -> "WatchStream":
+        """Subscribe to events for ``kinds``.
+
+        ``since`` maps kind -> lastResourceVersion: events after that
+        version replay from the bounded history buffer first (the
+        reference's RetryWatcher resume, resourcewatcher.go:128-134); a
+        version older than the buffer raises ExpiredError — the etcd
+        compaction "410 Gone" — telling the client to drop its cache and
+        relist (a silent relist could never signal deletions it missed).
+        ``list_first`` kinds get their current objects as ADDED events
+        (the reference's list-then-watch when no lastResourceVersion is
+        given, eventproxy.go:66-80).  Everything happens under one lock,
+        so replay/list and the live subscription have no event gap."""
         for k in kinds:
             self._check_kind(k)
         q: queue.SimpleQueue = queue.SimpleQueue()
         with self._lock:
+            # Empty history means no event was ever emitted (the deque only
+            # evicts when full), so there is nothing to replay.
+            if since and self._history:
+                covered_from = self._history[0][0]
+                for kind, last in since.items():
+                    self._check_kind(kind)
+                    if kind in kinds and last + 1 < covered_from:
+                        raise ExpiredError(
+                            f"{kind} resourceVersion {last} is too old "
+                            f"(history starts at {covered_from})"
+                        )
+            for kind in list_first:
+                self._check_kind(kind)
+                for obj in self._objects[kind].values():
+                    q.put(WatchEvent(kind, ADDED, copy.deepcopy(obj)))
+            if since and self._history:
+                for kind, last in since.items():
+                    if kind not in kinds:
+                        continue
+                    for rv, ev in self._history:
+                        if ev.kind == kind and rv > last:
+                            q.put(ev)
             self._watchers.append((q, frozenset(kinds)))
         return WatchStream(self, q)
 
@@ -182,6 +234,11 @@ class ClusterStore:
             self._watchers = [(w, ks) for (w, ks) in self._watchers if w is not q]
 
     def _notify(self, event: WatchEvent) -> None:
+        try:
+            rv = int(event.obj["metadata"]["resourceVersion"])
+        except (KeyError, ValueError, TypeError):
+            rv = 0
+        self._history.append((rv, event))
         for q, kinds in self._watchers:
             if event.kind in kinds:
                 q.put(event)
@@ -195,26 +252,26 @@ class ClusterStore:
     def restore(self, dump: dict[str, dict[str, JSON]]) -> None:
         """Wipe and restore; emits DELETED then ADDED events
         (reference reset deletes the etcd prefix then re-puts initial KVs,
-        simulator/reset/reset.go:58-85)."""
+        simulator/reset/reset.go:58-85).  Every emitted event — and every
+        restored object — gets a FRESH resourceVersion so watch-resume
+        replay (which filters on rv > lastResourceVersion) sees all of
+        them; the restored objects' recorded rvs are superseded, like an
+        etcd re-put bumping mod_revision."""
         with self._lock:
             for kind in KINDS:
                 for obj in list(self._objects[kind].values()):
+                    obj["metadata"]["resourceVersion"] = str(next(self._rv))
                     self._notify(WatchEvent(kind, DELETED, obj))
                 self._objects[kind].clear()
-            max_rv = 0
             for kind, objs in dump.items():
                 self._check_kind(kind)
                 for key, obj in objs.items():
                     restored = copy.deepcopy(obj)
+                    restored.setdefault("metadata", {})["resourceVersion"] = str(
+                        next(self._rv)
+                    )
                     self._objects[kind][key] = restored
-                    try:
-                        max_rv = max(max_rv, int(restored["metadata"]["resourceVersion"]))
-                    except (KeyError, ValueError, TypeError):
-                        pass
                     self._notify(WatchEvent(kind, ADDED, copy.deepcopy(restored)))
-            # Fast-forward the RV counter past every restored version so the
-            # store-wide monotonicity of resourceVersion survives restore.
-            self._rv = itertools.count(max(next(self._rv), max_rv + 1))
 
     def _check_kind(self, kind: str) -> None:
         if kind not in self._objects:

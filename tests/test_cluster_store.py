@@ -98,3 +98,52 @@ def test_restore_keeps_resource_version_monotonic():
     created = fresh.create("nodes", make_node("new"))
     restored_rvs = [int(n["metadata"]["resourceVersion"]) for n in fresh.list("nodes") if n["metadata"]["name"] != "new"]
     assert int(created["metadata"]["resourceVersion"]) > max(restored_rvs)
+
+
+def test_watch_resume_replays_deletes_and_expires():
+    from ksim_tpu.errors import ExpiredError
+    from tests.helpers import make_pod
+
+    store = ClusterStore()
+    store.create("pods", make_pod("a"))
+    b = store.create("pods", make_pod("b"))
+    last = int(b["metadata"]["resourceVersion"])
+    # Disconnect; a delete happens while away.
+    store.delete("pods", "a")
+    stream = store.watch(("pods",), since={"pods": last})
+    ev = stream.next(timeout=1)
+    assert ev is not None and ev.event_type == "DELETED"
+    assert ev.obj["metadata"]["name"] == "a"
+    # The DELETED event carries a fresh resourceVersion (> last).
+    assert int(ev.obj["metadata"]["resourceVersion"]) > last
+    stream.close()
+    # A resume point older than the history buffer raises ExpiredError.
+    store2 = ClusterStore()
+    store2.HISTORY_DEPTH = 4
+    store2._history = __import__("collections").deque(maxlen=4)
+    for i in range(8):
+        store2.create("pods", make_pod(f"p{i}"))
+    try:
+        store2.watch(("pods",), since={"pods": 1})
+        raise AssertionError("expected ExpiredError")
+    except ExpiredError:
+        pass
+
+
+def test_restore_emits_fresh_resource_versions():
+    from tests.helpers import make_pod
+
+    store = ClusterStore()
+    store.create("pods", make_pod("a"))
+    dump = store.dump()
+    stream = store.watch(("pods",))
+    store.restore(dump)
+    rvs = []
+    while True:
+        ev = stream.next(timeout=0.2)
+        if ev is None:
+            break
+        rvs.append(int(ev.obj["metadata"]["resourceVersion"]))
+    stream.close()
+    # DELETED then ADDED, both with fresh monotonically-increasing rvs.
+    assert len(rvs) == 2 and rvs[0] < rvs[1] and rvs[0] > 1

@@ -71,7 +71,9 @@ def test_node_ports_conflict_and_commit():
     fi = _plugin_col(res, "NodePorts")
     assert int(res.reason_bits[1, fi, 0]) != 0 and int(res.reason_bits[1, fi, 1]) != 0
     # Oracle agreement.
-    assert oracle.node_ports_filter(q1, [bound]) == [oracle.ERR_NODE_PORTS]
+    from ksim_tpu.plugins.nodeports import ERR_REASON
+
+    assert oracle.node_ports_filter(q1, [bound]) == [ERR_REASON]
     assert oracle.node_ports_filter(q1, []) == []
 
 

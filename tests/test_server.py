@@ -1,0 +1,175 @@
+"""HTTP server integration: the reference's /api/v1 surface end-to-end
+(reference simulator/server/server.go:44-54) — config-change -> schedule ->
+export cycle, reset, and the streaming listwatchresources endpoint."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+
+import pytest
+
+from ksim_tpu.server import DIContainer, SimulatorServer
+from tests.helpers import make_node, make_pod
+
+
+@pytest.fixture()
+def server():
+    di = DIContainer()
+    srv = SimulatorServer(di, port=0).start()  # ephemeral port
+    yield srv
+    srv.shutdown_server()
+    di.shutdown()
+
+
+def _conn(srv):
+    return http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+
+
+def _req(srv, method, path, body=None):
+    c = _conn(srv)
+    c.request(
+        method,
+        path,
+        json.dumps(body) if body is not None else None,
+        {"Content-Type": "application/json"},
+    )
+    r = c.getresponse()
+    data = r.read()
+    c.close()
+    return r.status, json.loads(data) if data else None
+
+
+def test_full_cycle_over_http(server):
+    di = server.di
+    # Import a snapshot.
+    snap = {
+        "nodes": [make_node("n0", cpu="4", memory="8Gi")],
+        "pods": [make_pod("p0", cpu="1", memory="1Gi")],
+        "pvs": [], "pvcs": [], "storageClasses": [], "priorityClasses": [],
+        "namespaces": [], "schedulerConfig": None,
+    }
+    status, _ = _req(server, "POST", "/api/v1/import", snap)
+    assert status == 200
+
+    # Apply a scheduler config (only profiles/extenders are taken).
+    cfg = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [{"schedulerName": "my-scheduler"}],
+    }
+    status, _ = _req(server, "POST", "/api/v1/schedulerconfiguration", cfg)
+    assert status == 202
+    status, got = _req(server, "GET", "/api/v1/schedulerconfiguration")
+    assert status == 200
+    assert got["profiles"] == [{"schedulerName": "my-scheduler"}]
+
+    # A bad config rolls back and returns 500.
+    bad = {"profiles": [{"plugins": {"multiPoint": {"enabled": [{"name": "Nope"}]}}}]}
+    status, _ = _req(server, "POST", "/api/v1/schedulerconfiguration", bad)
+    assert status == 500
+    _, got = _req(server, "GET", "/api/v1/schedulerconfiguration")
+    assert got["profiles"] == [{"schedulerName": "my-scheduler"}]
+
+    # Schedule the pending pod (profile renamed the scheduler, so address it).
+    di.store.patch(
+        "pods", "p0", "default",
+        lambda o: o["spec"].__setitem__("schedulerName", "my-scheduler"),
+    )
+    placements = di.scheduler_service.schedule_pending()
+    assert placements == {"default/p0": "n0"}
+
+    # Export reflects the binding and the applied config.
+    status, out = _req(server, "GET", "/api/v1/export")
+    assert status == 200
+    assert out["pods"][0]["spec"]["nodeName"] == "n0"
+    assert out["schedulerConfig"]["profiles"] == [{"schedulerName": "my-scheduler"}]
+
+    # Reset restores the boot-time (empty) cluster and default config.
+    status, _ = _req(server, "PUT", "/api/v1/reset")
+    assert status == 202
+    status, out = _req(server, "GET", "/api/v1/export")
+    assert out["nodes"] == [] and out["pods"] == []
+    _, got = _req(server, "GET", "/api/v1/schedulerconfiguration")
+    assert got == {}
+
+
+def test_extender_routes_present(server):
+    status, body = _req(server, "POST", "/api/v1/extender/filter/0", {})
+    assert status == 400  # no extenders configured
+    status, _ = _req(server, "POST", "/api/v1/extender/nope/0", {})
+    assert status == 404
+
+
+def _read_events(resp, n, deadline=10.0):
+    events = []
+    end = time.monotonic() + deadline
+    while len(events) < n and time.monotonic() < end:
+        try:
+            line = resp.readline()
+        except TimeoutError:
+            break
+        if line.strip():
+            events.append(json.loads(line))
+    return events
+
+
+def test_listwatch_stream(server):
+    di = server.di
+    di.store.create("nodes", make_node("n0"))
+    c = _conn(server)
+    c.request("GET", "/api/v1/listwatchresources")
+    resp = c.getresponse()
+    assert resp.status == 200
+    # Initial LIST as ADDED.
+    (ev,) = _read_events(resp, 1)
+    assert ev["Kind"] == "nodes" and ev["EventType"] == "ADDED"
+    assert ev["Obj"]["metadata"]["name"] == "n0"
+    # Live event.
+    di.store.create("pods", make_pod("p0"))
+    (ev2,) = _read_events(resp, 1)
+    assert ev2["Kind"] == "pods" and ev2["EventType"] == "ADDED"
+    rv = int(ev2["Obj"]["metadata"]["resourceVersion"])
+    c.close()
+
+    # Resume from lastResourceVersion: only newer events arrive.
+    di.store.create("pods", make_pod("p1"))
+    c2 = _conn(server)
+    c2.request(
+        "GET",
+        "/api/v1/listwatchresources?podsLastResourceVersion="
+        f"{rv}&nodesLastResourceVersion={rv}",
+    )
+    resp2 = c2.getresponse()
+    (ev3,) = _read_events(resp2, 1)
+    assert ev3["Kind"] == "pods" and ev3["Obj"]["metadata"]["name"] == "p1"
+    c2.close()
+
+
+def test_watch_driven_scheduling_over_http(server):
+    """The full product loop: watch stream sees the pod arrive and then
+    get bound by the running scheduler."""
+    di = server.di
+    di.store.create("nodes", make_node("n0"))
+    di.scheduler_service.start()
+    try:
+        c = _conn(server)
+        c.request("GET", "/api/v1/listwatchresources")
+        resp = c.getresponse()
+        (ev,) = _read_events(resp, 1)  # node list
+        di.store.create("pods", make_pod("p0", cpu="100m"))
+        seen_bound = False
+        end = time.monotonic() + 20
+        while not seen_bound and time.monotonic() < end:
+            for ev in _read_events(resp, 1, deadline=5.0):
+                if (
+                    ev["Kind"] == "pods"
+                    and ev["EventType"] in ("ADDED", "MODIFIED")
+                    and ev["Obj"]["spec"].get("nodeName") == "n0"
+                ):
+                    seen_bound = True
+        assert seen_bound
+        c.close()
+    finally:
+        di.scheduler_service.stop()
