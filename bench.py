@@ -92,11 +92,39 @@ def run_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
     return rung
 
 
+def run_churn(seed: int, n_nodes: int = 2_000, n_events: int = 50_000) -> dict:
+    """BASELINE config 5: churn replay — rolling pod arrivals/completions
+    + node drain/replace over the full default plugin set, sequential
+    scheduling semantics per step."""
+    from ksim_tpu.scenario import ScenarioRunner, churn_scenario
+
+    runner = ScenarioRunner()
+    res = runner.run(
+        churn_scenario(seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100)
+    )
+    out = {
+        "events": res.events_applied,
+        "wall_s": round(res.wall_seconds, 1),
+        "events_per_sec": round(res.events_per_second),
+        "pods_scheduled": res.pods_scheduled,
+        "unschedulable_attempts": res.unschedulable_attempts,
+        "steps": len(res.steps),
+    }
+    print(
+        f"[churn {n_events}ev/{n_nodes}n] {res.wall_seconds:.1f}s "
+        f"({res.events_per_second:.0f} ev/s, {res.pods_scheduled} scheduled)",
+        file=sys.stderr,
+    )
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--only", type=str, default="", help="pods x nodes, e.g. 10000x5000")
+    ap.add_argument("--skip-churn", action="store_true")
+    ap.add_argument("--churn-events", type=int, default=50_000)
     args = ap.parse_args()
 
     import jax
@@ -116,6 +144,13 @@ def main() -> None:
         except Exception:
             traceback.print_exc(file=sys.stderr)
             rungs[key] = {"error": traceback.format_exc(limit=1).strip().splitlines()[-1]}
+
+    if not args.skip_churn and not args.only:
+        try:
+            rungs["churn"] = run_churn(args.seed, n_events=args.churn_events)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            rungs["churn"] = {"error": traceback.format_exc(limit=1).strip().splitlines()[-1]}
 
     value = headline or 0
     print(

@@ -1,0 +1,1 @@
+"""Process entrypoints (reference simulator/cmd/{simulator,scheduler})."""

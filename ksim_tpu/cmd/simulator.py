@@ -1,0 +1,88 @@
+"""Simulator server entrypoint (reference simulator/cmd/simulator/
+simulator.go:35-136): load config, wire the DI container, optionally
+one-shot-import or continuously sync an external snapshot source, start
+the scheduler watch loop and the HTTP server, then wait for SIGTERM.
+
+Run: ``python -m ksim_tpu.cmd.simulator [--config config.yaml]`` (or the
+``ksim-simulator`` console script)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+def start_simulator(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="ksim-simulator")
+    ap.add_argument("--config", default=None, help="SimulatorConfiguration yaml")
+    ap.add_argument("--port", type=int, default=None, help="override the port")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+
+    from ksim_tpu.config import load_config
+    from ksim_tpu.oneshotimporter import OneShotImporter
+    from ksim_tpu.server import DIContainer, SimulatorServer
+    from ksim_tpu.state.cluster import ClusterStore
+    from ksim_tpu.state.snapshot import SnapshotService
+    from ksim_tpu.syncer import Syncer
+
+    cfg = load_config(args.config)
+    if args.port is not None:
+        cfg.port = args.port
+
+    di = DIContainer(scheduler_config=cfg.initial_scheduler_cfg)
+
+    syncer = None
+    if cfg.external_import_enabled or cfg.resource_sync_enabled:
+        with open(cfg.external_snapshot_path) as f:
+            snap_data = json.load(f)
+        source = ClusterStore()
+        SnapshotService(source).load(snap_data, ignore_err=True)
+        if cfg.external_import_enabled:
+            OneShotImporter(
+                di.snapshot_service, SnapshotService(source)
+            ).import_cluster_resources(cfg.resource_import_label_selector)
+        else:
+            syncer = Syncer(source, di.store).run()
+
+    di.scheduler_service.start()
+    server = SimulatorServer(
+        di,
+        port=cfg.port,
+        cors_allowed_origins=cfg.cors_allowed_origin_list,
+    ).start()
+    logger.info("simulator server started on :%d", server.port)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        logger.info("signal %s: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    try:
+        stop.wait()
+    finally:
+        server.shutdown_server()
+        if syncer is not None:
+            syncer.stop()
+        di.shutdown()
+    return 0
+
+
+def main() -> None:
+    sys.exit(start_simulator())
+
+
+if __name__ == "__main__":
+    main()

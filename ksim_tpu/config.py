@@ -1,0 +1,103 @@
+"""Simulator configuration: env vars over config.yaml over defaults.
+
+Mirrors the reference's SimulatorConfiguration v1alpha1 layering
+(reference simulator/config/config.go:60-114, config/v1alpha1/types.go:
+23-75): every env var overrides the corresponding config.yaml field; the
+KubeSchedulerConfiguration loads from ``kubeSchedulerConfigPath``.  Fields
+tied to the reference's KWOK topology (etcdURL, kubeApiServerUrl) have no
+meaning over the in-memory store; they are accepted and ignored so a
+reference config.yaml parses.  The external-cluster handle here is a
+snapshot source: ``externalSnapshotPath`` points at a reference-format
+snapshot JSON (the analogue of kubeConfig for import/sync).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+from ksim_tpu.errors import InvalidConfigError
+from ksim_tpu.state.resources import JSON
+
+DEFAULT_CONFIG_PATH = "./config.yaml"
+DEFAULT_PORT = 1212
+
+
+@dataclass
+class SimulatorConfig:
+    port: int = DEFAULT_PORT
+    cors_allowed_origin_list: tuple[str, ...] = ()
+    kube_scheduler_config_path: str = ""
+    external_import_enabled: bool = False
+    resource_sync_enabled: bool = False
+    external_snapshot_path: str = ""
+    resource_import_label_selector: JSON | None = None
+    initial_scheduler_cfg: JSON = field(default_factory=dict)
+
+
+def _env_bool(name: str, fallback: bool) -> bool:
+    v = os.environ.get(name, "")
+    if not v:
+        return fallback
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def load_config(path: str | None = None) -> SimulatorConfig:
+    """config.yaml (if present) + env overrides (reference getPort et al:
+    PORT, CORS_ALLOWED_ORIGIN_LIST, KUBE_SCHEDULER_CONFIG_PATH,
+    EXTERNAL_IMPORT_ENABLED, RESOURCE_SYNC_ENABLED, EXTERNAL_SNAPSHOT_PATH)."""
+    import yaml
+
+    raw: dict[str, Any] = {}
+    cfg_path = path or DEFAULT_CONFIG_PATH
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            raw = yaml.safe_load(f) or {}
+    elif path:  # explicitly named file must exist
+        raise InvalidConfigError(f"config file {path!r} not found")
+
+    port = int(os.environ.get("PORT") or raw.get("port") or DEFAULT_PORT)
+    cors_env = os.environ.get("CORS_ALLOWED_ORIGIN_LIST", "")
+    cors = (
+        tuple(x for x in cors_env.split(",") if x)
+        if cors_env
+        else tuple(raw.get("corsAllowedOriginList") or ())
+    )
+    sched_path = os.environ.get("KUBE_SCHEDULER_CONFIG_PATH") or raw.get(
+        "kubeSchedulerConfigPath", ""
+    )
+    ext_import = _env_bool(
+        "EXTERNAL_IMPORT_ENABLED", bool(raw.get("externalImportEnabled"))
+    )
+    sync = _env_bool("RESOURCE_SYNC_ENABLED", bool(raw.get("resourceSyncEnabled")))
+    snap_path = os.environ.get("EXTERNAL_SNAPSHOT_PATH") or raw.get(
+        "externalSnapshotPath", ""
+    )
+    if ext_import and sync:
+        # Reference: mutually exclusive (config.go:88-90).
+        raise InvalidConfigError(
+            "externalImportEnabled and resourceSyncEnabled cannot be used "
+            "simultaneously"
+        )
+    if (ext_import or sync) and not snap_path:
+        raise InvalidConfigError(
+            "externalSnapshotPath must be set when external import or "
+            "resource sync is enabled"
+        )
+
+    sched_cfg: JSON = {}
+    if sched_path:
+        with open(sched_path) as f:
+            sched_cfg = yaml.safe_load(f) or {}
+
+    return SimulatorConfig(
+        port=port,
+        cors_allowed_origin_list=cors,
+        kube_scheduler_config_path=sched_path,
+        external_import_enabled=ext_import,
+        resource_sync_enabled=sync,
+        external_snapshot_path=snap_path,
+        resource_import_label_selector=raw.get("resourceImportLabelSelector"),
+        initial_scheduler_cfg=sched_cfg,
+    )

@@ -383,14 +383,19 @@ class Engine:
     # invisible.
     SCHEDULE_CHUNK = 2048
 
-    def schedule(self, *, chunk: int | None = None) -> tuple[EngineResult, NodeStateView]:
+    def schedule(
+        self, *, chunk: int | None = None, pull_state: bool = True
+    ) -> tuple[EngineResult, NodeStateView | None]:
         """Greedy sequential scheduling of the pod queue with capacity
         commit; pod order is queue order (upstream pops by priority —
         callers sort the queue before featurizing).
 
         The scan runs in ``chunk``-sized pod segments (host loop, one
         compiled program reused across segments); results are concatenated
-        host-side."""
+        host-side.  ``pull_state=False`` skips the device->host transfer
+        of the final node state (callers that only consume the per-pod
+        results — the scheduler service — save ~7 blocking pulls per
+        pass, which dominate wall-clock over a high-latency link)."""
         P = int(self._pods.valid.shape[0])
         if chunk is None:
             chunk = min(P, self.SCHEDULE_CHUNK)
@@ -405,7 +410,10 @@ class Engine:
         merged = jax.tree_util.tree_map(
             lambda *xs: np.concatenate(xs, axis=0), *outs
         )
-        return self._to_result(merged), jax.tree_util.tree_map(np.asarray, state)
+        final_state = (
+            jax.tree_util.tree_map(np.asarray, state) if pull_state else None
+        )
+        return self._to_result(merged), final_state
 
     # -- decode -------------------------------------------------------------
 

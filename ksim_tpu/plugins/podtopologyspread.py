@@ -79,10 +79,20 @@ class PodTopologySpread:
     normalize_needs_ctx = True
 
     def __init__(self, spread: SpreadTensors) -> None:
+        from ksim_tpu.state.featurizer import bucket_size
+
         self._mc = spread.con_valid.shape[1]
         self._n_tk = spread.node_ldom.shape[1]
-        self._sizes = spread.tk_sizes
         self._singleton = spread.tk_singleton
+        # Per-key domain counts only bound aranges / num_segments, so pad
+        # them to power-of-two buckets (padded local ids never occur ->
+        # all-zero one-hot columns, never "present"); singleton keys don't
+        # use their size at all.  Unbucketed sizes would recompile on
+        # every node add/remove under churn.
+        self._sizes = tuple(
+            1 if singleton else bucket_size(size, 8)
+            for size, singleton in zip(spread.tk_sizes, spread.tk_singleton)
+        )
 
     def static_sig(self) -> tuple:
         return (NAME, self._mc, self._n_tk, self._sizes, self._singleton)

@@ -1,0 +1,25 @@
+"""Scenario replay: timed operation streams driving the simulator.
+
+What the reference designed but never built (reference
+keps/140-scenario-based-simulation/README.md — a Scenario CRD whose timed
+``operations`` create/update/delete resources while results accumulate in
+``.status.result``; the scaffold at scenario/controllers/
+scenario_controller.go:28-40 is an empty TODO).  Here it is a library:
+an operation stream applied step-by-step to the ClusterStore with a
+scheduling pass per step and aggregated results."""
+
+from ksim_tpu.scenario.runner import (
+    Operation,
+    ScenarioResult,
+    ScenarioRunner,
+    StepResult,
+)
+from ksim_tpu.scenario.generate import churn_scenario
+
+__all__ = [
+    "Operation",
+    "ScenarioResult",
+    "ScenarioRunner",
+    "StepResult",
+    "churn_scenario",
+]

@@ -100,9 +100,17 @@ class SchedulerService:
         """Compile-and-swap — the reference's RestartScheduler with
         rollback (scheduler.go:90-111): a config that fails to compile
         leaves the previous profiles in place and raises."""
+        from ksim_tpu.scheduler.extender import ExtenderService
+
         profiles = compile_configuration(cfg, registry=self._registry)
+        extenders = ExtenderService((cfg or {}).get("extenders"))
         self._profiles = {p.scheduler_name: p for p in profiles}
+        self._extenders = extenders
         self._config = copy.deepcopy(cfg) or {}
+
+    @property
+    def extender_service(self):
+        return self._extenders
 
     def reset_scheduler_config(self) -> None:
         """Back to the boot-time config (reference di.go initial cfg)."""
@@ -129,8 +137,13 @@ class SchedulerService:
         return name in self._scheduler_names
 
     def pending_pods(self) -> list[JSON]:
+        """The sorted pending queue (deep copies — callers may mutate)."""
+        return copy.deepcopy(self._pending_pods_live())
+
+    def _pending_pods_live(self) -> list[JSON]:
+        """Internal read-only variant over the store's live dicts."""
         return sorted(
-            (p for p in self._store.list("pods") if self._is_pending(p)),
+            (p for p in self._store.list("pods", copy_objs=False) if self._is_pending(p)),
             key=queue_sort_key,
         )
 
@@ -141,15 +154,15 @@ class SchedulerService:
         namespace/name -> node name (None = unschedulable this pass).
         Results are recorded on the pods' annotations either way (the
         reference records every attempt; history accumulates)."""
-        nodes = self._store.list("nodes")
-        namespaces = self._store.list("namespaces")
+        nodes = self._store.list("nodes", copy_objs=False)
+        namespaces = self._store.list("namespaces", copy_objs=False)
         if not nodes:
             return {}
         placements: dict[str, str | None] = {}
         for sched_name in self._scheduler_names:
             # Fresh pod snapshot per profile: earlier profiles' bindings
             # must charge their nodes before the next profile evaluates.
-            pods = self._store.list("pods")
+            pods = self._store.list("pods", copy_objs=False)
             queue = [
                 p
                 for p in pods
@@ -167,12 +180,21 @@ class SchedulerService:
                 prof = self._profiles[sched_name]
                 featurizer = self._featurizer_override or prof.featurizer()
                 factory = prof.plugins
+            if self._extenders:
+                # Webhook extenders need per-pod HTTP round-trips between
+                # filtering and scoring — exact upstream semantics require
+                # pod-at-a-time evaluation (the reference's scheduler is
+                # per-pod anyway; extenders are the slow path by design).
+                self._schedule_queue_with_extenders(
+                    queue, featurizer, factory, namespaces, placements
+                )
+                continue
             feats = featurizer.featurize(
                 nodes, pods, queue_pods=queue, namespaces=namespaces
             )
             plugins = tuple(factory(feats))
             eng = Engine(feats, plugins, record=self._record)
-            res, _state = eng.schedule()
+            res, _ = eng.schedule(pull_state=False)
             self._bind_results(queue, feats, plugins, res, placements)
         # Bound _own_rvs growth for library use (schedule_pending without
         # the watch loop draining events).  The limit scales with the pass
@@ -184,6 +206,120 @@ class SchedulerService:
                 for rv in sorted(self._own_rvs, key=int)[:-limit]:
                     self._own_rvs.discard(rv)
         return placements
+
+    def _schedule_queue_with_extenders(
+        self, queue, featurizer, factory, namespaces, placements
+    ) -> None:
+        """Per-pod cycle with extender webhooks (upstream
+        findNodesThatPassExtenders + prioritizeNodes extender scores):
+        engine filters/scores the pod batch-style against all nodes, then
+        each configured extender filters the feasible set and adds
+        prioritize scores before selectHost."""
+        import numpy as np
+
+        for pod in queue:
+            nodes = self._store.list("nodes", copy_objs=False)
+            pods = self._store.list("pods", copy_objs=False)
+            feats = featurizer.featurize(
+                nodes, pods, queue_pods=[pod], namespaces=namespaces
+            )
+            plugins = tuple(factory(feats))
+            eng = Engine(feats, plugins, record="full")
+            res = eng.evaluate_batch()
+            n_valid = feats.nodes.count
+            ok = np.asarray(res.reason_bits[0] == 0).all(axis=0)[:n_valid]
+            feasible = [feats.nodes.names[i] for i in range(n_valid) if ok[i]]
+            node_objs = {name_of(n): n for n in nodes}
+            failed = False
+            for idx, ext in enumerate(self._extenders.extenders):
+                if not feasible:
+                    break
+                if not ext.filter_verb:
+                    continue
+                args = {"pod": pod}
+                if ext.node_cache_capable:
+                    args["nodenames"] = list(feasible)
+                else:
+                    args["nodes"] = {"items": [node_objs[n] for n in feasible]}
+                try:
+                    result = self._extenders.filter(idx, args)
+                except Exception:
+                    logger.exception("extender %s filter failed", ext.name)
+                    if ext.ignorable:
+                        continue
+                    failed = True
+                    break
+                if result.get("error"):
+                    if ext.ignorable:
+                        continue
+                    failed = True
+                    break
+                if result.get("nodenames") is not None:
+                    keep = set(result["nodenames"])
+                    feasible = [n for n in feasible if n in keep]
+                elif result.get("nodes") is not None:
+                    keep = {
+                        name_of(item) for item in result["nodes"].get("items") or []
+                    }
+                    feasible = [n for n in feasible if n in keep]
+            selected = None
+            if feasible and not failed:
+                feasible_set = set(feasible)
+                totals = {
+                    feats.nodes.names[i]: int(res.total[0, i])
+                    for i in range(n_valid)
+                    if feats.nodes.names[i] in feasible_set
+                }
+                for idx, ext in enumerate(self._extenders.extenders):
+                    if not ext.prioritize_verb:
+                        continue
+                    args = {"pod": pod}
+                    if ext.node_cache_capable:
+                        args["nodenames"] = list(feasible)
+                    else:
+                        args["nodes"] = {"items": [node_objs[n] for n in feasible]}
+                    try:
+                        for hp in self._extenders.prioritize(idx, args):
+                            host = hp.get("host")
+                            if host in totals:
+                                totals[host] += int(hp.get("score") or 0)
+                    except Exception:
+                        logger.exception("extender %s prioritize failed", ext.name)
+                # selectHost: max score, lowest node index on ties.
+                order = {n: i for i, n in enumerate(feats.nodes.names)}
+                selected = max(feasible, key=lambda n: (totals[n], -order[n]))
+            # PostFilter still runs when nothing fit (the batch path's
+            # preemption applies identically; extenders may further have a
+            # preemptVerb — the proxy route records it when an external
+            # scheduler drives it).
+            nominated, victims, postfilter = None, [], None
+            if selected is None and self._preemption:
+                nominated, victims, postfilter = self._attempt_preemption(
+                    pod, feats, plugins, res, 0
+                )
+            anno = render_pod_results(feats, plugins, res, 0, postfilter=postfilter)
+            anno.update(self._extenders.store.get_stored_result(pod))
+
+            def mutate(obj: JSON) -> None:
+                annos = obj.setdefault("metadata", {}).setdefault("annotations", {})
+                apply_results_to_pod(annos, anno)
+                if selected:
+                    obj.setdefault("spec", {})["nodeName"] = selected
+                    obj.setdefault("status", {})["phase"] = "Running"
+                    obj.get("status", {}).pop("nominatedNodeName", None)
+                elif nominated:
+                    obj.setdefault("status", {})["nominatedNodeName"] = nominated
+
+            updated = self._store.patch("pods", name_of(pod), namespace_of(pod), mutate)
+            self._extenders.store.delete_data(pod)
+            with self._own_rvs_lock:
+                self._own_rvs.add(updated["metadata"]["resourceVersion"])
+            for v in victims:
+                try:
+                    self._store.delete("pods", name_of(v), namespace_of(v))
+                except Exception:
+                    logger.exception("failed to evict victim %s", name_of(v))
+            placements[f"{namespace_of(pod)}/{name_of(pod)}"] = selected
 
     def _bind_results(self, queue, feats, plugins, res, placements) -> None:
         for j, pod in enumerate(queue):
@@ -247,9 +383,9 @@ class SchedulerService:
         # Preemption dry-runs against the LIVE store (upstream uses the
         # live cache in PostFilter) — earlier preemptions in this pass
         # already removed their victims.
-        nodes = self._store.list("nodes")
-        cluster_pods = self._store.list("pods")
-        namespaces = self._store.list("namespaces")
+        nodes = self._store.list("nodes", copy_objs=False)
+        cluster_pods = self._store.list("pods", copy_objs=False)
+        namespaces = self._store.list("namespaces", copy_objs=False)
         if res.reason_bits is not None:
             live_mask = [mask_by_name.get(name_of(n), False) for n in nodes]
         decision = pre.find_preemption(
@@ -307,8 +443,42 @@ class SchedulerService:
             if rv in self._own_rvs:
                 self._own_rvs.discard(rv)
                 return False
+        self._flush_extender_results(ev)
         # A delete frees capacity; an add/update may need scheduling.
         return True
+
+    def _flush_extender_results(self, ev: WatchEvent) -> None:
+        """Reflector behavior for proxy-driven EXTERNAL schedulers
+        (reference storereflector.go:78-146 merges extender stores onto
+        the pod on update events): the in-process path flushes
+        synchronously, so anything left here came through the HTTP proxy
+        routes."""
+        if not self._extenders:
+            return
+        from ksim_tpu.state.cluster import DELETED
+
+        pod = ev.obj
+        if ev.event_type == DELETED:
+            self._extenders.store.delete_data(pod)
+            return
+        anno = self._extenders.store.get_stored_result(pod)
+        if not anno:
+            return
+        try:
+            updated = self._store.patch(
+                "pods",
+                name_of(pod),
+                namespace_of(pod),
+                lambda obj: obj.setdefault("metadata", {})
+                .setdefault("annotations", {})
+                .update(anno),
+            )
+        except Exception:
+            logger.exception("failed to flush extender results")
+            return
+        with self._own_rvs_lock:
+            self._own_rvs.add(updated["metadata"]["resourceVersion"])
+        self._extenders.store.delete_data(pod)
 
     def _run(self) -> None:
         stream = self._store.watch(("pods", "nodes"))

@@ -32,11 +32,16 @@ class DIContainer:
             self.store, scheduler_service=self.scheduler_service
         )
         self.reset_service = ResetService(self.store, self.scheduler_service)
-        # Placeholder until the extender webhook proxy lands; the HTTP
-        # routes exist either way (reference server.go:88-93).
-        self.extender_service: Any = None
         if start_scheduler:
             self.scheduler_service.start()
+
+    @property
+    def extender_service(self) -> Any:
+        """The proxy behind /api/v1/extender/<verb>/<id> (server.go:88-93);
+        follows the scheduler config (extenders live in
+        KubeSchedulerConfiguration.extenders)."""
+        svc = self.scheduler_service.extender_service
+        return svc if svc else None
 
     def shutdown(self) -> None:
         self.scheduler_service.stop()

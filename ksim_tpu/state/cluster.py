@@ -109,13 +109,19 @@ class ClusterStore:
             except KeyError:
                 raise NotFoundError(f"{kind} {key!r} not found") from None
 
-    def list(self, kind: str, namespace: str = "") -> list[JSON]:
+    def list(self, kind: str, namespace: str = "", *, copy_objs: bool = True) -> list[JSON]:
+        """List objects sorted by name.  ``copy_objs=False`` returns the
+        live dicts for READ-ONLY hot paths (featurization lists the whole
+        cluster every scheduling pass; deep-copying thousands of pod dicts
+        per pass dominated churn-replay profiles) — callers must not
+        mutate and must not hold them across store writes."""
         self._check_kind(kind)
         with self._lock:
             objs = self._objects[kind].values()
             if namespace and kind in NAMESPACED_KINDS:
                 objs = [o for o in objs if namespace_of(o) == namespace]
-            return copy.deepcopy(sorted(objs, key=name_of))
+            out = sorted(objs, key=name_of)
+            return copy.deepcopy(out) if copy_objs else out
 
     def update(self, kind: str, obj: JSON, *, expect_rv: str | None = None) -> JSON:
         """Replace an object; raises ConflictError if expect_rv is stale."""

@@ -43,6 +43,16 @@ FORBIDDING_EFFECTS = ("NoSchedule", "NoExecute")
 # -- node-affinity / node-selector encoding ---------------------------------
 
 
+def _vpad(n: int, minimum: int = 8) -> int:
+    """Pad a vocabulary axis to a power-of-two bucket: churn replay adds
+    and removes vocab entries constantly, and unbucketed vocab shapes
+    would force an XLA recompile on nearly every step (the pod/node axes
+    are already bucketed by the featurizer)."""
+    from ksim_tpu.state.featurizer import bucket_size
+
+    return bucket_size(max(n, 1), minimum)
+
+
 def _canon(obj: Any) -> str:
     return json.dumps(obj, sort_keys=True, separators=(",", ":"))
 
@@ -151,8 +161,8 @@ def encode_affinity(
                 w = int(pt.get("weight", 0))
                 pref[j][tid] = pref[j].get(tid, 0) + w
 
-    Q = len(vocab.req_list)
-    T = len(vocab.term_list)
+    Q = _vpad(len(vocab.req_list))
+    T = _vpad(len(vocab.term_list))
     node_req_match = np.zeros((n_padded, max(Q, 1)), dtype=bool)
     for ni, node in enumerate(nodes):
         lbls = dict(labels_of(node))
@@ -236,7 +246,7 @@ def encode_taints(
     for node in nodes:
         per_node.append([tid(t) for t in node.get("spec", {}).get("taints") or []])
 
-    W = max(len(taints), 1)
+    W = _vpad(len(taints))
     order = np.zeros((n_padded, W), dtype=np.int32)
     for ni, ids in enumerate(per_node):
         for pos, w in enumerate(ids):
@@ -400,7 +410,7 @@ def encode_topology_spread(
         tk_sizes[ki] = max(len(per_key_loc[ki]), 1)
         tk_singleton[ki] = all(c <= 1 for c in per_key_cnt[ki].values())
 
-    S = max(len(sel_list), 1)
+    S = _vpad(len(sel_list))
     init_counts = np.zeros((n_padded, S), dtype=np.int32)
     node_index = {name_of(n): i for i, n in enumerate(nodes)}
     for bp in bound_pods:
@@ -419,7 +429,7 @@ def encode_topology_spread(
             )
 
     MC = max((len(c) for c in per_pod_cons), default=0)
-    MC = max(MC, 1)
+    MC = _vpad(MC, minimum=2)
     shape = (p_padded, MC)
     con_valid = np.zeros(shape, dtype=bool)
     con_mode = np.zeros(shape, dtype=np.int32)

@@ -103,7 +103,9 @@ def encode_node_ports(
     for ports in pod_ports:
         for t in ports:
             vocab.setdefault(t, len(vocab))
-    v = max(len(vocab), 1)
+    from ksim_tpu.state.featurizer import bucket_size
+
+    v = bucket_size(max(len(vocab), 1), 8)
     entries = list(vocab)
 
     conflict_counts = np.zeros((n_padded, v), dtype=np.int32)
@@ -182,7 +184,9 @@ def encode_image_locality(
                 imgs.append(vocab.setdefault(normalized_image_name(img), len(vocab)))
         pod_imgs.append(imgs)
 
-    i = max(len(vocab), 1)
+    from ksim_tpu.state.featurizer import bucket_size
+
+    i = bucket_size(max(len(vocab), 1), 8)
     node_has = np.zeros((n_padded, i), dtype=bool)
     size = np.zeros(i, dtype=np.float64)
     num_nodes = np.zeros(i, dtype=np.int32)

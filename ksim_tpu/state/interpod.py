@@ -223,8 +223,13 @@ def encode_inter_pod(
     queue_terms = [terms_of(p) for p in pods]
     bound_terms = [terms_of(p) for p in bound_pods]
 
-    U = max(len(vocab.ctxs), 1)
-    T = max(len(vocab.terms), 1)
+    from ksim_tpu.state.featurizer import bucket_size
+
+    # Vocab axes pad to power-of-two buckets (padded terms are inert:
+    # term_u/term_tk 0 with all-zero pod columns), bounding recompiles
+    # under churn.
+    U = bucket_size(max(len(vocab.ctxs), 1), 8)
+    T = bucket_size(max(len(vocab.terms), 1), 8)
     TK = max(len(vocab.tk_ids), 1)
 
     term_u = np.zeros(T, dtype=np.int32)

@@ -16,6 +16,7 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass
 from fractions import Fraction
+from functools import lru_cache
 
 # Decimal SI suffixes (powers of 10) and binary suffixes (powers of 1024).
 _DECIMAL_SUFFIXES = {
@@ -90,9 +91,16 @@ class Quantity:
 
 
 def parse_quantity(s: str | int | float | Quantity) -> Quantity:
-    """Parse a Kubernetes quantity string ("100m", "2Gi", "1.5", "1e3")."""
+    """Parse a Kubernetes quantity string ("100m", "2Gi", "1.5", "1e3").
+    Cached — clusters repeat a handful of distinct quantity strings, and
+    featurization parses them for every pod every scheduling pass."""
     if isinstance(s, Quantity):
         return s
+    return _parse_quantity_cached(s)
+
+
+@lru_cache(maxsize=65536)
+def _parse_quantity_cached(s: str | int | float) -> Quantity:
     if isinstance(s, int):
         return Quantity(Fraction(s))
     if isinstance(s, float):

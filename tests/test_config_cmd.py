@@ -1,0 +1,99 @@
+"""Config layering (env over yaml) and the two process entrypoints."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ksim_tpu.config import load_config
+from ksim_tpu.errors import InvalidConfigError
+from tests.helpers import make_node, make_pod
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def clean_env(monkeypatch):
+    for k in (
+        "PORT",
+        "CORS_ALLOWED_ORIGIN_LIST",
+        "KUBE_SCHEDULER_CONFIG_PATH",
+        "EXTERNAL_IMPORT_ENABLED",
+        "RESOURCE_SYNC_ENABLED",
+        "EXTERNAL_SNAPSHOT_PATH",
+    ):
+        monkeypatch.delenv(k, raising=False)
+    return monkeypatch
+
+
+def test_yaml_plus_env_layering(tmp_path, clean_env):
+    sched = tmp_path / "scheduler.yaml"
+    sched.write_text("profiles:\n- schedulerName: my-sched\n")
+    cfg_file = tmp_path / "config.yaml"
+    cfg_file.write_text(
+        "apiVersion: kube-scheduler-simulator-config/v1alpha1\n"
+        "kind: SimulatorConfiguration\n"
+        "port: 3131\n"
+        "corsAllowedOriginList:\n- http://localhost:3000\n"
+        f"kubeSchedulerConfigPath: {sched}\n"
+        "etcdURL: http://ignored:2379\n"  # KWOK-topology field: ignored
+    )
+    cfg = load_config(str(cfg_file))
+    assert cfg.port == 3131
+    assert cfg.cors_allowed_origin_list == ("http://localhost:3000",)
+    assert cfg.initial_scheduler_cfg["profiles"][0]["schedulerName"] == "my-sched"
+    # Env overrides yaml (reference getPort: PORT first).
+    clean_env.setenv("PORT", "4545")
+    assert load_config(str(cfg_file)).port == 4545
+
+
+def test_import_modes_mutually_exclusive(tmp_path, clean_env):
+    cfg_file = tmp_path / "config.yaml"
+    cfg_file.write_text(
+        "port: 1212\nexternalImportEnabled: true\nresourceSyncEnabled: true\n"
+        "externalSnapshotPath: /tmp/x.json\n"
+    )
+    with pytest.raises(InvalidConfigError):
+        load_config(str(cfg_file))
+    cfg_file.write_text("port: 1212\nexternalImportEnabled: true\n")
+    with pytest.raises(InvalidConfigError):
+        load_config(str(cfg_file))  # import without a source
+
+
+def _run_cmd(args, timeout=120):
+    env = dict(os.environ)
+    # CPU is plenty for entrypoint smoke tests.
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+        env=env,
+    )
+
+
+def test_scheduler_entrypoint_schedules_snapshot(tmp_path):
+    snap = {
+        "nodes": [make_node("n0", cpu="4", memory="8Gi")],
+        "pods": [make_pod("p0", cpu="1", memory="1Gi")],
+        "pvs": [], "pvcs": [], "storageClasses": [], "priorityClasses": [],
+        "namespaces": [], "schedulerConfig": None,
+    }
+    snap_file = tmp_path / "snap.json"
+    snap_file.write_text(json.dumps(snap))
+    out_file = tmp_path / "out.json"
+    proc = _run_cmd(
+        ["ksim_tpu.cmd.scheduler", "--snapshot", str(snap_file), "--out", str(out_file)]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(out_file.read_text())
+    assert result["pods"][0]["spec"]["nodeName"] == "n0"
+    anno = result["pods"][0]["metadata"]["annotations"]
+    assert anno["kube-scheduler-simulator.sigs.k8s.io/selected-node"] == "n0"

@@ -1,0 +1,137 @@
+"""Resource syncer + one-shot importer (reference simulator/syncer/,
+simulator/oneshotimporter/): mirroring semantics, mandatory mutators and
+filters, NotFound tolerance — tested with two in-memory stores, the way
+the reference fakes two clusters with fake dynamic clients
+(syncer_test.go:18-25)."""
+
+from __future__ import annotations
+
+import time
+
+from ksim_tpu.oneshotimporter import OneShotImporter
+from ksim_tpu.state.cluster import ClusterStore
+from ksim_tpu.state.snapshot import SnapshotService
+from ksim_tpu.syncer import Syncer, SyncerOptions
+from tests.helpers import make_node, make_pod
+
+
+def _wait(pred, timeout=5.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_initial_sync_strips_metadata_and_mutates_pods():
+    src, dst = ClusterStore(), ClusterStore()
+    src.create("nodes", make_node("n0"))
+    pod = make_pod("p0")
+    pod["spec"]["serviceAccountName"] = "robot"
+    pod["metadata"]["ownerReferences"] = [{"kind": "ReplicaSet", "name": "rs"}]
+    pod["metadata"]["uid"] = "src-uid-42"
+    src.create("pods", pod)
+    Syncer(src, dst).sync_once()
+    got = dst.get("pods", "p0")
+    assert "serviceAccountName" not in got["spec"]
+    assert "ownerReferences" not in got["metadata"]
+    # Destination assigns its own uid (the source's is stripped).
+    assert got["metadata"]["uid"] != "src-uid-42"
+    assert dst.get("nodes", "n0")
+
+
+def test_watch_mirroring_and_scheduled_pod_filter():
+    src, dst = ClusterStore(), ClusterStore()
+    syncer = Syncer(src, dst).run()
+    try:
+        src.create("nodes", make_node("n0"))
+        assert _wait(lambda: dst.list("nodes"))
+        # Unscheduled pod update mirrors; scheduled pod update does not.
+        src.create("pods", make_pod("p0"))
+        assert _wait(lambda: dst.list("pods"))
+        src.patch("pods", "p0", "default",
+                  lambda o: o["metadata"]["labels"].__setitem__("x", "1"))
+        assert _wait(lambda: dst.get("pods", "p0")["metadata"]["labels"].get("x") == "1")
+        # Bind on the SOURCE: the update must be filtered out.
+        src.patch("pods", "p0", "default",
+                  lambda o: o["spec"].__setitem__("nodeName", "n0"))
+        time.sleep(0.3)
+        assert "nodeName" not in dst.get("pods", "p0")["spec"]
+        # Deletes mirror; deleting an already-missing object is tolerated.
+        src.delete("pods", "p0")
+        assert _wait(lambda: not dst.list("pods"))
+        dst.create("nodes", make_node("only-dst"))
+        src.create("nodes", make_node("only-dst"))
+        src.delete("nodes", "only-dst")
+        assert _wait(lambda: "only-dst" not in
+                     [n["metadata"]["name"] for n in dst.list("nodes")])
+    finally:
+        syncer.stop()
+
+
+def test_pv_claimref_uid_reresolved_against_destination():
+    src, dst = ClusterStore(), ClusterStore()
+    pvc = {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "claim", "namespace": "default"}, "spec": {},
+    }
+    src.create("persistentvolumeclaims", dict(pvc))
+    pv = {
+        "apiVersion": "v1", "kind": "PersistentVolume",
+        "metadata": {"name": "vol"},
+        "spec": {"claimRef": {"name": "claim", "namespace": "default",
+                              "uid": "stale-src-uid"}},
+        "status": {"phase": "Bound"},
+    }
+    src.create("persistentvolumes", pv)
+    Syncer(src, dst).sync_once()
+    got = dst.get("persistentvolumes", "vol")
+    dst_pvc_uid = dst.get("persistentvolumeclaims", "claim")["metadata"]["uid"]
+    assert got["spec"]["claimRef"]["uid"] == dst_pvc_uid != "stale-src-uid"
+
+
+def test_user_filters_and_mutators_compose():
+    src, dst = ClusterStore(), ClusterStore()
+    src.create("nodes", make_node("keep"))
+    src.create("nodes", make_node("drop"))
+    opts = SyncerOptions(
+        additional_filtering={
+            "nodes": lambda o, d, e: o["metadata"]["name"] != "drop"
+        },
+        additional_mutating={
+            "nodes": lambda o, d, e: {
+                **o, "metadata": {**o["metadata"],
+                                  "labels": {**o["metadata"].get("labels", {}),
+                                             "synced": "true"}},
+            }
+        },
+    )
+    Syncer(src, dst, opts).sync_once()
+    names = [n["metadata"]["name"] for n in dst.list("nodes")]
+    assert names == ["keep"]
+    assert dst.get("nodes", "keep")["metadata"]["labels"]["synced"] == "true"
+
+
+def test_oneshot_importer_ignores_scheduler_config_and_errors():
+    src, dst = ClusterStore(), ClusterStore()
+
+    class FakeSched:
+        def __init__(self):
+            self.applied = None
+
+        def get_scheduler_config(self):
+            return {"profiles": [{"schedulerName": "src-sched"}]}
+
+        def apply_scheduler_config(self, cfg):
+            self.applied = cfg
+
+    src_svc = SnapshotService(src, scheduler_service=FakeSched())
+    dst_sched = FakeSched()
+    dst_svc = SnapshotService(dst, scheduler_service=dst_sched)
+    src.create("nodes", make_node("n0"))
+    src.create("pods", make_pod("p0"))
+    OneShotImporter(dst_svc, src_svc).import_cluster_resources()
+    assert dst.get("nodes", "n0") and dst.get("pods", "p0")
+    # The source's scheduler config is never applied (importer.go note).
+    assert dst_sched.applied is None

@@ -1,0 +1,40 @@
+"""TPU test tier: spawn tpu_parity_main.py against the real chip (the
+suite itself is pinned to the virtual-CPU backend by conftest.py, so the
+check runs in a subprocess with the image's default platform)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_tpu_backend_parity():
+    env = dict(os.environ)
+    # Drop the virtual-CPU-mesh flag the suite injects; keep the image's
+    # default platform (the axon TPU plugin).
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    )
+    # Prepend (not replace): the image's PYTHONPATH carries the axon TPU
+    # plugin's sitecustomize — dropping it would silently lose the chip.
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tests" / "tpu_parity_main.py")],
+        capture_output=True,
+        text=True,
+        timeout=580,
+        cwd=REPO,
+        env=env,
+    )
+    if proc.returncode == 42:
+        pytest.skip(f"no TPU available: {proc.stderr.strip()[-200:]}")
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"

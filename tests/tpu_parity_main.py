@@ -1,0 +1,85 @@
+"""TPU-backend parity check (run as a subprocess by test_tpu_parity.py).
+
+Runs the kernels on the REAL TPU with x64 enabled (XLA emulates s64/f64
+on TPU) and asserts bit-for-bit agreement with the float64/int64 oracle —
+the SURVEY section-4 "CPU-vs-TPU numerical-equality" tier.  Exit codes:
+0 parity holds, 42 no TPU available, 1 mismatch.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # no backend at all
+        print(f"no TPU backend: {e}", file=sys.stderr)
+        return 42
+    if platform != "tpu":
+        print(f"default platform is {platform!r}, not tpu", file=sys.stderr)
+        return 42
+
+    import numpy as np
+
+    from ksim_tpu.engine import Engine
+    from ksim_tpu.engine.profiles import default_plugins
+    from ksim_tpu.plugins import oracle
+    from ksim_tpu.state.featurizer import Featurizer
+    from tests.helpers import random_cluster
+    from tests.test_engine_schedule import greedy_oracle
+
+    failures = 0
+    for seed in (0, 1, 2):
+        nodes, pods = random_cluster(seed, n_nodes=11, n_pods=47, bound_fraction=0.25)
+        queue = [p for p in pods if not p["spec"].get("nodeName")]
+        feats = Featurizer().featurize(nodes, pods, queue_pods=queue)
+        eng = Engine(feats, default_plugins(feats), record="full")
+
+        # Sequential selections must match the pure-Python greedy oracle
+        # (exercises every filter, score, normalize, and carry commit).
+        res, _ = eng.schedule()
+        want = greedy_oracle(nodes, pods, queue)
+        got = [int(x) for x in res.selected[: len(queue)]]
+        if got != want:
+            print(f"seed {seed}: selections differ\n got {got}\nwant {want}")
+            failures += 1
+
+        # Batch raw scores vs the oracle, per plugin per node.
+        bres = eng.evaluate_batch()
+        infos = oracle.build_node_infos(nodes, pods)
+        checks = {
+            "NodeResourcesFit": oracle.least_allocated_score,
+            "NodeResourcesBalancedAllocation": oracle.balanced_allocation_score,
+            "TaintToleration": oracle.taint_toleration_score,
+            "NodeAffinity": oracle.node_affinity_score,
+        }
+        for name, fn in checks.items():
+            si = bres.plugin_names.index(name)
+            for pi, pod in enumerate(queue):
+                for ni, info in enumerate(infos):
+                    w = fn(pod, info)
+                    g = int(bres.scores[pi, si, ni])
+                    if g != w:
+                        print(
+                            f"seed {seed}: {name} score mismatch pod {pi} "
+                            f"node {ni}: got {g} want {w}"
+                        )
+                        failures += 1
+        print(f"seed {seed}: ok ({len(queue)} pods x {len(nodes)} nodes)")
+    if failures:
+        print(f"{failures} mismatches", file=sys.stderr)
+        return 1
+    print("tpu parity: all checks passed (platform=tpu, x64 on)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
