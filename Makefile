@@ -1,0 +1,20 @@
+# Developer entrypoints (the reference's Makefile analogue).
+
+PY ?= python
+
+.PHONY: test test-tpu bench serve lint
+
+test:
+	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
+
+test-tpu:
+	$(PY) -m pytest tests/test_tpu_parity.py -q -rs
+
+bench:
+	$(PY) bench.py
+
+serve:
+	$(PY) -m ksim_tpu.cmd.simulator
+
+lint:
+	$(PY) -m compileall -q ksim_tpu

@@ -7,9 +7,16 @@ product's recorded-results path), on whatever jax default backend is live
 (TPU under the driver), over a ladder of cluster sizes ending at the
 BASELINE config-4 shape (10k pods x 5k nodes).
 
+The headline runs in EXACT mode — x64 enabled, so the int64/float64
+scoring paths are active and final scores are bit-exact vs the upstream
+plugins (XLA emulates s64/f64 on TPU; verified by
+tests/tpu_parity_main.py on a real v5e).  Each rung also reports the
+float32 fast mode (documented ±1 rounding tolerance at integer-ratio
+boundaries) as ``sched_pairs_per_sec_f32``.
+
 Each rung is isolated: a crash at one size still reports the others.
-Prints ONE JSON line with the headline metric (sequential-scan pairs/sec
-at the largest completed rung):
+Prints ONE JSON line with the headline metric (exact sequential-scan
+pairs/sec at the largest completed rung):
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N/50000, "rungs": {...}}
 Baseline: >= 50k pairs/sec north star (BASELINE.json).
 """
@@ -46,15 +53,30 @@ def run_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
     )
     pairs = n_pods * n_nodes
 
-    # Sequential-commit scan (the real scheduling semantics) — headline.
+    # Sequential-commit scan (the real scheduling semantics), exact mode
+    # (x64 active, set by main) — headline.
     eng = Engine(feats, default_plugins(feats), record="selection")
     eng.schedule()  # compile + warmup
     times = []
     for _ in range(repeats):
         t = time.perf_counter()
-        res, _state = eng.schedule()
+        res, _state = eng.schedule(pull_state=False)
         times.append(time.perf_counter() - t)
     sched_s = min(times)
+
+    # float32 fast mode (same kernels, f32 normalize/score paths).
+    jax.config.update("jax_enable_x64", False)
+    try:
+        eng32 = Engine(feats, default_plugins(feats), record="selection")
+        eng32.schedule()
+        times = []
+        for _ in range(repeats):
+            t = time.perf_counter()
+            eng32.schedule(pull_state=False)
+            times.append(time.perf_counter() - t)
+        sched32_s = min(times)
+    finally:
+        jax.config.update("jax_enable_x64", True)
 
     # One-shot batch evaluation, record="full": materializes every filter
     # reason / raw score / final score matrix (the product's recorded
@@ -78,14 +100,18 @@ def run_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
     n_sched = int((res.selected >= 0).sum())
     rung = {
         "sched_pairs_per_sec": round(pairs / sched_s),
+        "sched_pairs_per_sec_f32": round(pairs / sched32_s),
         "batch_pairs_per_sec": round(pairs / batch_s),
         "sched_s": round(sched_s, 3),
+        "sched_f32_s": round(sched32_s, 3),
         "batch_s": round(batch_s, 3),
         "pods_scheduled": n_sched,
+        "exact": True,
     }
     print(
-        f"[{n_pods}x{n_nodes}] scan {sched_s*1e3:.0f}ms "
+        f"[{n_pods}x{n_nodes}] scan-exact {sched_s*1e3:.0f}ms "
         f"({pairs/sched_s/1e6:.2f}M pairs/s, {n_sched} placed), "
+        f"scan-f32 {sched32_s*1e3:.0f}ms ({pairs/sched32_s/1e6:.2f}M pairs/s), "
         f"batch-full {batch_s*1e3:.0f}ms ({pairs/batch_s/1e6:.2f}M pairs/s)",
         file=sys.stderr,
     )
@@ -129,6 +155,9 @@ def main() -> None:
 
     import jax
 
+    # Exact mode for the headline: int64/float64 scoring paths active.
+    jax.config.update("jax_enable_x64", True)
+
     ladder = LADDER
     if args.only:
         p, n = args.only.lower().split("x")
@@ -158,7 +187,10 @@ def main() -> None:
             {
                 "metric": "sched_pairs_per_sec",
                 "value": value,
-                "unit": "pod-node pairs/s (sequential-commit scan, largest completed rung)",
+                "unit": (
+                    "pod-node pairs/s (sequential-commit scan, bit-exact "
+                    "finalscore mode, largest completed rung)"
+                ),
                 "vs_baseline": round(value / 50_000, 2),
                 "platform": jax.devices()[0].platform,
                 "rungs": rungs,

@@ -57,7 +57,13 @@ def load_config(path: str | None = None) -> SimulatorConfig:
     elif path:  # explicitly named file must exist
         raise InvalidConfigError(f"config file {path!r} not found")
 
-    port = int(os.environ.get("PORT") or raw.get("port") or DEFAULT_PORT)
+    port_raw = os.environ.get("PORT")
+    if port_raw is None:
+        port_raw = raw.get("port")
+    try:
+        port = DEFAULT_PORT if port_raw in (None, "") else int(port_raw)
+    except (TypeError, ValueError):
+        raise InvalidConfigError(f"invalid PORT {port_raw!r}") from None
     cors_env = os.environ.get("CORS_ALLOWED_ORIGIN_LIST", "")
     cors = (
         tuple(x for x in cors_env.split(",") if x)

@@ -123,21 +123,35 @@ def render_pod_results(
     PostFilter wrapper when preemption ran (wrappedplugin.go:550-577)."""
     if res.reason_bits is None:
         raise ValueError("render_pod_results needs record='full' results")
+    import numpy as np
+
     node_names = feats.nodes.names
     filter_plugins = [sp for sp in plugins if sp.filter_enabled]
     score_plugins = [sp for sp in plugins if sp.score_enabled]
 
+    # Decode reason bits through a per-plugin memo: clusters repeat a
+    # handful of distinct bit patterns across thousands of nodes, and the
+    # rendered results are the product's hot output path at 10k x 5k
+    # (SURVEY hard part 7).
+    bits_pi = np.asarray(res.reason_bits[pi])  # [F, N]
+    decode_memo: list[dict[int, str]] = []
+    for fi, sp in enumerate(filter_plugins):
+        memo: dict[int, str] = {0: PASSED_FILTER_MESSAGE}
+        for b in np.unique(bits_pi[fi, : len(node_names)]):
+            if int(b) != 0:
+                memo[int(b)] = ", ".join(sp.plugin.decode_reasons(int(b)))
+        decode_memo.append(memo)
+
     filter_map: dict[str, dict[str, str]] = {}
     feasible_nodes: list[int] = []
+    plugin_names_f = [sp.plugin.name for sp in filter_plugins]
     for ni, node in enumerate(node_names):
         row: dict[str, str] = {}
         ok = True
-        for fi, sp in enumerate(filter_plugins):
-            bits = int(res.reason_bits[pi, fi, ni])
-            if bits == 0:
-                row[sp.plugin.name] = PASSED_FILTER_MESSAGE
-            else:
-                row[sp.plugin.name] = ", ".join(sp.plugin.decode_reasons(bits))
+        for fi in range(len(filter_plugins)):
+            bits = int(bits_pi[fi, ni])
+            row[plugin_names_f[fi]] = decode_memo[fi][bits]
+            if bits != 0:
                 ok = False
                 break  # upstream RunFilterPlugins early exit
         filter_map[node] = row

@@ -54,6 +54,32 @@ from ksim_tpu.state.featurizer import FeaturizedSnapshot
 
 
 @dataclass(frozen=True)
+class PluginExtender:
+    """Before/After hooks around one plugin's extension points — the
+    TPU-native form of the reference's PluginExtender surface
+    (simulator/scheduler/plugin/wrappedplugin.go:47-171): hooks are
+    jax-traceable callables over the BATCHED tensors, compiled into the
+    engine programs rather than wrapped around per-(pod,node) calls.
+
+    - before_filter(state, pod, aux) -> (state, pod): rewrite inputs;
+    - after_filter(state, pod, aux, out: FilterOutput) -> FilterOutput;
+    - before_score(state, pod, aux) -> (state, pod);
+    - after_score(state, pod, aux, scores) -> scores (pre-normalize).
+
+    Implement ``static_sig()`` for cross-instance program reuse; without
+    it the engine keys the jit cache by extender identity (always safe).
+    """
+
+    before_filter: Any = None
+    after_filter: Any = None
+    before_score: Any = None
+    after_score: Any = None
+
+    def static_sig(self) -> tuple | None:
+        return None
+
+
+@dataclass(frozen=True)
 class ScoredPlugin:
     """A plugin enabled in a profile, with its score weight."""
 
@@ -61,6 +87,7 @@ class ScoredPlugin:
     weight: int = 1
     filter_enabled: bool = True
     score_enabled: bool = True
+    extender: PluginExtender | None = None
 
 
 @dataclass
@@ -152,7 +179,13 @@ class _Program:
         self._sig = (
             record,
             tuple(
-                (_plugin_sig(sp.plugin), sp.weight, sp.filter_enabled, sp.score_enabled)
+                (
+                    _plugin_sig(sp.plugin),
+                    sp.weight,
+                    sp.filter_enabled,
+                    sp.score_enabled,
+                    _plugin_sig(sp.extender) if sp.extender is not None else None,
+                )
                 for sp in plugins
             ),
         )
@@ -178,7 +211,13 @@ class _Program:
             if not sp.filter_enabled:
                 continue
             kw = {"carry": carries[sp.plugin.name]} if sp.plugin.name in carries else {}
-            out: FilterOutput = sp.plugin.filter(state, pod, aux, **kw)
+            ext = sp.extender
+            f_state, f_pod = state, pod
+            if ext is not None and ext.before_filter is not None:
+                f_state, f_pod = ext.before_filter(f_state, f_pod, aux)
+            out: FilterOutput = sp.plugin.filter(f_state, f_pod, aux, **kw)
+            if ext is not None and ext.after_filter is not None:
+                out = ext.after_filter(f_state, f_pod, aux, out)
             reason_bits.append(out.reason_bits)
             filter_ok = filter_ok & out.ok
         raw_scores = []
@@ -188,8 +227,14 @@ class _Program:
             if not sp.score_enabled:
                 continue
             kw = {"carry": carries[sp.plugin.name]} if sp.plugin.name in carries else {}
-            raw = sp.plugin.score(state, pod, aux, ok=filter_ok, **kw)
-            final = _final_from_raw(sp.plugin, raw, filter_ok, sp.weight, state, pod, aux, kw)
+            ext = sp.extender
+            s_state, s_pod = state, pod
+            if ext is not None and ext.before_score is not None:
+                s_state, s_pod = ext.before_score(s_state, s_pod, aux)
+            raw = sp.plugin.score(s_state, s_pod, aux, ok=filter_ok, **kw)
+            if ext is not None and ext.after_score is not None:
+                raw = ext.after_score(s_state, s_pod, aux, raw)
+            final = _final_from_raw(sp.plugin, raw, filter_ok, sp.weight, s_state, s_pod, aux, kw)
             raw_scores.append(raw)
             final_scores.append(final)
             total = total + final.astype(jnp.int32)

@@ -79,7 +79,7 @@ class PodTopologySpread:
     normalize_needs_ctx = True
 
     def __init__(self, spread: SpreadTensors) -> None:
-        from ksim_tpu.state.featurizer import bucket_size
+        from ksim_tpu.state.featurizer import vocab_pad
 
         self._mc = spread.con_valid.shape[1]
         self._n_tk = spread.node_ldom.shape[1]
@@ -90,7 +90,7 @@ class PodTopologySpread:
         # use their size at all.  Unbucketed sizes would recompile on
         # every node add/remove under churn.
         self._sizes = tuple(
-            1 if singleton else bucket_size(size, 8)
+            1 if singleton else vocab_pad(size)
             for size, singleton in zip(spread.tk_sizes, spread.tk_singleton)
         )
 

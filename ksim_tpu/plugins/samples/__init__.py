@@ -1,0 +1,19 @@
+"""Sample out-of-tree plugins (the reference's pkg/nodenumber analogue)."""
+
+from ksim_tpu.plugins.samples.nodenumber import (
+    DataProviderScore,
+    NodeNumber,
+    data_provider_builder,
+    encode_node_number,
+    node_number_builder,
+    provider_encoder,
+)
+
+__all__ = [
+    "DataProviderScore",
+    "NodeNumber",
+    "data_provider_builder",
+    "encode_node_number",
+    "node_number_builder",
+    "provider_encoder",
+]

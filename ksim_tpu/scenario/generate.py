@@ -105,7 +105,7 @@ def churn_scenario(
                 yield Operation(
                     step=step, op="create", kind="pods", obj=_mk_pod(rng, name)
                 )
-            elif r < pod_create_frac + pod_delete_frac or len(live_nodes) <= n_nodes // 2:
+            elif r < pod_create_frac + pod_delete_frac:
                 victim = live_pods.pop(rng.randrange(len(live_pods)))
                 yield Operation(
                     step=step, op="delete", kind="pods",

@@ -293,7 +293,9 @@ class SchedulerService:
             # preemptVerb — the proxy route records it when an external
             # scheduler drives it).
             nominated, victims, postfilter = None, [], None
-            if selected is None and self._preemption:
+            # An aborted cycle (non-ignorable extender error) never runs
+            # PostFilter — upstream gives up on the pod for this pass.
+            if selected is None and self._preemption and not failed:
                 nominated, victims, postfilter = self._attempt_preemption(
                     pod, feats, plugins, res, 0
                 )

@@ -169,6 +169,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             idx = int(parts[5])
+            if idx < 0:  # Python's negative indexing must not dispatch
+                raise IndexError(idx)
             out = getattr(svc, parts[4])(idx, self._body())
         except (IndexError, ValueError):
             self._json(400, {"message": "Bad Request"})

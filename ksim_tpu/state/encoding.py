@@ -44,13 +44,9 @@ FORBIDDING_EFFECTS = ("NoSchedule", "NoExecute")
 
 
 def _vpad(n: int, minimum: int = 8) -> int:
-    """Pad a vocabulary axis to a power-of-two bucket: churn replay adds
-    and removes vocab entries constantly, and unbucketed vocab shapes
-    would force an XLA recompile on nearly every step (the pod/node axes
-    are already bucketed by the featurizer)."""
-    from ksim_tpu.state.featurizer import bucket_size
+    from ksim_tpu.state.featurizer import vocab_pad
 
-    return bucket_size(max(n, 1), minimum)
+    return vocab_pad(n, minimum)
 
 
 def _canon(obj: Any) -> str:

@@ -103,9 +103,9 @@ def encode_node_ports(
     for ports in pod_ports:
         for t in ports:
             vocab.setdefault(t, len(vocab))
-    from ksim_tpu.state.featurizer import bucket_size
+    from ksim_tpu.state.featurizer import vocab_pad
 
-    v = bucket_size(max(len(vocab), 1), 8)
+    v = vocab_pad(len(vocab))
     entries = list(vocab)
 
     conflict_counts = np.zeros((n_padded, v), dtype=np.int32)
@@ -184,9 +184,9 @@ def encode_image_locality(
                 imgs.append(vocab.setdefault(normalized_image_name(img), len(vocab)))
         pod_imgs.append(imgs)
 
-    from ksim_tpu.state.featurizer import bucket_size
+    from ksim_tpu.state.featurizer import vocab_pad
 
-    i = bucket_size(max(len(vocab), 1), 8)
+    i = vocab_pad(len(vocab))
     node_has = np.zeros((n_padded, i), dtype=bool)
     size = np.zeros(i, dtype=np.float64)
     num_nodes = np.zeros(i, dtype=np.int32)

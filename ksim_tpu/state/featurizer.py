@@ -65,6 +65,14 @@ def bucket_size(n: int, minimum: int = 8) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def vocab_pad(n: int, minimum: int = 8) -> int:
+    """Power-of-two bucket for a VOCABULARY axis: churn replay adds and
+    removes vocab entries constantly, and unbucketed vocab shapes would
+    force an XLA recompile on nearly every step (the pod/node axes are
+    bucketed the same way)."""
+    return bucket_size(max(n, 1), minimum)
+
+
 @dataclass
 class NodeTensors:
     """Per-node device-ready arrays, shape [N] or [N, R]."""
@@ -135,7 +143,12 @@ class Featurizer:
         node_bucket_min: int = 8,
         pod_bucket_min: int = 8,
         interpod_hard_weight: int | None = None,
+        extra_encoders: "dict[str, Any] | None" = None,
     ) -> None:
+        """``extra_encoders`` maps aux key -> fn(nodes, queue_pods,
+        n_padded, p_padded) -> dataclass-with-AXES — the hook out-of-tree
+        plugins use to ship their own tensors to the device (the sample
+        NodeNumber / data-provider plugins ride this)."""
         if interpod_hard_weight is None:
             from ksim_tpu.state.interpod import DEFAULT_HARD_POD_AFFINITY_WEIGHT
 
@@ -143,6 +156,7 @@ class Featurizer:
         self._node_bucket_min = node_bucket_min
         self._pod_bucket_min = pod_bucket_min
         self._interpod_hard_weight = interpod_hard_weight
+        self._extra_encoders = dict(extra_encoders or {})
 
     def featurize(
         self,
@@ -288,6 +302,8 @@ class Featurizer:
             "nodeports": encode_node_ports(nodes, sched_pods, bound_pods, NP, PP),
             "imagelocality": encode_image_locality(nodes, sched_pods, NP, PP),
         }
+        for key, encoder in self._extra_encoders.items():
+            aux[key] = encoder(nodes, sched_pods, NP, PP)
 
         return FeaturizedSnapshot(
             resources=resources,

@@ -223,13 +223,11 @@ def encode_inter_pod(
     queue_terms = [terms_of(p) for p in pods]
     bound_terms = [terms_of(p) for p in bound_pods]
 
-    from ksim_tpu.state.featurizer import bucket_size
+    from ksim_tpu.state.featurizer import vocab_pad
 
-    # Vocab axes pad to power-of-two buckets (padded terms are inert:
-    # term_u/term_tk 0 with all-zero pod columns), bounding recompiles
-    # under churn.
-    U = bucket_size(max(len(vocab.ctxs), 1), 8)
-    T = bucket_size(max(len(vocab.terms), 1), 8)
+    # Padded terms are inert: term_u/term_tk 0 with all-zero pod columns.
+    U = vocab_pad(len(vocab.ctxs))
+    T = vocab_pad(len(vocab.terms))
     TK = max(len(vocab.tk_ids), 1)
 
     term_u = np.zeros(T, dtype=np.int32)

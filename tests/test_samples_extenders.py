@@ -1,0 +1,149 @@
+"""Out-of-tree samples (NodeNumber, data provider) and PluginExtender
+Before/After hooks — the reference's extension surface
+(pkg/debuggablescheduler WithPlugin/WithPluginExtenders,
+wrappedplugin.go:47-171)."""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from ksim_tpu.engine import Engine
+from ksim_tpu.engine.annotations import SCORE_RESULT_KEY
+from ksim_tpu.engine.core import PluginExtender, ScoredPlugin
+from ksim_tpu.engine.profiles import default_plugins
+from ksim_tpu.plugins.base import FilterOutput
+from ksim_tpu.plugins.samples import (
+    data_provider_builder,
+    encode_node_number,
+    node_number_builder,
+    provider_encoder,
+)
+from ksim_tpu.scheduler.service import SchedulerService
+from ksim_tpu.state.cluster import ClusterStore
+from ksim_tpu.state.featurizer import Featurizer
+from tests.helpers import make_node, make_pod
+
+
+def test_node_number_scores_suffix_match():
+    nodes = [make_node("node-1"), make_node("node-2"), make_node("nodigit")]
+    queue = [make_pod("pod-2"), make_pod("pod-x")]
+    feats = Featurizer(
+        extra_encoders={"nodenumber": encode_node_number}
+    ).featurize(nodes, [], queue_pods=queue)
+    build = node_number_builder()
+    sp = build(feats, {})
+    eng = Engine(feats, (*default_plugins(feats), sp), record="full")
+    res = eng.evaluate_batch()
+    si = res.plugin_names.index("NodeNumber")
+    # pod-2 matches node-2 only; pod-x (no digit) scores 0 everywhere.
+    assert [int(x) for x in res.scores[0, si, :3]] == [0, 10, 0]
+    assert [int(x) for x in res.scores[1, si, :3]] == [0, 0, 0]
+    # reverse=True flips it.
+    sp_rev = node_number_builder(reverse=True)(feats, {})
+    eng2 = Engine(feats, (*default_plugins(feats), sp_rev), record="full")
+    res2 = eng2.evaluate_batch()
+    si2 = res2.plugin_names.index("NodeNumber")
+    assert [int(x) for x in res2.scores[0, si2, :3]] == [10, 0, 10]
+
+
+def test_node_number_through_service_registry():
+    """Full out-of-tree flow: registry Builder + featurizer extra encoder
+    + profile enabling the plugin at the score point."""
+    store = ClusterStore()
+    store.create("nodes", make_node("big-5", cpu="64", memory="128Gi"))
+    store.create("nodes", make_node("node-7", cpu="64", memory="128Gi"))
+    store.create("pods", make_pod("app-7", cpu="100m"))
+    cfg = {
+        "profiles": [{
+            "schedulerName": "default-scheduler",
+            "plugins": {"multiPoint": {"enabled": [
+                {"name": "NodeNumber", "weight": 100}  # dominate ties
+            ]}},
+        }]
+    }
+    svc = SchedulerService(
+        store,
+        config=cfg,
+        registry={"NodeNumber": node_number_builder()},
+        featurizer=Featurizer(extra_encoders={"nodenumber": encode_node_number}),
+    )
+    assert svc.schedule_pending() == {"default/app-7": "node-7"}
+    anno = store.get("pods", "app-7")["metadata"]["annotations"]
+    scores = json.loads(anno[SCORE_RESULT_KEY])
+    assert scores["node-7"]["NodeNumber"] == "10"
+
+
+def test_data_provider_capability():
+    """The fork's external-data scorer as a capability: provider runs
+    host-side at featurize time, never in the scoring hot path."""
+    calls = []
+
+    def provider(nodes):
+        calls.append(len(nodes))
+        return np.asarray([90 if "green" in n["metadata"]["name"] else 5
+                           for n in nodes])
+
+    store = ClusterStore()
+    store.create("nodes", make_node("dirty-dc", cpu="64", memory="128Gi"))
+    store.create("nodes", make_node("green-dc", cpu="64", memory="128Gi"))
+    store.create("pods", make_pod("p", cpu="100m"))
+    svc = SchedulerService(
+        store,
+        config={"profiles": [{
+            "plugins": {"multiPoint": {"enabled": [
+                {"name": "Renewable", "weight": 10}]}},
+        }]},
+        registry={"Renewable": data_provider_builder("Renewable", provider)},
+        featurizer=Featurizer(
+            extra_encoders={"provider:Renewable": provider_encoder(provider)}
+        ),
+    )
+    assert svc.schedule_pending() == {"default/p": "green-dc"}
+    assert calls  # the provider ran (once per featurization)
+
+
+def test_plugin_extender_hooks():
+    """Before/After hooks compile into the engine programs."""
+    nodes = [make_node("a"), make_node("b")]
+    queue = [make_pod("p")]
+    feats = Featurizer().featurize(nodes, [], queue_pods=queue)
+    base = default_plugins(feats)
+
+    seen = {}
+
+    def after_filter(state, pod, aux, out: FilterOutput) -> FilterOutput:
+        seen["filter"] = True
+        # Veto node 0 regardless of the plugin's verdict.
+        n = out.ok.shape[0]
+        veto = jnp.arange(n) == 0
+        return FilterOutput(
+            ok=out.ok & ~veto,
+            reason_bits=jnp.where(veto, 1, out.reason_bits).astype(jnp.int32),
+        )
+
+    def after_score(state, pod, aux, scores):
+        seen["score"] = True
+        return scores + 7
+
+    wrapped = tuple(
+        ScoredPlugin(
+            sp.plugin, sp.weight, sp.filter_enabled, sp.score_enabled,
+            extender=PluginExtender(after_filter=after_filter, after_score=after_score)
+            if sp.plugin.name == "NodeResourcesFit"
+            else None,
+        )
+        for sp in base
+    )
+    eng = Engine(feats, wrapped, record="full")
+    res = eng.evaluate_batch()
+    assert seen == {"filter": True, "score": True}
+    fi = res.filter_plugin_names.index("NodeResourcesFit")
+    assert int(res.reason_bits[0, fi, 0]) == 1  # vetoed by the hook
+    assert int(res.selected[0]) == 1
+    # after_score applied pre-normalize: raw scores shifted by exactly 7.
+    plain = Engine(feats, base, record="full").evaluate_batch()
+    si = res.plugin_names.index("NodeResourcesFit")
+    assert int(res.scores[0, si, 1]) == int(plain.scores[0, si, 1]) + 7
