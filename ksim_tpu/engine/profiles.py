@@ -21,6 +21,12 @@ from ksim_tpu.plugins.noderesources import (
 )
 from ksim_tpu.plugins.podtopologyspread import PodTopologySpread
 from ksim_tpu.plugins.tainttoleration import TaintToleration
+from ksim_tpu.plugins.volumes import (
+    NodeVolumeLimits,
+    VolumeBinding,
+    VolumeRestrictions,
+    VolumeZone,
+)
 from ksim_tpu.state.featurizer import FeaturizedSnapshot
 
 
@@ -30,8 +36,10 @@ def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
     InterPodAffinity 2, TaintToleration 3 (default_plugins.go)."""
     # Filter order follows upstream MultiPoint registration order
     # (default_plugins.go): NodeUnschedulable, NodeName, TaintToleration,
-    # NodeAffinity, NodePorts, NodeResourcesFit, PodTopologySpread,
+    # NodeAffinity, NodePorts, NodeResourcesFit, VolumeRestrictions,
+    # NodeVolumeLimits, VolumeBinding, VolumeZone, PodTopologySpread,
     # InterPodAffinity — early-exit filter-result recording depends on it.
+    vols = feats.aux["volumes"]
     return (
         ScoredPlugin(NodeUnschedulable(), score_enabled=False),
         ScoredPlugin(NodeName(), score_enabled=False),
@@ -44,6 +52,10 @@ def default_plugins(feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
             weight=1,
             filter_enabled=False,
         ),
+        ScoredPlugin(VolumeRestrictions(vols), score_enabled=False),
+        ScoredPlugin(NodeVolumeLimits(vols), score_enabled=False),
+        ScoredPlugin(VolumeBinding(vols), score_enabled=False),
+        ScoredPlugin(VolumeZone(vols), score_enabled=False),
         ScoredPlugin(PodTopologySpread(feats.aux["spread"]), weight=2),
         ScoredPlugin(InterPodAffinity(feats.aux["interpod"]), weight=2),
         ScoredPlugin(

@@ -730,3 +730,228 @@ def image_locality_score(
     if denom <= 0:
         return 0
     return int(MAX_NODE_SCORE * (clamped - MIN_THRESHOLD) / denom)
+
+
+# -- Volume family ----------------------------------------------------------
+# Pure-Python counterparts of plugins/volumes.py (same scoped semantics,
+# state/volumes.py docstring documents the simplifications).
+
+
+def _volume_claims(pod: JSON, pvcs_by_key: dict) -> tuple[list[JSON], int]:
+    """(resolved PVC objects, pod_fail code 0|1 unbound-immediate|2 missing)
+    — ignores storage-class context; callers refine."""
+    from ksim_tpu.state.volumes import _pvc_name, _pod_volumes
+    from ksim_tpu.state.resources import namespace_of
+
+    ns = namespace_of(pod) or "default"
+    out, fail = [], 0
+    for vol in _pod_volumes(pod):
+        claim = _pvc_name(pod, vol)
+        if claim is None:
+            continue
+        pvc = pvcs_by_key.get(f"{ns}/{claim}")
+        if pvc is None:
+            fail = fail or 2
+            continue
+        out.append(pvc)
+    return out, fail
+
+
+def volume_binding_filter(
+    pod: JSON, node: JSON, pvcs: Sequence[JSON], pvs: Sequence[JSON],
+    storage_classes: Sequence[JSON],
+) -> list[str]:
+    from ksim_tpu.plugins.volumes import (
+        ERR_BIND_CONFLICT,
+        ERR_NODE_CONFLICT,
+        ERR_PVC_NOT_FOUND,
+        ERR_UNBOUND_IMMEDIATE,
+    )
+    from ksim_tpu.state.volumes import (
+        NO_PROVISIONER,
+        _pv_affinity_admits,
+        _pv_matches_claim,
+    )
+    from ksim_tpu.state.resources import namespace_of
+
+    pvcs_by_key = {f"{namespace_of(c)}/{name_of(c)}": c for c in pvcs}
+    pv_by_name = {name_of(v): v for v in pvs}
+    sc_by_name = {name_of(s): s for s in storage_classes}
+    claims, fail = _volume_claims(pod, pvcs_by_key)
+    reasons = []
+    if fail == 2:
+        reasons.append(ERR_PVC_NOT_FOUND)
+    node_conf = bind_conf = unbound = False
+    for pvc in claims:
+        spec = pvc.get("spec") or {}
+        bound = spec.get("volumeName") or ""
+        sc = sc_by_name.get(spec.get("storageClassName") or "")
+        mode = (sc or {}).get("volumeBindingMode") or "Immediate"
+        if bound:
+            pv = pv_by_name.get(bound)
+            if pv is None:
+                if ERR_PVC_NOT_FOUND not in reasons:
+                    reasons.append(ERR_PVC_NOT_FOUND)
+            elif not _pv_affinity_admits(pv, node):
+                node_conf = True
+        elif mode == "Immediate":
+            unbound = True
+        else:
+            provisionable = bool(
+                sc and (sc.get("provisioner") or "") not in ("", NO_PROVISIONER)
+            )
+            has_cand = any(
+                _pv_matches_claim(pv, pvc) and _pv_affinity_admits(pv, node)
+                for pv in pvs
+            )
+            if not (provisionable or has_cand):
+                bind_conf = True
+    if unbound:
+        reasons.insert(0, ERR_UNBOUND_IMMEDIATE)
+    if node_conf:
+        reasons.append(ERR_NODE_CONFLICT)
+    if bind_conf:
+        reasons.append(ERR_BIND_CONFLICT)
+    return reasons
+
+
+def volume_zone_filter(
+    pod: JSON, node: JSON, pvcs: Sequence[JSON], pvs: Sequence[JSON]
+) -> list[str]:
+    from ksim_tpu.plugins.volumes import ERR_ZONE_CONFLICT
+    from ksim_tpu.state.volumes import _pv_zone_admits
+    from ksim_tpu.state.resources import labels_of, namespace_of
+
+    pvcs_by_key = {f"{namespace_of(c)}/{name_of(c)}": c for c in pvcs}
+    pv_by_name = {name_of(v): v for v in pvs}
+    claims, _fail = _volume_claims(pod, pvcs_by_key)
+    node_labels = dict(labels_of(node))
+    for pvc in claims:
+        bound = (pvc.get("spec") or {}).get("volumeName") or ""
+        pv = pv_by_name.get(bound)
+        if pv is not None and not _pv_zone_admits(pv, node_labels):
+            return [ERR_ZONE_CONFLICT]
+    return []
+
+
+def volume_restrictions_filter(
+    pod: JSON, pods_on_node: Sequence[JSON], pvcs: Sequence[JSON]
+) -> list[str]:
+    from ksim_tpu.plugins.volumes import ERR_DISK_CONFLICT, ERR_RWOP_CONFLICT
+    from ksim_tpu.state.volumes import DISK_SOURCES, _pod_volumes, _pvc_name
+    from ksim_tpu.state.resources import namespace_of
+
+    pvcs_by_key = {f"{namespace_of(c)}/{name_of(c)}": c for c in pvcs}
+
+    def rwop_claims(p):
+        ns = namespace_of(p) or "default"
+        out = set()
+        for vol in _pod_volumes(p):
+            claim = _pvc_name(p, vol)
+            if claim is None:
+                continue
+            pvc = pvcs_by_key.get(f"{ns}/{claim}")
+            modes = set(((pvc or {}).get("spec") or {}).get("accessModes") or [])
+            if "ReadWriteOncePod" in modes:
+                out.add(f"{ns}/{claim}")
+        return out
+
+    def disks(p):
+        out = []
+        for vol in _pod_volumes(p):
+            for src, id_field, ro_share in DISK_SOURCES:
+                s = vol.get(src)
+                if s and s.get(id_field):
+                    out.append((src, str(s[id_field]), not s.get("readOnly"), ro_share))
+        return out
+
+    reasons = []
+    mine = rwop_claims(pod)
+    existing = set()
+    for p in pods_on_node:
+        existing |= rwop_claims(p)
+    my_disks = disks(pod)
+    node_disks = [d for p in pods_on_node for d in disks(p)]
+    disk_conf = False
+    for src, vid, rw, ro_share in my_disks:
+        for esrc, evid, erw, _ in node_disks:
+            if (src, vid) != (esrc, evid):
+                continue
+            if not ro_share or rw or erw:
+                disk_conf = True
+    if disk_conf:
+        reasons.append(ERR_DISK_CONFLICT)
+    if mine & existing:
+        reasons.append(ERR_RWOP_CONFLICT)
+    return reasons
+
+
+def node_volume_limits_filter(
+    pod: JSON,
+    node: JSON,
+    pods_on_node: Sequence[JSON],
+    pvcs: Sequence[JSON],
+    pvs: Sequence[JSON],
+    storage_classes: Sequence[JSON],
+) -> list[str]:
+    from ksim_tpu.plugins.volumes import ERR_MAX_VOLUME_COUNT
+    from ksim_tpu.state.volumes import (
+        DISK_SOURCES,
+        SOURCE_POOL,
+        _csi_pool,
+        _pod_volumes,
+        _pvc_name,
+        _pv_source_id,
+    )
+    from ksim_tpu.state.resources import namespace_of
+
+    pvcs_by_key = {f"{namespace_of(c)}/{name_of(c)}": c for c in pvcs}
+    pv_by_name = {name_of(v): v for v in pvs}
+    sc_by_name = {name_of(s): s for s in storage_classes}
+
+    def pooled_volumes(p):
+        """set of (pool, volume-id) the pod attaches."""
+        ns = namespace_of(p) or "default"
+        out = set()
+        for vol in _pod_volumes(p):
+            claim = _pvc_name(p, vol)
+            if claim is not None:
+                pvc = pvcs_by_key.get(f"{ns}/{claim}")
+                if not pvc:
+                    continue
+                pv = pv_by_name.get((pvc.get("spec") or {}).get("volumeName") or "")
+                if not pv:
+                    continue
+                src, _vid = _pv_source_id(pv)
+                sc = sc_by_name.get((pvc.get("spec") or {}).get("storageClassName") or "")
+                pool = SOURCE_POOL.get(src) if src else None
+                pool = pool or _csi_pool(pv, sc)
+                if pool:
+                    out.add((pool, f"pv:{name_of(pv)}"))
+                continue
+            for src, id_field, _ro in DISK_SOURCES:
+                s = vol.get(src)
+                if s and s.get(id_field) and SOURCE_POOL.get(src):
+                    out.add((SOURCE_POOL[src], f"{src}:{s[id_field]}"))
+        return out
+
+    alloc = node.get("status", {}).get("allocatable") or {}
+    limits = {
+        k.removeprefix("attachable-volumes-"): int(v)
+        for k, v in alloc.items()
+        if k.startswith("attachable-volumes-")
+    }
+    attached: dict[str, set] = {}
+    for p in pods_on_node:
+        for pool, vid in pooled_volumes(p):
+            attached.setdefault(pool, set()).add(vid)
+    # Accumulate the pod's volumes per pool BEFORE comparing: a pod
+    # attaching several new volumes must fit as a whole (the kernel sums
+    # used + new the same way).
+    want: dict[str, set] = {}
+    for pool, vid in pooled_volumes(pod):
+        want.setdefault(pool, set()).add(vid)
+    for pool, vids in want.items():
+        if pool in limits and len(attached.get(pool, set()) | vids) > limits[pool]:
+            return [ERR_MAX_VOLUME_COUNT]
+    return []

@@ -76,12 +76,18 @@ class ScenarioRunner:
         *,
         record: str = "selection",
         requeue_on_node_delete: bool = True,
+        max_pods_per_pass: int | None = None,
     ) -> None:
         self.store = store if store is not None else ClusterStore()
         self.service = (
             service
             if service is not None
-            else SchedulerService(self.store, record=record, preemption=False)
+            else SchedulerService(
+                self.store,
+                record=record,
+                preemption=False,
+                max_pods_per_pass=max_pods_per_pass,
+            )
         )
         self._requeue = requeue_on_node_delete
 
@@ -124,6 +130,16 @@ class ScenarioRunner:
             for op in batch:
                 self._apply(op)
             result.events_applied += len(batch)
+            # The runner drives the store directly (no watch loop), so it
+            # raises the capacity-freed/topology-changed signal itself:
+            # node ops and pod deletions flush the unschedulable backoff.
+            if any(
+                op.kind in ("nodes", "persistentvolumes",
+                            "persistentvolumeclaims", "storageclasses")
+                or (op.op == "delete" and op.kind == "pods")
+                for op in batch
+            ):
+                self.service.flush_backoff()
             placements = self.service.schedule_pending()
             scheduled = sum(1 for v in placements.values() if v is not None)
             unsched = len(placements) - scheduled

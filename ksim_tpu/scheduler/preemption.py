@@ -80,9 +80,17 @@ class _FitState:
         nodes: Sequence[JSON],
         cluster_pods: Sequence[JSON],
         namespaces: Sequence[JSON],
+        volumes: dict | None = None,
     ) -> None:
         self.nodes = nodes
         self.namespaces = namespaces
+        self.volumes = volumes or {"pvs": (), "pvcs": (), "storage_classes": ()}
+        # The volume oracle filters rebuild per-call lookup maps over the
+        # pvc/pv/sc lists; skip them wholesale when the cluster has no
+        # volume objects (the common case for preemption).
+        self._check_volumes = bool(
+            self.volumes.get("pvcs") or self.volumes.get("pvs")
+        )
         self.infos = oracle.build_node_infos(nodes, cluster_pods)
         self._by_name = {info["name"]: info for info in self.infos}
         self.pbn = _pods_by_node(cluster_pods)
@@ -137,6 +145,22 @@ class _FitState:
             return False
         if oracle.fit_filter(pod, info):
             return False
+        if self._check_volumes or pod.get("spec", {}).get("volumes"):
+            vols = self.volumes
+            node = self.nodes[node_idx]
+            on_node = self.pbn.get(info["name"], [])
+            if oracle.volume_restrictions_filter(pod, on_node, vols["pvcs"]):
+                return False
+            if oracle.node_volume_limits_filter(
+                pod, node, on_node, vols["pvcs"], vols["pvs"], vols["storage_classes"]
+            ):
+                return False
+            if oracle.volume_binding_filter(
+                pod, node, vols["pvcs"], vols["pvs"], vols["storage_classes"]
+            ):
+                return False
+            if oracle.volume_zone_filter(pod, node, vols["pvcs"], vols["pvs"]):
+                return False
         if oracle.topology_spread_filter_all(pod, self.infos, self.pbn)[node_idx]:
             return False
         if oracle.inter_pod_affinity_filter_all(
@@ -165,6 +189,7 @@ def _select_victims_on_node(
     nodes: Sequence[JSON],
     cluster_pods: Sequence[JSON],
     namespaces: Sequence[JSON],
+    volumes: dict | None = None,
 ) -> list[JSON] | None:
     """Upstream selectVictimsOnNode: remove all lower-priority pods, check
     feasibility, then reprieve as many as possible in importance order.
@@ -180,7 +205,7 @@ def _select_victims_on_node(
     ]
     if not potential:
         return None
-    state = _FitState(nodes, cluster_pods, namespaces)
+    state = _FitState(nodes, cluster_pods, namespaces, volumes)
     for v in potential:
         state.remove(v)
     if not state.fits(pod, node_idx):
@@ -232,6 +257,7 @@ def find_preemption(
     *,
     candidate_mask: Sequence[bool] | None = None,
     namespaces: Sequence[JSON] = (),
+    volumes: dict | None = None,
 ) -> PreemptionDecision:
     """DefaultPreemption for one unschedulable pod.
 
@@ -249,7 +275,9 @@ def find_preemption(
     for ni in range(n):
         if candidate_mask is not None and not candidate_mask[ni]:
             continue
-        victims = _select_victims_on_node(pod, ni, nodes, pods_list, namespaces)
+        victims = _select_victims_on_node(
+            pod, ni, nodes, pods_list, namespaces, volumes
+        )
         if victims is None:
             continue
         candidates.append(

@@ -160,6 +160,16 @@ def _build_image_locality(feats, args):
     )
 
 
+def _build_volume(cls_name):
+    def build(feats, args):
+        from ksim_tpu.plugins import volumes
+
+        cls = getattr(volumes, cls_name)
+        return ScoredPlugin(cls(feats.aux["volumes"]), score_enabled=False)
+
+    return build
+
+
 INTREE_BUILDERS: dict[str, Builder] = {
     "NodeUnschedulable": _build_node_unschedulable,
     "NodeName": _build_node_name,
@@ -171,6 +181,10 @@ INTREE_BUILDERS: dict[str, Builder] = {
     "PodTopologySpread": _build_spread,
     "InterPodAffinity": _build_interpod,
     "ImageLocality": _build_image_locality,
+    "VolumeRestrictions": _build_volume("VolumeRestrictions"),
+    "NodeVolumeLimits": _build_volume("NodeVolumeLimits"),
+    "VolumeBinding": _build_volume("VolumeBinding"),
+    "VolumeZone": _build_volume("VolumeZone"),
 }
 
 

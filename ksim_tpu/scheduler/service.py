@@ -74,11 +74,17 @@ class SchedulerService:
         record: str = "full",
         featurizer: Featurizer | None = None,
         preemption: bool = True,
+        max_pods_per_pass: int | None = None,
     ) -> None:
         self._store = store
         self._registry = registry or {}
         self._record = record
         self._preemption = preemption
+        # Upstream schedules ONE pod per cycle; a pass here batches the
+        # queue.  Capping the batch bounds featurize/scan cost per pass
+        # under churn saturation — excess pods are simply deeper in the
+        # queue, exactly as upstream's one-at-a-time loop would leave them.
+        self._max_pods_per_pass = max_pods_per_pass
         # Direct-factory mode (library use) bypasses profile compilation.
         self._plugins_factory = plugins_factory
         self._featurizer_override = featurizer
@@ -90,6 +96,56 @@ class SchedulerService:
         self._own_rvs_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Unschedulable-pod backoff (the upstream scheduling queue's
+        # backoff/unschedulable pools, measured in scheduling passes
+        # instead of wall-clock): an unschedulable pod skips
+        # min(2^(attempts-1), MAX) passes; cluster events that could make
+        # it schedulable flush the backoff (QueueingHint analogue).
+        self._backoff: dict[str, tuple[int, int]] = {}  # key -> (attempts, retry_at)
+        self._backoff_lock = threading.Lock()
+        self._pass_count = 0
+
+    MAX_BACKOFF_PASSES = 16
+
+    def flush_backoff(self) -> None:
+        """Retry every backed-off pod on the next pass (a node was
+        added/removed or capacity freed — upstream moves unschedulable
+        pods back to the active queue on such events)."""
+        with self._backoff_lock:
+            self._backoff = {
+                k: (attempts, 0) for k, (attempts, _r) in self._backoff.items()
+            }
+
+    def _in_backoff(self, pod: JSON) -> bool:
+        # _pass_count was already incremented for the pass being built, so
+        # a retry_at of P skips passes up to and including P (delay=1 ->
+        # exactly one skipped pass).
+        key = f"{namespace_of(pod)}/{name_of(pod)}"
+        with self._backoff_lock:
+            entry = self._backoff.get(key)
+            return entry is not None and entry[1] >= self._pass_count
+
+    def _record_attempts(self, placements: dict[str, str | None]) -> None:
+        with self._backoff_lock:
+            for key, node in placements.items():
+                if node is None:
+                    # A pod that preemption just nominated expects to
+                    # schedule as soon as its victims are gone — upstream
+                    # reactivates it on the delete events; never back it
+                    # off.
+                    ns, _, name = key.partition("/")
+                    try:
+                        pod = self._store.get("pods", name, ns)
+                    except Exception:
+                        continue
+                    if pod.get("status", {}).get("nominatedNodeName"):
+                        self._backoff.pop(key, None)
+                        continue
+                    attempts = self._backoff.get(key, (0, 0))[0] + 1
+                    delay = min(2 ** (attempts - 1), self.MAX_BACKOFF_PASSES)
+                    self._backoff[key] = (attempts, self._pass_count + delay)
+                else:
+                    self._backoff.pop(key, None)
 
     # -- scheduler configuration (reference scheduler.go Service) -----------
 
@@ -156,8 +212,14 @@ class SchedulerService:
         reference records every attempt; history accumulates)."""
         nodes = self._store.list("nodes", copy_objs=False)
         namespaces = self._store.list("namespaces", copy_objs=False)
+        volume_kw = dict(
+            pvs=self._store.list("persistentvolumes", copy_objs=False),
+            pvcs=self._store.list("persistentvolumeclaims", copy_objs=False),
+            storage_classes=self._store.list("storageclasses", copy_objs=False),
+        )
         if not nodes:
             return {}
+        self._pass_count += 1
         placements: dict[str, str | None] = {}
         for sched_name in self._scheduler_names:
             # Fresh pod snapshot per profile: earlier profiles' bindings
@@ -167,12 +229,15 @@ class SchedulerService:
                 p
                 for p in pods
                 if self._is_pending(p)
+                and not self._in_backoff(p)
                 and (p.get("spec", {}).get("schedulerName") or DEFAULT_SCHEDULER_NAME)
                 == sched_name
             ]
             if not queue:
                 continue
             queue.sort(key=queue_sort_key)
+            if self._max_pods_per_pass is not None:
+                queue = queue[: self._max_pods_per_pass]
             if self._plugins_factory is not None:
                 featurizer = self._featurizer_override or Featurizer()
                 factory: PluginsFactory = self._plugins_factory
@@ -186,11 +251,11 @@ class SchedulerService:
                 # pod-at-a-time evaluation (the reference's scheduler is
                 # per-pod anyway; extenders are the slow path by design).
                 self._schedule_queue_with_extenders(
-                    queue, featurizer, factory, namespaces, placements
+                    queue, featurizer, factory, namespaces, volume_kw, placements
                 )
                 continue
             feats = featurizer.featurize(
-                nodes, pods, queue_pods=queue, namespaces=namespaces
+                nodes, pods, queue_pods=queue, namespaces=namespaces, **volume_kw
             )
             plugins = tuple(factory(feats))
             eng = Engine(feats, plugins, record=self._record)
@@ -205,10 +270,20 @@ class SchedulerService:
             if len(self._own_rvs) > limit:
                 for rv in sorted(self._own_rvs, key=int)[:-limit]:
                     self._own_rvs.discard(rv)
+        self._record_attempts(placements)
+        with self._backoff_lock:
+            if len(self._backoff) > 2 * len(placements) + 64:
+                alive = {
+                    f"{namespace_of(p)}/{name_of(p)}"
+                    for p in self._store.list("pods", copy_objs=False)
+                }
+                self._backoff = {
+                    k: v for k, v in self._backoff.items() if k in alive
+                }
         return placements
 
     def _schedule_queue_with_extenders(
-        self, queue, featurizer, factory, namespaces, placements
+        self, queue, featurizer, factory, namespaces, volume_kw, placements
     ) -> None:
         """Per-pod cycle with extender webhooks (upstream
         findNodesThatPassExtenders + prioritizeNodes extender scores):
@@ -221,7 +296,7 @@ class SchedulerService:
             nodes = self._store.list("nodes", copy_objs=False)
             pods = self._store.list("pods", copy_objs=False)
             feats = featurizer.featurize(
-                nodes, pods, queue_pods=[pod], namespaces=namespaces
+                nodes, pods, queue_pods=[pod], namespaces=namespaces, **volume_kw
             )
             plugins = tuple(factory(feats))
             eng = Engine(feats, plugins, record="full")
@@ -388,10 +463,16 @@ class SchedulerService:
         nodes = self._store.list("nodes", copy_objs=False)
         cluster_pods = self._store.list("pods", copy_objs=False)
         namespaces = self._store.list("namespaces", copy_objs=False)
+        volumes = dict(
+            pvs=self._store.list("persistentvolumes", copy_objs=False),
+            pvcs=self._store.list("persistentvolumeclaims", copy_objs=False),
+            storage_classes=self._store.list("storageclasses", copy_objs=False),
+        )
         if res.reason_bits is not None:
             live_mask = [mask_by_name.get(name_of(n), False) for n in nodes]
         decision = pre.find_preemption(
-            pod, nodes, cluster_pods, candidate_mask=live_mask, namespaces=namespaces
+            pod, nodes, cluster_pods, candidate_mask=live_mask,
+            namespaces=namespaces, volumes=volumes,
         )
         post = pre.render_postfilter_result(failed_nodes, decision.nominated_node)
         return decision.nominated_node, decision.victims, post
@@ -435,8 +516,23 @@ class SchedulerService:
             self._thread.join(timeout=5)
             self._thread = None
 
+    # Kinds whose changes can make a pending pod schedulable.
+    WATCH_KINDS = (
+        "pods",
+        "nodes",
+        "persistentvolumes",
+        "persistentvolumeclaims",
+        "storageclasses",
+    )
+
     def _relevant(self, ev: WatchEvent) -> bool:
         if ev.kind == "nodes":
+            self.flush_backoff()  # topology changed: retry everything
+            return True
+        if ev.kind in ("persistentvolumes", "persistentvolumeclaims", "storageclasses"):
+            # Volume objects gate VolumeBinding/Zone/Limits: retry
+            # (upstream requeues on PV/PVC events via QueueingHints).
+            self.flush_backoff()
             return True
         if ev.kind != "pods":
             return False
@@ -446,6 +542,13 @@ class SchedulerService:
                 self._own_rvs.discard(rv)
                 return False
         self._flush_extender_results(ev)
+        from ksim_tpu.state.cluster import DELETED
+
+        if ev.event_type == DELETED:
+            key = f"{namespace_of(ev.obj)}/{name_of(ev.obj)}"
+            with self._backoff_lock:
+                self._backoff.pop(key, None)  # the pod is gone
+            self.flush_backoff()  # capacity freed: retry everything
         # A delete frees capacity; an add/update may need scheduling.
         return True
 
@@ -483,7 +586,7 @@ class SchedulerService:
         self._extenders.store.delete_data(pod)
 
     def _run(self) -> None:
-        stream = self._store.watch(("pods", "nodes"))
+        stream = self._store.watch(self.WATCH_KINDS)
         try:
             self.schedule_pending()
             while not self._stop.is_set():

@@ -165,10 +165,14 @@ class Featurizer:
         *,
         queue_pods: Sequence[JSON] = (),
         namespaces: Sequence[JSON] = (),
+        pvs: Sequence[JSON] = (),
+        pvcs: Sequence[JSON] = (),
+        storage_classes: Sequence[JSON] = (),
     ) -> FeaturizedSnapshot:
         """``pods`` are existing cluster pods (bound ones charge their node);
         ``queue_pods`` are the pods to schedule (the pod axis P);
-        ``namespaces`` feed namespaceSelector matching (InterPodAffinity)."""
+        ``namespaces`` feed namespaceSelector matching (InterPodAffinity);
+        ``pvs``/``pvcs``/``storage_classes`` feed the volume plugins."""
         sched_pods = list(queue_pods) if queue_pods else [
             p for p in pods if not pod_is_scheduled(p)
         ]
@@ -289,6 +293,7 @@ class Featurizer:
             encode_node_ports,
         )
         from ksim_tpu.state.interpod import encode_inter_pod
+        from ksim_tpu.state.volumes import encode_volumes
 
         aux = {
             "affinity": encode_affinity(nodes, sched_pods, NP, PP),
@@ -301,6 +306,9 @@ class Featurizer:
             "nodename": encode_node_name(nodes, sched_pods, PP),
             "nodeports": encode_node_ports(nodes, sched_pods, bound_pods, NP, PP),
             "imagelocality": encode_image_locality(nodes, sched_pods, NP, PP),
+            "volumes": encode_volumes(
+                nodes, sched_pods, bound_pods, pvs, pvcs, storage_classes, NP, PP
+            ),
         }
         for key, encoder in self._extra_encoders.items():
             aux[key] = encoder(nodes, sched_pods, NP, PP)

@@ -128,13 +128,11 @@ def test_scheduling_gates_enforced():
     assert svc.schedule_pending() == {"default/gated": "n0"}
 
 
-def test_volume_plugins_surface_in_skipped():
+def test_no_default_plugins_skipped():
+    # Every upstream default-profile plugin has a kernel now; truly
+    # unknown plugins still raise (profile.py compile_profile).
     prof = compile_profile({})
-    assert "VolumeBinding" in prof.skipped
-    assert "VolumeRestrictions" in prof.skipped
-    # The new kernels are no longer skipped.
-    for name in ("NodeName", "NodePorts", "ImageLocality"):
-        assert name not in prof.skipped
+    assert prof.skipped == ()
 
 
 def test_new_plugins_neutral_on_plain_clusters():

@@ -26,8 +26,11 @@ def test_default_profile_matches_upstream_multipoint():
     assert got["PodTopologySpread"] == 2
     assert got["InterPodAffinity"] == 2
     assert got["NodeResourcesFit"] == 1
-    # Unimplemented volume family surfaces as skipped, not an error.
-    assert "VolumeBinding" in prof.skipped
+    # The full default profile now compiles: nothing skipped.
+    assert prof.skipped == ()
+    for name in ("VolumeBinding", "VolumeZone", "VolumeRestrictions",
+                 "NodeVolumeLimits"):
+        assert name in got
 
 
 def test_disable_and_reweight():

@@ -56,8 +56,11 @@ def test_priority_order_wins_contended_capacity():
 
 def test_retry_history_accumulates():
     store = make_store([make_node("tiny", cpu="100m")], [make_pod("big", cpu="2")])
-    svc = SchedulerService(store)
+    svc = SchedulerService(store, preemption=False)
     assert svc.schedule_pending()["default/big"] is None
+    # The unschedulable pod is in backoff; a cluster event flushes it and
+    # the retry appends to the result history.
+    svc.flush_backoff()
     assert svc.schedule_pending()["default/big"] is None
     annos = store.get("pods", "big", "default")["metadata"]["annotations"]
     assert len(json.loads(annos[RESULT_HISTORY_KEY])) == 2
@@ -94,3 +97,23 @@ def test_watch_loop_schedules_new_pods_and_reacts_to_new_nodes():
         assert pod["spec"].get("nodeName") == "roomy"
     finally:
         svc.stop()
+
+
+def test_unschedulable_backoff_skips_and_flushes():
+    """Upstream backoff-queue analogue: an unschedulable pod skips
+    passes exponentially; capacity-freed/topology events flush it."""
+    from tests.helpers import make_node, make_pod
+
+    store = ClusterStore()
+    store.create("nodes", make_node("n0", cpu="1", memory="8Gi"))
+    store.create("pods", make_pod("big", cpu="2", memory=None))
+    svc = SchedulerService(store, preemption=False)
+    assert svc.schedule_pending() == {"default/big": None}  # attempt 1
+    # Backoff: the next pass skips it entirely.
+    assert svc.schedule_pending() == {}
+    # A node event flushes the backoff and it schedules.
+    store.create("nodes", make_node("n1", cpu="4", memory="8Gi"))
+    svc.flush_backoff()
+    assert svc.schedule_pending() == {"default/big": "n1"}
+    # Scheduling cleared the backoff entry.
+    assert svc._backoff == {}
