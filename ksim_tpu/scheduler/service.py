@@ -43,6 +43,7 @@ from ksim_tpu.scheduler.profile import (
 from ksim_tpu.state.cluster import ClusterStore, WatchEvent
 from ksim_tpu.state.featurizer import FeaturizedSnapshot, Featurizer
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
+from ksim_tpu.util import Metrics
 
 logger = logging.getLogger(__name__)
 
@@ -104,6 +105,7 @@ class SchedulerService:
         self._backoff: dict[str, tuple[int, int]] = {}  # key -> (attempts, retry_at)
         self._backoff_lock = threading.Lock()
         self._pass_count = 0
+        self.metrics = Metrics()
 
     MAX_BACKOFF_PASSES = 16
 
@@ -254,13 +256,16 @@ class SchedulerService:
                     queue, featurizer, factory, namespaces, volume_kw, placements
                 )
                 continue
-            feats = featurizer.featurize(
-                nodes, pods, queue_pods=queue, namespaces=namespaces, **volume_kw
-            )
+            with self.metrics.timer("featurize"):
+                feats = featurizer.featurize(
+                    nodes, pods, queue_pods=queue, namespaces=namespaces, **volume_kw
+                )
             plugins = tuple(factory(feats))
-            eng = Engine(feats, plugins, record=self._record)
-            res, _ = eng.schedule(pull_state=False)
-            self._bind_results(queue, feats, plugins, res, placements)
+            with self.metrics.timer("engine"):
+                eng = Engine(feats, plugins, record=self._record)
+                res, _ = eng.schedule(pull_state=False)
+            with self.metrics.timer("bind"):
+                self._bind_results(queue, feats, plugins, res, placements)
         # Bound _own_rvs growth for library use (schedule_pending without
         # the watch loop draining events).  The limit scales with the pass
         # size so one large pass never trims its own still-queued events
@@ -271,6 +276,14 @@ class SchedulerService:
                 for rv in sorted(self._own_rvs, key=int)[:-limit]:
                     self._own_rvs.discard(rv)
         self._record_attempts(placements)
+        self.metrics.inc("scheduling_passes")
+        self.metrics.inc("scheduling_attempts", len(placements))
+        self.metrics.inc(
+            "pods_scheduled", sum(1 for v in placements.values() if v is not None)
+        )
+        self.metrics.inc(
+            "pods_unschedulable", sum(1 for v in placements.values() if v is None)
+        )
         with self._backoff_lock:
             if len(self._backoff) > 2 * len(placements) + 64:
                 alive = {
@@ -295,12 +308,14 @@ class SchedulerService:
         for pod in queue:
             nodes = self._store.list("nodes", copy_objs=False)
             pods = self._store.list("pods", copy_objs=False)
-            feats = featurizer.featurize(
-                nodes, pods, queue_pods=[pod], namespaces=namespaces, **volume_kw
-            )
+            with self.metrics.timer("featurize"):
+                feats = featurizer.featurize(
+                    nodes, pods, queue_pods=[pod], namespaces=namespaces, **volume_kw
+                )
             plugins = tuple(factory(feats))
-            eng = Engine(feats, plugins, record="full")
-            res = eng.evaluate_batch()
+            with self.metrics.timer("engine"):
+                eng = Engine(feats, plugins, record="full")
+                res = eng.evaluate_batch()
             n_valid = feats.nodes.count
             ok = np.asarray(res.reason_bits[0] == 0).all(axis=0)[:n_valid]
             feasible = [feats.nodes.names[i] for i in range(n_valid) if ok[i]]
@@ -569,15 +584,30 @@ class SchedulerService:
         anno = self._extenders.store.get_stored_result(pod)
         if not anno:
             return
+        from ksim_tpu.errors import ConflictError, NotFoundError
+        from ksim_tpu.util import retry_with_exponential_backoff
+
         try:
-            updated = self._store.patch(
-                "pods",
-                name_of(pod),
-                namespace_of(pod),
-                lambda obj: obj.setdefault("metadata", {})
-                .setdefault("annotations", {})
-                .update(anno),
+            # Conflict-retried like the reference's reflector writes
+            # (storereflector.go:124-136 + util/retry.go).  Scoped to
+            # ConflictError only: ClusterStore.patch is an atomic RMW so
+            # conflicts can't actually occur in-process, and a NotFound
+            # (pod deleted meanwhile) must drop straight through instead
+            # of stalling the watch loop through the backoff sleeps.
+            updated = retry_with_exponential_backoff(
+                lambda: self._store.patch(
+                    "pods",
+                    name_of(pod),
+                    namespace_of(pod),
+                    lambda obj: obj.setdefault("metadata", {})
+                    .setdefault("annotations", {})
+                    .update(anno),
+                ),
+                retriable=(ConflictError,),
             )
+        except NotFoundError:
+            self._extenders.store.delete_data(pod)
+            return
         except Exception:
             logger.exception("failed to flush extender results")
             return

@@ -97,10 +97,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:
         url = urlparse(self.path)
-        if url.path == "/api/v1/schedulerconfiguration":
+        if url.path in ("/", "/index.html"):
+            from ksim_tpu.server.ui import INDEX_HTML
+
+            body = INDEX_HTML.encode()
+            self.send_response(200)
+            self._cors()
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif url.path == "/api/v1/schedulerconfiguration":
             self._json(200, self.server.di.scheduler_service.get_scheduler_config())
         elif url.path == "/api/v1/export":
             self._json(200, self.server.di.snapshot_service.snap())
+        elif url.path == "/api/v1/metrics":
+            self._json(200, self.server.di.scheduler_service.metrics.snapshot())
         elif url.path == "/api/v1/listwatchresources":
             self._list_watch(parse_qs(url.query))
         else:

@@ -1,0 +1,138 @@
+"""Minimal built-in web UI.
+
+The reference ships a ~5k-LoC Nuxt2/Vuetify app (reference web/) that is
+a pure client of the REST + annotation contract; this single-file page
+demonstrates that contract end-to-end against THIS server: live
+node/pod tables fed by the streaming /api/v1/listwatchresources
+endpoint, per-plugin Filter/Score/FinalScore tables decoded from the 13
+result annotations (the SchedulingResults.vue analogue), and the
+export/reset top-bar operations.  Served at / by SimulatorServer."""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8"/>
+<title>ksim-tpu simulator</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 1.5rem; color: #222; }
+  h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.2rem; }
+  table { border-collapse: collapse; margin-top: .4rem; font-size: .85rem; }
+  th, td { border: 1px solid #ccc; padding: .25rem .5rem; text-align: left; }
+  th { background: #f3f3f3; }
+  .pill { display: inline-block; padding: 0 .5rem; border-radius: 999px;
+          background: #e8f0fe; margin-right: .3rem; }
+  .pending { background: #fde8e8; }
+  button { margin-right: .6rem; }
+  #results pre { background: #f8f8f8; padding: .5rem; overflow-x: auto; }
+  tr.sel { background: #fffbe6; cursor: pointer; } tr[data-pod] { cursor: pointer; }
+</style>
+</head>
+<body>
+<h1>ksim-tpu scheduler simulator</h1>
+<div>
+  <button onclick="doExport()">Export snapshot</button>
+  <button onclick="doReset()">Reset cluster</button>
+  <span id="status" class="pill">connecting…</span>
+</div>
+<h2>Nodes (<span id="nodecount">0</span>)</h2>
+<table id="nodes"><thead><tr><th>name</th><th>cpu</th><th>memory</th><th>pods</th></tr></thead><tbody></tbody></table>
+<h2>Pods (<span id="podcount">0</span>)</h2>
+<table id="pods"><thead><tr><th>namespace/name</th><th>node</th><th>phase</th><th>selected-node annotation</th></tr></thead><tbody></tbody></table>
+<h2>Scheduling results <small>(click a pod)</small></h2>
+<div id="results">none selected</div>
+<script>
+const nodes = new Map(), pods = new Map();
+const PREFIX = "kube-scheduler-simulator.sigs.k8s.io/";
+// All interpolated data is escaped: snapshots/extender results are
+// untrusted input and reach this page via annotations.
+function esc(s) {
+  return String(s).replace(/[&<>"']/g, c => ({
+    "&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+}
+
+function render() {
+  const nb = document.querySelector("#nodes tbody"); nb.innerHTML = "";
+  for (const n of [...nodes.values()].sort((a,b)=>a.metadata.name.localeCompare(b.metadata.name))) {
+    const a = (n.status||{}).allocatable||{};
+    nb.insertAdjacentHTML("beforeend",
+      `<tr><td>${esc(n.metadata.name)}</td><td>${esc(a.cpu||"")}</td><td>${esc(a.memory||"")}</td><td>${esc(a.pods||"")}</td></tr>`);
+  }
+  document.getElementById("nodecount").textContent = nodes.size;
+  const pb = document.querySelector("#pods tbody"); pb.innerHTML = "";
+  for (const [key,p] of [...pods.entries()].sort()) {
+    const sel = ((p.metadata||{}).annotations||{})[PREFIX+"selected-node"]||"";
+    const nn = (p.spec||{}).nodeName||"";
+    pb.insertAdjacentHTML("beforeend",
+      `<tr data-pod="${esc(key)}" class="${nn?"":"pending"}"><td>${esc(key)}</td><td>${esc(nn)}</td><td>${esc((p.status||{}).phase||"Pending")}</td><td>${esc(sel)}</td></tr>`);
+  }
+  document.getElementById("podcount").textContent = pods.size;
+  for (const tr of document.querySelectorAll("tr[data-pod]"))
+    tr.onclick = () => showResults(tr.dataset.pod);
+}
+
+function showResults(key) {
+  const p = pods.get(key); if (!p) return;
+  const annos = ((p.metadata||{}).annotations)||{};
+  const cats = ["filter-result","score-result","finalscore-result","postfilter-result",
+                "prefilter-result-status","prescore-result","selected-node","result-history"];
+  let html = `<b>${esc(key)}</b>`;
+  for (const c of cats) {
+    const raw = annos[PREFIX+c]; if (raw === undefined) continue;
+    let body = raw;
+    try {
+      const obj = JSON.parse(raw);
+      if (c.endsWith("-result") && obj && typeof obj === "object" && !Array.isArray(obj)) {
+        const nodesK = Object.keys(obj).sort();
+        const plugins = [...new Set(nodesK.flatMap(n=>Object.keys(obj[n]||{})))].sort();
+        if (plugins.length) {
+          body = `<table><tr><th>node</th>${plugins.map(p=>`<th>${esc(p)}</th>`).join("")}</tr>` +
+            nodesK.map(n=>`<tr><td>${esc(n)}</td>${plugins.map(pl=>`<td>${esc((obj[n]||{})[pl]??"")}</td>`).join("")}</tr>`).join("") +
+            `</table>`;
+        } else { body = `<pre>${esc(JSON.stringify(obj,null,1))}</pre>`; }
+      } else { body = `<pre>${esc(JSON.stringify(obj,null,1))}</pre>`; }
+    } catch (e) { body = `<pre>${esc(raw)}</pre>`; }
+    html += `<h2>${esc(c)}</h2>${body}`;
+  }
+  document.getElementById("results").innerHTML = html;
+}
+
+async function watch() {
+  const resp = await fetch("/api/v1/listwatchresources");
+  document.getElementById("status").textContent = "live";
+  const reader = resp.body.getReader();
+  const dec = new TextDecoder(); let buf = "";
+  for (;;) {
+    const {value, done} = await reader.read();
+    if (done) break;
+    buf += dec.decode(value, {stream: true});
+    let i;
+    while ((i = buf.indexOf("\\n")) >= 0) {
+      const line = buf.slice(0, i); buf = buf.slice(i+1);
+      if (!line.trim()) continue;
+      const ev = JSON.parse(line);
+      const md = (ev.Obj||{}).metadata||{};
+      const key = (md.namespace ? md.namespace+"/" : "") + md.name;
+      const map = ev.Kind === "nodes" ? nodes : ev.Kind === "pods" ? pods : null;
+      if (!map) continue;
+      if (ev.EventType === "DELETED") map.delete(key); else map.set(key, ev.Obj);
+    }
+    render();
+  }
+  document.getElementById("status").textContent = "disconnected";
+}
+
+async function doExport() {
+  const r = await fetch("/api/v1/export");
+  const blob = await r.blob();
+  const a = document.createElement("a");
+  a.href = URL.createObjectURL(blob); a.download = "snapshot.json"; a.click();
+}
+async function doReset() {
+  await fetch("/api/v1/reset", {method: "PUT"});
+  nodes.clear(); pods.clear(); render();
+}
+watch();
+</script>
+</body>
+</html>
+"""

@@ -173,3 +173,22 @@ def test_watch_driven_scheduling_over_http(server):
         c.close()
     finally:
         di.scheduler_service.stop()
+
+
+def test_metrics_and_ui(server):
+    di = server.di
+    di.store.create("nodes", make_node("n0"))
+    di.store.create("pods", make_pod("p0"))
+    di.scheduler_service.schedule_pending()
+    status, m = _req(server, "GET", "/api/v1/metrics")
+    assert status == 200
+    assert m["counters"]["scheduling_passes"] >= 1
+    assert m["counters"]["pods_scheduled"] >= 1
+    assert m["timings"]["engine"]["count"] >= 1
+    # The built-in UI serves at / and references the watch endpoint.
+    c = _conn(server)
+    c.request("GET", "/")
+    r = c.getresponse()
+    body = r.read().decode()
+    c.close()
+    assert r.status == 200 and "listwatchresources" in body
