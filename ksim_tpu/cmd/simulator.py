@@ -39,7 +39,10 @@ def start_simulator(argv: list[str] | None = None) -> int:
     if args.port is not None:
         cfg.port = args.port
 
-    di = DIContainer(scheduler_config=cfg.initial_scheduler_cfg)
+    di = DIContainer(
+        scheduler_config=cfg.initial_scheduler_cfg,
+        scheduler_config_path=cfg.kube_scheduler_config_path or None,
+    )
 
     syncer = None
     if cfg.external_import_enabled or cfg.resource_sync_enabled:

@@ -76,8 +76,10 @@ class SchedulerService:
         featurizer: Featurizer | None = None,
         preemption: bool = True,
         max_pods_per_pass: int | None = None,
+        config_path: str | None = None,
     ) -> None:
         self._store = store
+        self._config_path = config_path
         self._registry = registry or {}
         self._record = record
         self._preemption = preemption
@@ -108,14 +110,29 @@ class SchedulerService:
         self.metrics = Metrics()
 
     MAX_BACKOFF_PASSES = 16
+    # An event-triggered flush caps the remaining wait instead of zeroing
+    # it: upstream cluster events move pods from the indefinite
+    # unschedulable pool into the BACKOFF queue — the pod still serves a
+    # backoff before retrying (podInitialBackoff).  First-attempt pods
+    # retry immediately; repeat offenders keep an attempts-proportional
+    # wait, so a churn stream (deletes nearly every step) can't make the
+    # whole saturated backlog retry every single pass.
+    FLUSH_CAP_PASSES = 4
 
     def flush_backoff(self) -> None:
-        """Retry every backed-off pod on the next pass (a node was
-        added/removed or capacity freed — upstream moves unschedulable
-        pods back to the active queue on such events)."""
+        """Accelerate backed-off pods (a node was added/removed or
+        capacity freed): remaining wait drops to min(attempts-1, cap)."""
         with self._backoff_lock:
             self._backoff = {
-                k: (attempts, 0) for k, (attempts, _r) in self._backoff.items()
+                k: (
+                    attempts,
+                    min(
+                        retry_at,
+                        self._pass_count
+                        + min(attempts - 1, self.FLUSH_CAP_PASSES),
+                    ),
+                )
+                for k, (attempts, retry_at) in self._backoff.items()
             }
 
     def _in_backoff(self, pod: JSON) -> bool:
@@ -165,6 +182,17 @@ class SchedulerService:
         self._profiles = {p.scheduler_name: p for p in profiles}
         self._extenders = extenders
         self._config = copy.deepcopy(cfg) or {}
+        # Persist the applied config like the reference rewrites the
+        # mounted scheduler.yaml (scheduler/config/config.go:33-60
+        # UpdateSchedulerConfig) — a restart then boots with it.
+        if self._config_path and self._config:
+            try:
+                import yaml
+
+                with open(self._config_path, "w") as f:
+                    yaml.safe_dump(self._config, f, sort_keys=False)
+            except OSError:
+                logger.exception("failed to write scheduler config")
 
     @property
     def extender_service(self):

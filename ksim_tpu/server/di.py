@@ -20,6 +20,7 @@ class DIContainer:
         registry: dict | None = None,
         record: str = "full",
         start_scheduler: bool = False,
+        scheduler_config_path: str | None = None,
     ) -> None:
         self.store = store if store is not None else ClusterStore()
         self.scheduler_service = SchedulerService(
@@ -27,6 +28,7 @@ class DIContainer:
             config=scheduler_config,
             registry=registry,
             record=record,
+            config_path=scheduler_config_path,
         )
         self.snapshot_service = SnapshotService(
             self.store, scheduler_service=self.scheduler_service

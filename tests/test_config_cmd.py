@@ -97,3 +97,17 @@ def test_scheduler_entrypoint_schedules_snapshot(tmp_path):
     assert result["pods"][0]["spec"]["nodeName"] == "n0"
     anno = result["pods"][0]["metadata"]["annotations"]
     assert anno["kube-scheduler-simulator.sigs.k8s.io/selected-node"] == "n0"
+
+
+def test_config_write_back(tmp_path):
+    """Applying a config persists it to the configured scheduler.yaml
+    (the reference's UpdateSchedulerConfig rewrite)."""
+    import yaml
+
+    from ksim_tpu.scheduler.service import SchedulerService
+    from ksim_tpu.state.cluster import ClusterStore
+
+    path = tmp_path / "scheduler.yaml"
+    svc = SchedulerService(ClusterStore(), config_path=str(path))
+    svc.apply_scheduler_config({"profiles": [{"schedulerName": "x"}]})
+    assert yaml.safe_load(path.read_text())["profiles"] == [{"schedulerName": "x"}]
