@@ -1,0 +1,28 @@
+"""Deprecated external-scheduler SDK (compat shim).
+
+The reference keeps ``pkg/externalscheduler`` as a deprecated older
+surface next to ``pkg/debuggablescheduler`` (reference
+simulator/pkg/externalscheduler/external_scheduler.go:39 deprecation
+note).  This module mirrors that arrangement: the same capabilities,
+re-exported under the old names, emitting DeprecationWarning.  New code
+uses ksim_tpu.scheduler.service / ksim_tpu.cmd.scheduler directly.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from ksim_tpu.scheduler.profile import Builder  # noqa: F401 (compat)
+from ksim_tpu.scheduler.service import SchedulerService
+
+
+def new_scheduler(store, *, config=None, registry=None, **kw) -> SchedulerService:
+    """Deprecated: construct the debuggable scheduler service (the
+    reference's externalscheduler.NewSchedulerCommand analogue)."""
+    warnings.warn(
+        "ksim_tpu.externalscheduler is deprecated; use "
+        "ksim_tpu.scheduler.service.SchedulerService",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    return SchedulerService(store, config=config, registry=registry, **kw)

@@ -35,6 +35,8 @@ MIN_CANDIDATE_NODES_ABSOLUTE = 100
 
 
 def pod_priority(pod: JSON) -> int:
+    """Bare spec.priority (callers wanting PriorityClass resolution pass
+    a resolver from state/priorities.py as ``priority_of``)."""
     return int(pod.get("spec", {}).get("priority") or 0)
 
 
@@ -52,10 +54,10 @@ def _start_time(pod: JSON) -> str:
     )
 
 
-def _more_important(p: JSON) -> tuple:
+def _more_important(p: JSON, priority_of=pod_priority) -> tuple:
     """Sort key for util.MoreImportantPod order: higher priority first,
     then earlier start time."""
-    return (-pod_priority(p), _start_time(p), namespace_of(p), name_of(p))
+    return (-priority_of(p), _start_time(p), namespace_of(p), name_of(p))
 
 
 def _pods_by_node(pods: Sequence[JSON]) -> dict[str, list[JSON]]:
@@ -190,18 +192,19 @@ def _select_victims_on_node(
     cluster_pods: Sequence[JSON],
     namespaces: Sequence[JSON],
     volumes: dict | None = None,
+    priority_of=pod_priority,
 ) -> list[JSON] | None:
     """Upstream selectVictimsOnNode: remove all lower-priority pods, check
     feasibility, then reprieve as many as possible in importance order.
     Returns the victim list, or None when the node is not a candidate."""
     node_name = name_of(nodes[node_idx])
-    prio = pod_priority(pod)
+    prio = priority_of(pod)
     potential = [
         p
         for p in cluster_pods
         if p.get("spec", {}).get("nodeName") == node_name
         and p.get("status", {}).get("phase") not in ("Succeeded", "Failed")
-        and pod_priority(p) < prio
+        and priority_of(p) < prio
     ]
     if not potential:
         return None
@@ -212,7 +215,7 @@ def _select_victims_on_node(
         return None
     victims: list[JSON] = []
     # Reprieve in MoreImportantPod order (no PDBs -> single bucket).
-    for v in sorted(potential, key=_more_important):
+    for v in sorted(potential, key=lambda p: _more_important(p, priority_of)):
         state.add(v)
         if not state.fits(pod, node_idx):
             state.remove(v)
@@ -220,7 +223,7 @@ def _select_victims_on_node(
     return victims
 
 
-def _pick_one_node(candidates: list[Candidate]) -> Candidate:
+def _pick_one_node(candidates: list[Candidate], priority_of=pod_priority) -> Candidate:
     """Upstream pickOneNodeForPreemption, PDB criteria degenerate:
     lowest highest-victim-priority, then smallest priority sum, then
     fewest victims, then latest earliest victim start time, then first."""
@@ -237,12 +240,12 @@ def _pick_one_node(candidates: list[Candidate]) -> Candidate:
         HIGHEST-priority victims only."""
         if not c.victims:
             return ""
-        top = max(pod_priority(v) for v in c.victims)
-        return min(_start_time(v) for v in c.victims if pod_priority(v) == top)
+        top = max(priority_of(v) for v in c.victims)
+        return min(_start_time(v) for v in c.victims if priority_of(v) == top)
 
-    narrow(lambda c: max((pod_priority(v) for v in c.victims), default=-(2**31)))
+    narrow(lambda c: max((priority_of(v) for v in c.victims), default=-(2**31)))
     if len(best) > 1:
-        narrow(lambda c: sum(pod_priority(v) for v in c.victims))
+        narrow(lambda c: sum(priority_of(v) for v in c.victims))
     if len(best) > 1:
         narrow(lambda c: len(c.victims))
     if len(best) > 1:
@@ -258,6 +261,7 @@ def find_preemption(
     candidate_mask: Sequence[bool] | None = None,
     namespaces: Sequence[JSON] = (),
     volumes: dict | None = None,
+    priority_of=pod_priority,
 ) -> PreemptionDecision:
     """DefaultPreemption for one unschedulable pod.
 
@@ -276,7 +280,7 @@ def find_preemption(
         if candidate_mask is not None and not candidate_mask[ni]:
             continue
         victims = _select_victims_on_node(
-            pod, ni, nodes, pods_list, namespaces, volumes
+            pod, ni, nodes, pods_list, namespaces, volumes, priority_of
         )
         if victims is None:
             continue
@@ -287,7 +291,7 @@ def find_preemption(
             break
     if not candidates:
         return PreemptionDecision(nominated_node=None, victims=[])
-    chosen = _pick_one_node(candidates)
+    chosen = _pick_one_node(candidates, priority_of)
     return PreemptionDecision(
         nominated_node=chosen.node_name, victims=chosen.victims
     )

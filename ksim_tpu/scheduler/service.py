@@ -54,10 +54,15 @@ PluginsFactory = Callable[[FeaturizedSnapshot], Sequence[ScoredPlugin]]
 _OWN_RV_LIMIT = 4096
 
 
-def queue_sort_key(pod: JSON):
+def queue_sort_key(pod: JSON, priority_of=None):
     """Upstream PrioritySort: priority desc, then creation time asc; name
-    breaks exact ties deterministically."""
-    prio = int(pod.get("spec", {}).get("priority") or 0)
+    breaks exact ties deterministically.  ``priority_of`` resolves
+    PriorityClass names (state/priorities.py); bare spec.priority
+    otherwise."""
+    if priority_of is not None:
+        prio = priority_of(pod)
+    else:
+        prio = int(pod.get("spec", {}).get("priority") or 0)
     created = pod.get("metadata", {}).get("creationTimestamp") or ""
     return (-prio, created, namespace_of(pod), name_of(pod))
 
@@ -79,7 +84,9 @@ class SchedulerService:
         config_path: str | None = None,
     ) -> None:
         self._store = store
-        self._config_path = config_path
+        # Deferred below: the boot-time apply must NOT rewrite the user's
+        # file (the reference only rewrites on update calls).
+        self._config_path = None
         self._registry = registry or {}
         self._record = record
         self._preemption = preemption
@@ -94,7 +101,11 @@ class SchedulerService:
         self._initial_config = copy.deepcopy(config) or {}
         self._config: JSON = {}
         self._profiles: dict[str, CompiledProfile] = {}
+        from ksim_tpu.state.priorities import build_priority_resolver
+
+        self._priority_of = build_priority_resolver(())
         self.apply_scheduler_config(copy.deepcopy(self._initial_config))
+        self._config_path = config_path
         self._own_rvs: set[str] = set()
         self._own_rvs_lock = threading.Lock()
         self._stop = threading.Event()
@@ -184,14 +195,21 @@ class SchedulerService:
         self._config = copy.deepcopy(cfg) or {}
         # Persist the applied config like the reference rewrites the
         # mounted scheduler.yaml (scheduler/config/config.go:33-60
-        # UpdateSchedulerConfig) — a restart then boots with it.
-        if self._config_path and self._config:
+        # UpdateSchedulerConfig) — a restart then boots with it.  An
+        # empty config is persisted too (a reset must not resurrect the
+        # pre-reset file on restart).  Atomic: dump to a sibling temp
+        # file then replace, so a mid-write failure can't truncate the
+        # real file.
+        if self._config_path:
             try:
+                import os
                 import yaml
 
-                with open(self._config_path, "w") as f:
+                tmp = f"{self._config_path}.tmp"
+                with open(tmp, "w") as f:
                     yaml.safe_dump(self._config, f, sort_keys=False)
-            except OSError:
+                os.replace(tmp, self._config_path)
+            except (OSError, yaml.YAMLError):
                 logger.exception("failed to write scheduler config")
 
     @property
@@ -230,7 +248,7 @@ class SchedulerService:
         """Internal read-only variant over the store's live dicts."""
         return sorted(
             (p for p in self._store.list("pods", copy_objs=False) if self._is_pending(p)),
-            key=queue_sort_key,
+            key=lambda p: queue_sort_key(p, self._priority_of),
         )
 
     # -- one scheduling pass ------------------------------------------------
@@ -246,6 +264,11 @@ class SchedulerService:
             pvs=self._store.list("persistentvolumes", copy_objs=False),
             pvcs=self._store.list("persistentvolumeclaims", copy_objs=False),
             storage_classes=self._store.list("storageclasses", copy_objs=False),
+        )
+        from ksim_tpu.state.priorities import build_priority_resolver
+
+        self._priority_of = build_priority_resolver(
+            self._store.list("priorityclasses", copy_objs=False)
         )
         if not nodes:
             return {}
@@ -265,7 +288,7 @@ class SchedulerService:
             ]
             if not queue:
                 continue
-            queue.sort(key=queue_sort_key)
+            queue.sort(key=lambda p: queue_sort_key(p, self._priority_of))
             if self._max_pods_per_pass is not None:
                 queue = queue[: self._max_pods_per_pass]
             if self._plugins_factory is not None:
@@ -516,6 +539,7 @@ class SchedulerService:
         decision = pre.find_preemption(
             pod, nodes, cluster_pods, candidate_mask=live_mask,
             namespaces=namespaces, volumes=volumes,
+            priority_of=self._priority_of,
         )
         post = pre.render_postfilter_result(failed_nodes, decision.nominated_node)
         return decision.nominated_node, decision.victims, post

@@ -158,3 +158,23 @@ def test_preemption_rechecks_port_conflicts():
     hi["spec"]["containers"][0]["ports"] = [{"hostPort": 8080}]
     d2 = find_preemption(preemptor, [node], [hi, _bound("low2", "n0", "1", 1)])
     assert d2.nominated_node is None
+
+
+def test_priority_class_resolution():
+    """Pods naming a PriorityClass (no spec.priority) resolve through the
+    snapshot's priorityClasses for queue order AND preemption."""
+    store = ClusterStore()
+    store.create("priorityclasses", {
+        "apiVersion": "scheduling.k8s.io/v1", "kind": "PriorityClass",
+        "metadata": {"name": "critical"}, "value": 1000,
+    })
+    store.create("nodes", make_node("n0", cpu="2", memory="8Gi"))
+    store.create("pods", _bound("low", "n0", "2", 1))
+    crit = make_pod("crit", cpu="1", memory=None)
+    crit["spec"]["priorityClassName"] = "critical"  # no spec.priority
+    store.create("pods", crit)
+    svc = SchedulerService(store)
+    assert svc.schedule_pending() == {"default/crit": None}
+    # Preemption saw the resolved priority 1000 > 1 and evicted the holder.
+    assert store.get("pods", "crit")["status"]["nominatedNodeName"] == "n0"
+    assert svc.schedule_pending() == {"default/crit": "n0"}
