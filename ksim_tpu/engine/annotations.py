@@ -24,9 +24,12 @@ batched EngineResult tensors:
   the extension point (our kernels fold Pre* work into the fused kernels,
   so the recorded status is always success; PreFilterResult node lists are
   always nil upstream for the default plugins -> "{}" here).
-- ``reserve-result`` / ``permit-result`` / ``permit-result-timeout`` /
-  ``prebind-result``: "{}" — the default profile has no wrapped plugins at
-  these points in our kernel set (VolumeBinding is not yet implemented).
+- ``reserve-result`` / ``prebind-result``: {"VolumeBinding": "success"}
+  for scheduled pods when VolumeBinding is enabled at that point (the
+  default profile's only Reserve/PreBind plugin; wrappedplugin.go:616-645
+  Reserve, :670-697 PreBind); per-point profile disables drop it.
+- ``permit-result`` / ``permit-result-timeout``: "{}" — the default
+  profile has no Permit plugins.
 - ``bind-result``: {"DefaultBinder": "success"} for scheduled pods.
 - ``selected-node``: set only when the pod was scheduled (reference
   store.go AddSelectedNode is called at Reserve).
@@ -194,6 +197,21 @@ def render_pod_results(
     )
 
     selected = int(res.selected[pi])
+    # VolumeBinding is the default profile's only Reserve/PreBind plugin;
+    # on a successful cycle upstream's wrappers record "success" for it
+    # (wrappedplugin.go:616-645 Reserve, :670-697 PreBind).  Profiles can
+    # disable it at a single point (ScoredPlugin.reserve/prebind_enabled).
+    def _point_map(flag: str) -> dict:
+        if selected < 0:
+            return {}
+        return {
+            sp.plugin.name: SUCCESS_MESSAGE
+            for sp in plugins
+            if sp.plugin.name == "VolumeBinding" and getattr(sp, flag, True)
+        }
+
+    reserve_map = _point_map("reserve_enabled")
+    prebind_map = _point_map("prebind_enabled")
     out = {
         PRE_FILTER_RESULT_KEY: _marshal({}),
         PRE_FILTER_STATUS_KEY: _marshal(prefilter_status),
@@ -202,10 +220,10 @@ def render_pod_results(
         PRE_SCORE_RESULT_KEY: _marshal(prescore),
         SCORE_RESULT_KEY: _marshal(score_map),
         FINAL_SCORE_RESULT_KEY: _marshal(final_map),
-        RESERVE_RESULT_KEY: _marshal({}),
+        RESERVE_RESULT_KEY: _marshal(reserve_map),
         PERMIT_RESULT_KEY: _marshal({}),
         PERMIT_TIMEOUT_RESULT_KEY: _marshal({}),
-        PRE_BIND_RESULT_KEY: _marshal({}),
+        PRE_BIND_RESULT_KEY: _marshal(prebind_map),
         BIND_RESULT_KEY: _marshal(
             {"DefaultBinder": SUCCESS_MESSAGE} if selected >= 0 else {}
         ),

@@ -88,6 +88,12 @@ class ScoredPlugin:
     filter_enabled: bool = True
     score_enabled: bool = True
     extender: PluginExtender | None = None
+    # Host-side recording hints (not part of the traced computation): is
+    # the plugin active at the Reserve/PreBind points (profiles can
+    # disable single extension points; the annotation renderer consults
+    # these for reserve-result/prebind-result).
+    reserve_enabled: bool = True
+    prebind_enabled: bool = True
 
 
 @dataclass

@@ -202,6 +202,8 @@ class CompiledProfile:
     # a plugin at ONE point, not everywhere).
     filter_disabled: frozenset[str] = frozenset()
     score_disabled: frozenset[str] = frozenset()
+    reserve_disabled: frozenset[str] = frozenset()
+    prebind_disabled: frozenset[str] = frozenset()
     # Plugins added only through a per-point set: name -> points enabled.
     point_only: dict[str, frozenset[str]] = field(default_factory=dict)
 
@@ -231,6 +233,8 @@ class CompiledProfile:
                     weight=weight if weight > 0 else 1,
                     filter_enabled=filter_on,
                     score_enabled=score_on,
+                    reserve_enabled=name not in self.reserve_disabled,
+                    prebind_enabled=name not in self.prebind_disabled,
                 )
             )
         return tuple(out)
@@ -289,6 +293,8 @@ def compile_profile(
     default_names = {n for n, _ in DEFAULT_MULTIPOINT}
     filter_off: set[str] = set()
     score_off: set[str] = set()
+    reserve_off: set[str] = set()
+    prebind_off: set[str] = set()
     point_only: dict[str, set[str]] = {}
     for point in ("preFilter", "filter", "postFilter", "preScore", "score",
                   "reserve", "permit", "preBind", "bind", "postBind"):
@@ -301,6 +307,10 @@ def compile_profile(
             filter_off |= have if "*" in disabled_here else disabled_here
         elif point == "score":
             score_off |= have if "*" in disabled_here else disabled_here
+        elif point == "reserve":
+            reserve_off |= have if "*" in disabled_here else disabled_here
+        elif point == "preBind":
+            prebind_off |= have if "*" in disabled_here else disabled_here
         for p in point_cfg.get("enabled") or []:
             name = p.get("name")
             if not name:
@@ -352,6 +362,8 @@ def compile_profile(
         hard_pod_affinity_weight=hard_weight,
         filter_disabled=frozenset(filter_off),
         score_disabled=frozenset(score_off),
+        reserve_disabled=frozenset(reserve_off),
+        prebind_disabled=frozenset(prebind_off),
         point_only={k: frozenset(v) for k, v in point_only.items()},
     )
 

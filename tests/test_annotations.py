@@ -121,3 +121,37 @@ def test_apply_results_merges_and_records_history():
     assert pod_anno[SELECTED_NODE_KEY] == "n1"
     hist = json.loads(pod_anno[RESULT_HISTORY_KEY])
     assert len(hist) == 1 and hist[0][SELECTED_NODE_KEY] == "n1"
+
+
+def test_reserve_prebind_record_volume_binding():
+    """Scheduled pods record VolumeBinding success at Reserve/PreBind
+    (the default profile's only plugin at those points); a per-point
+    profile disable drops it from that annotation only."""
+    import json
+
+    from ksim_tpu.scheduler.service import SchedulerService
+    from ksim_tpu.state.cluster import ClusterStore
+    from tests.helpers import make_node, make_pod
+    from ksim_tpu.engine.annotations import (
+        PRE_BIND_RESULT_KEY,
+        RESERVE_RESULT_KEY,
+    )
+
+    store = ClusterStore()
+    store.create("nodes", make_node("n0"))
+    store.create("pods", make_pod("p0"))
+    SchedulerService(store).schedule_pending()
+    annos = store.get("pods", "p0")["metadata"]["annotations"]
+    assert json.loads(annos[RESERVE_RESULT_KEY]) == {"VolumeBinding": "success"}
+    assert json.loads(annos[PRE_BIND_RESULT_KEY]) == {"VolumeBinding": "success"}
+
+    store2 = ClusterStore()
+    store2.create("nodes", make_node("n0"))
+    store2.create("pods", make_pod("p0"))
+    cfg = {"profiles": [{
+        "plugins": {"reserve": {"disabled": [{"name": "VolumeBinding"}]}},
+    }]}
+    SchedulerService(store2, config=cfg).schedule_pending()
+    annos2 = store2.get("pods", "p0")["metadata"]["annotations"]
+    assert json.loads(annos2[RESERVE_RESULT_KEY]) == {}
+    assert json.loads(annos2[PRE_BIND_RESULT_KEY]) == {"VolumeBinding": "success"}
