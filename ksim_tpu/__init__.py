@@ -5,7 +5,7 @@ A re-imagining of kubernetes-sigs/kube-scheduler-simulator (reference at
 plugin) Filter/Score hot loop (reference:
 simulator/scheduler/plugin/wrappedplugin.go:420-548) is collapsed into fused
 JAX kernels evaluating all pod-by-node filter masks and score matrices in one
-vmap/pjit pass on TPU, while preserving the reference's product surface:
+vmap/lax.scan pass on TPU, while preserving the reference's product surface:
 
 - per-plugin, per-node scheduling results recorded as explainable annotations
   (reference: simulator/scheduler/plugin/resultstore/store.go)
@@ -13,17 +13,22 @@ vmap/pjit pass on TPU, while preserving the reference's product surface:
   ``ResourcesForSnap`` (reference: simulator/snapshot/snapshot.go:33-54)
 - KubeSchedulerConfiguration-driven profiles ("profile compilation" replaces
   the reference's Docker-restart reload, simulator/scheduler/scheduler.go:58-111)
-- scenario replay (reference design: keps/140-scenario-based-simulation)
-- a watchable REST/SSE API (reference: simulator/server/server.go:41-54)
+- preemption, extender webhooks, resource syncing, scenario replay
+  (reference design: keps/140-scenario-based-simulation)
+- a watchable REST/streaming API + built-in UI (reference:
+  simulator/server/server.go:41-54, web/)
 
 Layout (maps to SURVEY.md section 7):
-    state/     cluster state, quantities, snapshot JSON, featurizer
-    plugins/   per-plugin kernel pairs (filter/score), numpy parity models
-    engine/    batched evaluation, lax.scan commit loop, sharding
-    sched/     scheduling framework: registry, wrapped plugins, result store
-    server/    REST + SSE simulator shell
-    services/  reset / syncer / importer / resource watcher
-    scenario/  replay harness
+    state/     cluster store, quantities, snapshot JSON, featurizer, encoders
+    plugins/   per-plugin kernels (filter/score), parity oracle, samples
+    engine/    batched evaluation, lax.scan commit loop, sharding, annotations
+    scheduler/ service, profiles, preemption, extenders
+    server/    REST + streaming-watch shell, DI container, reset, UI
+    syncer/    continuous cluster mirroring; oneshotimporter for boot import
+    scenario/  replay harness (churn generator)
+    cmd/       ksim-simulator / ksim-scheduler entrypoints
+
+See docs/migration.md for the reference -> ksim_tpu capability map.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

@@ -26,11 +26,12 @@ Plugin args honored from pluginConfig (upstream *Args types):
 ``InterPodAffinityArgs.hardPodAffinityWeight`` (threaded into the
 featurizer's inter-pod encoding).
 
-Names the upstream default profile enables that have no batched kernel
-yet are STRUCTURAL (handled by the service: PrioritySort = queue sort,
-DefaultBinder = bind, DefaultPreemption = postfilter, SchedulingGates) or
-UNIMPLEMENTED (volume family; they compile to no-ops and are listed in
-``CompiledProfile.skipped`` so callers can surface the gap).
+Every upstream default-profile plugin resolves: kernels for the filter/
+score families (including the volume family), STRUCTURAL handling in the
+service for PrioritySort (queue sort with PriorityClass resolution),
+DefaultBinder (bind), DefaultPreemption (postfilter), and SchedulingGates
+(queue gate).  Truly unknown names raise; anything enabled without a
+kernel would surface through ``CompiledProfile.skipped``.
 """
 
 from __future__ import annotations
