@@ -262,14 +262,19 @@ class _Program:
                 )
         return out
 
-    def _select(self, filter_ok: jnp.ndarray, total: jnp.ndarray):
+    def _select(self, filter_ok: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+        """selectHost: index of the max-scoring feasible node, -1 when
+        none is feasible (feasibility is fully encoded in the sign)."""
         feasible = jnp.any(filter_ok)
         masked = jnp.where(filter_ok, total, jnp.iinfo(jnp.int32).min)
         best = jnp.argmax(masked).astype(jnp.int32)
-        return feasible, jnp.where(feasible, best, -1)
+        return jnp.where(feasible, best, -1)
 
-    def _pod_outputs(self, pv, feasible, best, bits, raw, final, total) -> dict:
-        out = dict(feasible=feasible & pv, selected=jnp.where(pv, best, -1))
+    def _pod_outputs(self, pv, best, bits, raw, final, total) -> dict:
+        # No separate feasible output: selected >= 0 iff (valid & any node
+        # passed), so _to_result derives it — one fewer device->host pull
+        # per chunk (each costs ~150ms over a high-latency link).
+        out = dict(selected=jnp.where(pv, best, -1))
         n = total.shape[0]
         if self.record in ("full", "final"):
             out["total"] = total
@@ -292,8 +297,8 @@ class _Program:
                 index=pb.index,
             )
             ok, bits, raw, final, total = self._eval_one(state, pod, aux, carries)
-            feasible, best = self._select(ok, total)
-            return self._pod_outputs(pb.valid, feasible, best, bits, raw, final, total)
+            best = self._select(ok, total)
+            return self._pod_outputs(pb.valid, best, bits, raw, final, total)
 
         return jax.vmap(per_pod)(pods)
 
@@ -309,12 +314,11 @@ class _Program:
                 index=pb.index,
             )
             ok, bits, raw, final, total = self._eval_one(node_state, pod, aux, plugin_carries)
-            feasible, best = self._select(ok, total)
-            best = jnp.where(pb.valid, best, -1)
+            best = jnp.where(pb.valid, self._select(ok, total), -1)
             node_state = node_state.commit(best, pb.requests, pb.nonzero_requests)
             plugin_carries = self._commit_carries(plugin_carries, pod, best, aux)
             return (node_state, plugin_carries), self._pod_outputs(
-                pb.valid, feasible, best, bits, raw, final, total
+                pb.valid, best, bits, raw, final, total
             )
 
         (final_state, final_carries), out = jax.lax.scan(body, (state, carries), pods)
@@ -474,6 +478,7 @@ class Engine:
         ]
         score_names = [sp.plugin.name for sp in self._plugins if sp.score_enabled]
         get = lambda k: np.asarray(out[k]) if k in out else None
+        selected = np.asarray(out["selected"])
         return EngineResult(
             plugin_names=score_names,
             filter_plugin_names=filter_names,
@@ -481,6 +486,6 @@ class Engine:
             scores=get("raw"),
             final_scores=get("final"),
             total=get("total"),
-            feasible=np.asarray(out["feasible"]),
-            selected=np.asarray(out["selected"]),
+            feasible=selected >= 0,
+            selected=selected,
         )

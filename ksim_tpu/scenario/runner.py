@@ -151,7 +151,7 @@ class ScenarioRunner:
                     ops_applied=len(batch),
                     scheduled=scheduled,
                     unschedulable=unsched,
-                    pending_after=len(self.service.pending_pods()),
+                    pending_after=self.service.pending_count(),
                 )
             )
         result.wall_seconds = time.perf_counter() - t0

@@ -241,8 +241,18 @@ class SchedulerService:
         return name in self._scheduler_names
 
     def pending_pods(self) -> list[JSON]:
-        """The sorted pending queue (deep copies — callers may mutate)."""
+        """The sorted pending queue (deep copies — callers may mutate).
+        Public API (the reference UI lists it); hot loops wanting only
+        the size use pending_count()."""
         return copy.deepcopy(self._pending_pods_live())
+
+    def pending_count(self) -> int:
+        """Number of pending pods (no copies — the hot-loop counter)."""
+        return sum(
+            1
+            for p in self._store.list("pods", copy_objs=False)
+            if self._is_pending(p)
+        )
 
     def _pending_pods_live(self) -> list[JSON]:
         """Internal read-only variant over the store's live dicts."""

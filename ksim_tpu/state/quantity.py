@@ -67,11 +67,10 @@ class Quantity:
         return self.scaled(Fraction(1, 1000))
 
     def scaled(self, unit: Fraction | int) -> int:
-        """Number of ``unit``-sized chunks, rounded up (away from zero)."""
-        q = self.raw / Fraction(unit)
-        if q >= 0:
-            return _ceil_div(q.numerator, q.denominator)
-        return -_ceil_div(-q.numerator, q.denominator)
+        """Number of ``unit``-sized chunks, rounded up (away from zero).
+        Cached — featurization rescales the same handful of distinct
+        (value, unit) pairs for every pod every pass."""
+        return _scaled_cached(self.raw, unit)
 
     @property
     def is_integer(self) -> bool:
@@ -88,6 +87,14 @@ class Quantity:
             return f"{m.numerator}m"
         n = self.raw * 10**9
         return f"{_ceil_div(n.numerator, n.denominator)}n"
+
+
+@lru_cache(maxsize=65536)
+def _scaled_cached(raw: Fraction, unit: Fraction | int) -> int:
+    q = raw / Fraction(unit)
+    if q >= 0:
+        return _ceil_div(q.numerator, q.denominator)
+    return -_ceil_div(-q.numerator, q.denominator)
 
 
 def parse_quantity(s: str | int | float | Quantity) -> Quantity:
