@@ -80,13 +80,17 @@ def run_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
 
     # One-shot batch evaluation, record="full": materializes every filter
     # reason / raw score / final score matrix (the product's recorded
-    # results) on device, streamed chunk by chunk (the product decodes
-    # per-pod annotations on demand; host transfer of the full dense
-    # tensors is ~9GB at this shape and is not part of the eval path).
+    # results) on device, streamed chunk by chunk, pulling each chunk's
+    # selection decisions to the host (the dense result tensors stay
+    # device-resident for on-demand decode — transferring all ~9GB at
+    # this shape is not part of the eval path).
+    import numpy as np
+
     engb = Engine(feats, default_plugins(feats), record="full")
 
     def batch_pass():
         for _s, out in engb.evaluate_batch_chunks():
+            np.asarray(out["selected"])
             jax.block_until_ready(out)
 
     batch_pass()  # compile + warmup
