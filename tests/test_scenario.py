@@ -70,3 +70,18 @@ def test_churn_replay_end_to_end():
     for p in runner.store.list("pods"):
         nn = p["spec"].get("nodeName")
         assert nn is None or nn in nodes
+
+
+def test_churn_replay_deterministic():
+    """Same seed -> identical placements and aggregates (the replayable-
+    trace property the deterministic selectHost tiebreak exists for)."""
+    def run_once():
+        runner = ScenarioRunner()
+        res = runner.run(churn_scenario(9, n_nodes=20, n_events=300, ops_per_step=30))
+        bound = sorted(
+            (p["metadata"]["name"], p["spec"].get("nodeName"))
+            for p in runner.store.list("pods")
+        )
+        return res.pods_scheduled, res.unschedulable_attempts, bound
+
+    assert run_once() == run_once()

@@ -117,3 +117,32 @@ def test_unschedulable_backoff_skips_and_flushes():
     assert svc.schedule_pending() == {"default/big": "n1"}
     # Scheduling cleared the backoff entry.
     assert svc._backoff == {}
+
+
+def test_multiple_profiles_schedule_their_own_pods():
+    """Two profiles in one config: each schedules only pods addressed to
+    its schedulerName, sequentially sharing cluster capacity."""
+    from tests.helpers import make_node, make_pod
+
+    store = ClusterStore()
+    store.create("nodes", make_node("n0", cpu="2", memory="8Gi"))
+    a = make_pod("a", cpu="1", memory=None)
+    b = make_pod("b", cpu="1", memory=None)
+    b["spec"]["schedulerName"] = "second"
+    c = make_pod("c", cpu="1", memory=None)
+    c["spec"]["schedulerName"] = "unknown-scheduler"
+    for p in (a, b, c):
+        store.create("pods", p)
+    svc = SchedulerService(store, config={
+        "profiles": [
+            {"schedulerName": "default-scheduler"},
+            {"schedulerName": "second"},
+        ]
+    })
+    placements = svc.schedule_pending()
+    # Both profiles' pods bound; the unknown scheduler's pod untouched.
+    assert placements == {"default/a": "n0", "default/b": "n0"}
+    assert store.get("pods", "c")["spec"].get("nodeName") is None
+    # Capacity was shared: 2 cpu total, both 1-cpu pods fit exactly.
+    assert store.get("pods", "a")["spec"]["nodeName"] == "n0"
+    assert store.get("pods", "b")["spec"]["nodeName"] == "n0"
