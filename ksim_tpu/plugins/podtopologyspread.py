@@ -71,7 +71,23 @@ DENSE_MAX = 256
 
 
 def _ftype():
+    """Dtype for GO-PARITY float math (the log-weighted score): float64
+    under x64 to match the oracle bit-for-bit."""
     return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+# The one-hot/selector matmul machinery moves integer COUNTS (< 2^24,
+# bounded by the pod count), which float32 represents exactly — always
+# use f32 there: f64 matmuls are software-emulated on TPU and dominated
+# the exact-mode scan (~4s of 7.5s at 16k x 8k).  The matmuls need
+# HIGHEST precision: TPU f32 matmuls default to bf16 passes whose 8-bit
+# mantissa silently truncates counts above 256.
+_COUNT_FT = jnp.float32
+_EXACT = jax.lax.Precision.HIGHEST
+
+
+def _mm(a, b):
+    return jnp.matmul(a, b, precision=_EXACT)
 
 
 class PodTopologySpread:
@@ -151,9 +167,8 @@ class PodTopologySpread:
         """[N, MC] the carried matching-pod count for each constraint's
         selector context (one narrow matmul instead of per-ci gathers)."""
         s = carry.shape[1]
-        ft = _ftype()
-        sel_oh = (con["sel"][None, :] == jnp.arange(s)[:, None]).astype(ft)  # [S, MC]
-        return (carry.astype(ft) @ sel_oh).astype(jnp.int32)
+        sel_oh = (con["sel"][None, :] == jnp.arange(s)[:, None]).astype(_COUNT_FT)
+        return _mm(carry.astype(_COUNT_FT), sel_oh).astype(jnp.int32)
 
     def _per_key_stats(self, aux, con, pres_mask, cnt_for):
         """Domain statistics for every constraint at once, via the static
@@ -170,7 +185,7 @@ class PodTopologySpread:
         min present-domain sum, _BIG when none present).
         """
         ldom = aux["spread"]["node_ldom"]
-        ft = _ftype()
+        ft = _COUNT_FT  # integer counts: f32-exact, no emulated f64
         n = ldom.shape[0]
         seg_at = jnp.zeros((n, self._mc), jnp.int32)
         dom_num = jnp.zeros((self._mc,), jnp.int32)
@@ -186,10 +201,10 @@ class PodTopologySpread:
                 oh = (
                     ldom[:, k][:, None] == jnp.arange(self._sizes[k])[None, :]
                 ).astype(ft)  # [N, Dk]
-                pres = (oh.T @ pres_mask.astype(ft)) > 0  # [Dk, MC]
-                reg_at = (oh @ pres.astype(ft)) > 0  # [N, MC]
-                seg_d = oh.T @ cnt_for(reg_at).astype(ft)  # [Dk, MC]
-                seg_k = (oh @ seg_d).astype(jnp.int32)
+                pres = _mm(oh.T, pres_mask.astype(ft)) > 0  # [Dk, MC]
+                reg_at = _mm(oh, pres.astype(ft)) > 0  # [N, MC]
+                seg_d = _mm(oh.T, cnt_for(reg_at).astype(ft))  # [Dk, MC]
+                seg_k = _mm(oh, seg_d).astype(jnp.int32)
                 dn_k = jnp.sum(pres, axis=0).astype(jnp.int32)
                 mm_k = jnp.min(
                     jnp.where(pres, seg_d, _BIG), axis=0
@@ -278,7 +293,7 @@ class PodTopologySpread:
         fd = filtered[:, None] & haskey  # [N, MC]
         elig0 = self._policy_elig(state, pod, aux, con) & haskey
         cnt_mc = self._sel_counts(carry, con)
-        seg_at, dom_num, _mm = self._per_key_stats(
+        seg_at, dom_num, _min_unused = self._per_key_stats(
             aux, con, fd, lambda reg_at: jnp.where(elig0 & reg_at, cnt_mc, 0)
         )
 
