@@ -97,7 +97,9 @@ class ClusterStore:
             md["resourceVersion"] = str(next(self._rv))
             md.setdefault("uid", f"uid-{kind}-{md['resourceVersion']}")
             self._objects[kind][key] = obj
-            self._notify(WatchEvent(kind, ADDED, copy.deepcopy(obj)))
+            # The stored object is frozen (writes replace, never mutate), so
+            # the event and history can share it without a copy.
+            self._notify(WatchEvent(kind, ADDED, obj))
             return copy.deepcopy(obj)
 
     def get(self, kind: str, name: str, namespace: str = "") -> JSON:
@@ -142,7 +144,7 @@ class ClusterStore:
             md["uid"] = current["metadata"].get("uid")
             md["resourceVersion"] = str(next(self._rv))
             self._objects[kind][key] = obj
-            self._notify(WatchEvent(kind, MODIFIED, copy.deepcopy(obj)))
+            self._notify(WatchEvent(kind, MODIFIED, obj))
             return copy.deepcopy(obj)
 
     def patch(
@@ -159,7 +161,7 @@ class ClusterStore:
             mutate(obj)
             obj["metadata"]["resourceVersion"] = str(next(self._rv))
             self._objects[kind][key] = obj
-            self._notify(WatchEvent(kind, MODIFIED, copy.deepcopy(obj)))
+            self._notify(WatchEvent(kind, MODIFIED, obj))
             return copy.deepcopy(obj)
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
@@ -171,8 +173,11 @@ class ClusterStore:
                 raise NotFoundError(f"{kind} {key!r} not found")
             # A delete is a new store event: stamp a fresh resourceVersion
             # (like the apiserver) so watch-resume replay — which filters
-            # history on rv > lastResourceVersion — never drops it.
-            obj["metadata"]["resourceVersion"] = str(next(self._rv))
+            # history on rv > lastResourceVersion — never drops it.  The
+            # rebumped object is a shallow re-wrap: the popped dict may be
+            # shared with earlier events/history (frozen contract) and
+            # must not be mutated in place.
+            obj = dict(obj, metadata=dict(obj["metadata"], resourceVersion=str(next(self._rv))))
             self._notify(WatchEvent(kind, DELETED, obj))
 
     def apply(self, kind: str, obj: JSON) -> JSON:
@@ -266,7 +271,9 @@ class ClusterStore:
         with self._lock:
             for kind in KINDS:
                 for obj in list(self._objects[kind].values()):
-                    obj["metadata"]["resourceVersion"] = str(next(self._rv))
+                    # Shallow re-wrap, not in-place: the stored dict may be
+                    # shared with earlier events/history (frozen contract).
+                    obj = dict(obj, metadata=dict(obj["metadata"], resourceVersion=str(next(self._rv))))
                     self._notify(WatchEvent(kind, DELETED, obj))
                 self._objects[kind].clear()
             for kind, objs in dump.items():
@@ -277,7 +284,7 @@ class ClusterStore:
                         next(self._rv)
                     )
                     self._objects[kind][key] = restored
-                    self._notify(WatchEvent(kind, ADDED, copy.deepcopy(restored)))
+                    self._notify(WatchEvent(kind, ADDED, restored))
 
     def _check_kind(self, kind: str) -> None:
         if kind not in self._objects:

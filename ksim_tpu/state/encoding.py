@@ -90,14 +90,22 @@ class _TermVocab:
         self.term_list: list[list[int]] = []
 
     def req_id(self, req: JSON) -> int:
-        k = _canon(req)
+        return self.req_id_by_key(_canon(req), req)
+
+    def req_id_by_key(self, k: str, req: JSON) -> int:
         if k not in self.reqs:
             self.reqs[k] = len(self.req_list)
             self.req_list.append(req)
         return self.reqs[k]
 
     def term_id(self, reqs: Sequence[JSON]) -> int:
-        ids = sorted(self.req_id(r) for r in reqs)
+        return self._term_of_ids(sorted(self.req_id(r) for r in reqs))
+
+    def term_id_by_keys(self, pairs: Sequence[tuple[JSON, str]]) -> int:
+        """Term id from (req, canonical-key) pairs — skips re-canoning."""
+        return self._term_of_ids(sorted(self.req_id_by_key(k, r) for r, k in pairs))
+
+    def _term_of_ids(self, ids: list[int]) -> int:
         k = _canon(ids)
         if k not in self.terms:
             self.terms[k] = len(self.term_list)
@@ -119,15 +127,50 @@ def _term_reqs_from_selector_term(term: JSON) -> list[JSON] | None:
     return reqs or None
 
 
+def _parsed_node_affinity(pod: JSON) -> dict:
+    """Vocab-independent nodeSelector/nodeAffinity parse with canonical
+    requirement keys, memoized per pod object.  Pairs are (req, canon)."""
+    from ksim_tpu.state import objcache
+
+    def build() -> dict:
+        spec = pod.get("spec", {})
+        out: dict = {"sel": None, "req": None, "pref": []}
+        ns = spec.get("nodeSelector")
+        if ns:
+            reqs = [
+                {"key": k, "operator": "In", "values": [v]} for k, v in sorted(ns.items())
+            ]
+            out["sel"] = [(r, _canon(r)) for r in reqs]
+        aff = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+        required = aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+        if required is not None:
+            terms = []
+            for t in required.get("nodeSelectorTerms") or []:
+                reqs = _term_reqs_from_selector_term(t)
+                terms.append(None if reqs is None else [(r, _canon(r)) for r in reqs])
+            out["req"] = terms
+        for pt in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+            reqs = _term_reqs_from_selector_term(pt.get("preference") or {})
+            out["pref"].append(
+                (
+                    None if reqs is None else [(r, _canon(r)) for r in reqs],
+                    int(pt.get("weight", 0)),
+                )
+            )
+        return out
+
+    return objcache.cached("affpod", pod, build)
+
+
 def encode_affinity(
     nodes: Sequence[JSON], pods: Sequence[JSON], n_padded: int, p_padded: int
 ) -> AffinityTensors:
-    vocab = _TermVocab()
-    EMPTY = -2  # sentinel term id for match-nothing terms
+    from ksim_tpu.state import objcache
 
-    def term_for(term: JSON) -> int:
-        reqs = _term_reqs_from_selector_term(term)
-        return EMPTY if reqs is None else vocab.term_id(reqs)
+    vocab = _TermVocab()
+
+    def term_of_pairs(pairs: list[tuple[JSON, str]]) -> int:
+        return vocab.term_id_by_keys(pairs)
 
     sel_term = np.full(p_padded, -1, dtype=np.int32)
     has_req = np.zeros(p_padded, dtype=bool)
@@ -135,40 +178,44 @@ def encode_affinity(
     pref: list[dict[int, int]] = [{} for _ in range(p_padded)]
 
     for j, pod in enumerate(pods):
-        spec = pod.get("spec", {})
-        ns = spec.get("nodeSelector")
-        if ns:
-            reqs = [
-                {"key": k, "operator": "In", "values": [v]} for k, v in sorted(ns.items())
-            ]
-            sel_term[j] = vocab.term_id(reqs)
-        aff = (spec.get("affinity") or {}).get("nodeAffinity") or {}
-        required = aff.get("requiredDuringSchedulingIgnoredDuringExecution")
-        if required is not None:
+        parsed = _parsed_node_affinity(pod)
+        if parsed["sel"] is not None:
+            sel_term[j] = term_of_pairs(parsed["sel"])
+        if parsed["req"] is not None:
             has_req[j] = True
-            for t in required.get("nodeSelectorTerms") or []:
-                tid = term_for(t)
+            for pairs in parsed["req"]:
                 # Match-nothing terms contribute nothing to the OR.
-                if tid != EMPTY:
-                    req_terms[j].append(tid)
-        for pt in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
-            tid = term_for(pt.get("preference") or {})
-            if tid != EMPTY:
-                w = int(pt.get("weight", 0))
+                if pairs is not None:
+                    req_terms[j].append(term_of_pairs(pairs))
+        for pairs, w in parsed["pref"]:
+            if pairs is not None:
+                tid = term_of_pairs(pairs)
                 pref[j][tid] = pref[j].get(tid, 0) + w
 
     Q = _vpad(len(vocab.req_list))
     T = _vpad(len(vocab.term_list))
-    node_req_match = np.zeros((n_padded, max(Q, 1)), dtype=bool)
-    for ni, node in enumerate(nodes):
+    Q0 = len(vocab.req_list)
+    reqs_token = hash(tuple(vocab.reqs))
+
+    def node_row(node: JSON) -> np.ndarray:
+        key = ("affnode", objcache.ref_id(node), reqs_token)
+        hit = objcache.get(key)
+        if hit is not objcache.MISS:
+            return hit
         lbls = dict(labels_of(node))
         field_lbls = {"metadata.name": name_of(node)}
+        row = np.zeros(Q0, dtype=bool)
         for qi, req in enumerate(vocab.req_list):
             if req.get("_field"):
                 r = {k: v for k, v in req.items() if k != "_field"}
-                node_req_match[ni, qi] = match_node_selector_requirement(r, field_lbls)
+                row[qi] = match_node_selector_requirement(r, field_lbls)
             else:
-                node_req_match[ni, qi] = match_node_selector_requirement(req, lbls)
+                row[qi] = match_node_selector_requirement(req, lbls)
+        return objcache.put(key, row)
+
+    node_req_match = np.zeros((n_padded, max(Q, 1)), dtype=bool)
+    for ni, node in enumerate(nodes):
+        node_req_match[ni, :Q0] = node_row(node)
 
     term_req = np.zeros((max(T, 1), max(Q, 1)), dtype=bool)
     term_size = np.full(max(T, 1), -1, dtype=np.int32)
@@ -229,8 +276,9 @@ def encode_taints(
     vocab: dict[str, int] = {}
     taints: list[JSON] = []
 
-    def tid(t: JSON) -> int:
-        key = _canon({"key": t.get("key", ""), "value": t.get("value", ""), "effect": t.get("effect", "")})
+    from ksim_tpu.state import objcache
+
+    def tid(key: str, t: JSON) -> int:
         if key not in vocab:
             vocab[key] = len(taints)
             taints.append(
@@ -238,9 +286,23 @@ def encode_taints(
             )
         return vocab[key]
 
+    def node_taints(node: JSON) -> list[tuple[str, JSON]]:
+        """[(canonical key, taint)] per node, memoized per object."""
+
+        def build() -> list[tuple[str, JSON]]:
+            return [
+                (
+                    _canon({"key": t.get("key", ""), "value": t.get("value", ""), "effect": t.get("effect", "")}),
+                    t,
+                )
+                for t in node.get("spec", {}).get("taints") or []
+            ]
+
+        return objcache.cached("nodetaints", node, build)
+
     per_node: list[list[int]] = []
     for node in nodes:
-        per_node.append([tid(t) for t in node.get("spec", {}).get("taints") or []])
+        per_node.append([tid(k, t) for k, t in node_taints(node)])
 
     W = _vpad(len(taints))
     order = np.zeros((n_padded, W), dtype=np.int32)
@@ -254,14 +316,36 @@ def encode_taints(
         forbidding[w] = t["effect"] in FORBIDDING_EFFECTS
         prefer[w] = t["effect"] == "PreferNoSchedule"
 
+    W0 = len(taints)
+    taints_token = hash(tuple(vocab))
+
+    def tol_rows(pod: JSON) -> tuple[np.ndarray, np.ndarray]:
+        """(tolerated, tolerated_prefer) rows over the taint vocab,
+        memoized per (pod object, vocab)."""
+        key = ("taintrow", objcache.ref_id(pod), taints_token)
+        hit = objcache.get(key)
+        if hit is not objcache.MISS:
+            return hit
+        tols = pod_tolerations(pod)
+        prefer_tols = [t for t in tols if (t.get("effect") or "") in ("", "PreferNoSchedule")]
+        row = np.fromiter(
+            (any(toleration_tolerates(tl, t) for tl in tols) for t in taints),
+            dtype=bool,
+            count=W0,
+        )
+        prow = np.fromiter(
+            (any(toleration_tolerates(tl, t) for tl in prefer_tols) for t in taints),
+            dtype=bool,
+            count=W0,
+        )
+        return objcache.put(key, (row, prow))
+
     tolerated = np.zeros((p_padded, W), dtype=bool)
     tolerated_prefer = np.zeros((p_padded, W), dtype=bool)
     for j, pod in enumerate(pods):
-        tols = pod_tolerations(pod)
-        prefer_tols = [t for t in tols if (t.get("effect") or "") in ("", "PreferNoSchedule")]
-        for w, t in enumerate(taints):
-            tolerated[j, w] = any(toleration_tolerates(tl, t) for tl in tols)
-            tolerated_prefer[j, w] = any(toleration_tolerates(tl, t) for tl in prefer_tols)
+        row, prow = tol_rows(pod)
+        tolerated[j, :W0] = row
+        tolerated_prefer[j, :W0] = prow
 
     return TaintTensors(
         taints=taints,
@@ -357,30 +441,49 @@ def encode_topology_spread(
             tk_vocab[k] = len(tk_vocab)
         return tk_vocab[k]
 
-    def sel_id(ns: str, sel: JSON) -> int:
-        key = _canon({"ns": ns, "sel": sel})
+    def sel_id_by_key(key: str, ns: str, sel: JSON) -> int:
         if key not in sel_vocab:
             sel_vocab[key] = len(sel_list)
             sel_list.append((ns, sel))
         return sel_vocab[key]
 
+    from ksim_tpu.state import objcache
+
+    def parsed_cons(pod: JSON) -> list[dict]:
+        """Vocab-independent constraint parse, memoized per pod object
+        (the effective selector and its canonical key are the expensive
+        parts; vocab ids are assigned per call)."""
+
+        def build() -> list[dict]:
+            ns = namespace_of(pod) or "default"
+            out = []
+            for con in pod.get("spec", {}).get("topologySpreadConstraints") or []:
+                sel = _effective_selector(con, pod)
+                out.append(
+                    {
+                        "tk_str": con.get("topologyKey", ""),
+                        "ns": ns,
+                        "sel_obj": sel,
+                        "sel_key": _canon({"ns": ns, "sel": sel}),
+                        "mode": 0 if con.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule" else 1,
+                        "max_skew": int(con.get("maxSkew", 1)),
+                        "min_domains": int(con.get("minDomains") or 0),
+                        "self": match_label_selector(sel, labels_of(pod)),
+                        "honor_aff": (con.get("nodeAffinityPolicy") or "Honor") == "Honor",
+                        "honor_taints": (con.get("nodeTaintsPolicy") or "Ignore") == "Honor",
+                    }
+                )
+            return out
+
+        return objcache.cached("spreadcons", pod, build)
+
     # Pass 1: constraint tables.
     per_pod_cons: list[list[dict]] = []
     for pod in pods:
         cons = []
-        for con in pod.get("spec", {}).get("topologySpreadConstraints") or []:
-            sel = _effective_selector(con, pod)
+        for c in parsed_cons(pod):
             cons.append(
-                {
-                    "tk": tk_id(con.get("topologyKey", "")),
-                    "sel": sel_id(namespace_of(pod) or "default", sel),
-                    "mode": 0 if con.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule" else 1,
-                    "max_skew": int(con.get("maxSkew", 1)),
-                    "min_domains": int(con.get("minDomains") or 0),
-                    "self": match_label_selector(sel, labels_of(pod)),
-                    "honor_aff": (con.get("nodeAffinityPolicy") or "Honor") == "Honor",
-                    "honor_taints": (con.get("nodeTaintsPolicy") or "Ignore") == "Honor",
-                }
+                dict(c, tk=tk_id(c["tk_str"]), sel=sel_id_by_key(c["sel_key"], c["ns"], c["sel_obj"]))
             )
         per_pod_cons.append(cons)
 
@@ -407,22 +510,39 @@ def encode_topology_spread(
         tk_singleton[ki] = all(c <= 1 for c in per_key_cnt[ki].values())
 
     S = _vpad(len(sel_list))
+    S0 = len(sel_list)
+    # Per-pod selector-match rows, memoized on (pod object, selector
+    # vocab) — the vocab stabilizes under churn, so unchanged pods cost
+    # one lookup per pass.
+    sels_token = hash(tuple(sel_vocab))
+
+    def sel_row(pod: JSON) -> np.ndarray:
+        key = ("spreadrow", objcache.ref_id(pod), sels_token)
+        hit = objcache.get(key)
+        if hit is not objcache.MISS:
+            return hit
+        pod_ns = namespace_of(pod) or "default"
+        pod_labels = labels_of(pod)
+        row = np.fromiter(
+            (pod_ns == ns and match_label_selector(sel, pod_labels) for ns, sel in sel_list),
+            dtype=bool,
+            count=S0,
+        )
+        return objcache.put(key, row)
+
     init_counts = np.zeros((n_padded, S), dtype=np.int32)
     node_index = {name_of(n): i for i, n in enumerate(nodes)}
     for bp in bound_pods:
         ni = node_index.get(bp.get("spec", {}).get("nodeName", ""))
         if ni is None:
             continue
-        for si, (ns, sel) in enumerate(sel_list):
-            if (namespace_of(bp) or "default") == ns and match_label_selector(sel, labels_of(bp)):
-                init_counts[ni, si] += 1
+        row = sel_row(bp)
+        if row.any():
+            init_counts[ni, :S0] += row
 
     pod_sel_match = np.zeros((p_padded, S), dtype=bool)
     for j, pod in enumerate(pods):
-        for si, (ns, sel) in enumerate(sel_list):
-            pod_sel_match[j, si] = (namespace_of(pod) or "default") == ns and match_label_selector(
-                sel, labels_of(pod)
-            )
+        pod_sel_match[j, :S0] = sel_row(pod)
 
     MC = max((len(c) for c in per_pod_cons), default=0)
     MC = _vpad(MC, minimum=2)

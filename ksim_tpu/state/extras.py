@@ -47,21 +47,27 @@ def encode_node_name(
 
 def _host_ports(pod: JSON) -> list[tuple[str, str, int]]:
     """The pod's (hostIP, protocol, hostPort) triples, upstream
-    getContainerPorts (hostPort == 0 entries are ignored)."""
-    out = []
-    for c in pod.get("spec", {}).get("containers") or []:
-        for port in c.get("ports") or []:
-            hp = int(port.get("hostPort") or 0)
-            if hp <= 0:
-                continue
-            out.append(
-                (
-                    port.get("hostIP") or BIND_ALL_IP,
-                    port.get("protocol") or DEFAULT_PROTOCOL,
-                    hp,
+    getContainerPorts (hostPort == 0 entries are ignored).  Memoized per
+    pod object."""
+    from ksim_tpu.state import objcache
+
+    def build() -> list[tuple[str, str, int]]:
+        out = []
+        for c in pod.get("spec", {}).get("containers") or []:
+            for port in c.get("ports") or []:
+                hp = int(port.get("hostPort") or 0)
+                if hp <= 0:
+                    continue
+                out.append(
+                    (
+                        port.get("hostIP") or BIND_ALL_IP,
+                        port.get("protocol") or DEFAULT_PROTOCOL,
+                        hp,
+                    )
                 )
-            )
-    return out
+        return out
+
+    return objcache.cached("hostports", pod, build)
 
 
 def ports_conflict(a: tuple[str, str, int], b: tuple[str, str, int]) -> bool:
@@ -171,18 +177,27 @@ def encode_image_locality(
     n_padded: int,
     p_padded: int,
 ) -> ImageTensors:
+    from ksim_tpu.state import objcache
+
+    def pod_images(p: JSON) -> tuple[int, list[str]]:
+        """(container count, normalized image names), memoized per pod."""
+
+        def build() -> tuple[int, list[str]]:
+            containers = p.get("spec", {}).get("containers") or []
+            return (
+                len(containers),
+                [normalized_image_name(c["image"]) for c in containers if c.get("image")],
+            )
+
+        return objcache.cached("podimgs", p, build)
+
     vocab: dict[str, int] = {}
     pod_imgs: list[list[int]] = []
     n_containers = np.zeros(p_padded, dtype=np.int32)
     for j, p in enumerate(pods):
-        containers = p.get("spec", {}).get("containers") or []
-        n_containers[j] = len(containers)
-        imgs = []
-        for c in containers:
-            img = c.get("image") or ""
-            if img:
-                imgs.append(vocab.setdefault(normalized_image_name(img), len(vocab)))
-        pod_imgs.append(imgs)
+        nc, names = pod_images(p)
+        n_containers[j] = nc
+        pod_imgs.append([vocab.setdefault(nm, len(vocab)) for nm in names])
 
     from ksim_tpu.state.featurizer import vocab_pad
 

@@ -173,6 +173,12 @@ class Featurizer:
         ``queue_pods`` are the pods to schedule (the pod axis P);
         ``namespaces`` feed namespaceSelector matching (InterPodAffinity);
         ``pvs``/``pvcs``/``storage_classes`` feed the volume plugins."""
+        from ksim_tpu.state import objcache
+
+        # Safe point for memo-table size enforcement: no memo key is in
+        # flight here (see objcache.maybe_flush).
+        objcache.maybe_flush()
+
         sched_pods = list(queue_pods) if queue_pods else [
             p for p in pods if not pod_is_scheduled(p)
         ]
@@ -217,14 +223,25 @@ class Featurizer:
                 exact = False
             units[r] = unit
 
+        from ksim_tpu.state import objcache
+
+        # The requests dicts are memoized per pod object (pod_requests),
+        # so lowered rows can be memoized on the dict's identity as long
+        # as the unit scaling they were lowered with is part of the key.
+        units_token = hash((resources, tuple(units[r] for r in resources)))
+
         def lower(d: dict[str, int]) -> np.ndarray:
+            key = ("lower", objcache.ref_id(d), units_token)
+            hit = objcache.get(key)
+            if hit is not objcache.MISS:
+                return hit
             row = np.zeros(R, dtype=np.int64)
             for r, v in d.items():
                 i = ridx.get(r)
                 if i is not None:
                     u = units[r]
                     row[i] = v // u if v % u == 0 else -(-v // u)
-            return row
+            return objcache.put(key, row)
 
         N, P = len(nodes), len(sched_pods)
         NP, PP = bucket_size(N, self._node_bucket_min), bucket_size(P, self._pod_bucket_min)

@@ -112,9 +112,12 @@ class _Vocab:
         self.tk_ids: dict[str, int] = {}
 
     def ctx_id(self, ctx: dict) -> int:
-        k = _canon(
-            {"ns": ctx["namespaces"], "nsSel": ctx["ns_sel"], "sel": ctx["sel"]}
+        return self.ctx_id_by_key(
+            _canon({"ns": ctx["namespaces"], "nsSel": ctx["ns_sel"], "sel": ctx["sel"]}),
+            ctx,
         )
+
+    def ctx_id_by_key(self, k: str, ctx: dict) -> int:
         if k not in self.ctx_ids:
             self.ctx_ids[k] = len(self.ctxs)
             self.ctxs.append(ctx)
@@ -138,7 +141,14 @@ def term_context(term: JSON, owner_ns: str) -> dict:
     AffinityTerm): explicit namespaces default to the DEFINING pod's
     namespace iff both namespaces and namespaceSelector are unset; a nil
     labelSelector matches NOTHING (metav1.LabelSelectorAsSelector(nil))
-    while an empty one matches everything."""
+    while an empty one matches everything.  Memoized per term object so
+    the returned dict is identity-stable across featurizations."""
+    from ksim_tpu.state import objcache
+
+    return objcache.cached("ipctx", term, lambda: _term_context(term, owner_ns), owner_ns)
+
+
+def _term_context(term: JSON, owner_ns: str) -> dict:
     namespaces = sorted(term.get("namespaces") or [])
     ns_sel = term.get("namespaceSelector")
     if not namespaces and ns_sel is None:
@@ -165,16 +175,54 @@ def context_matches(ctx: dict, pod: JSON, ns_labels: dict[str, dict]) -> bool:
 
 
 def _pod_terms(pod: JSON) -> dict[str, list]:
-    """Extract the four term families from a pod spec."""
-    aff = (pod.get("spec", {}).get("affinity") or {})
-    pa = aff.get("podAffinity") or {}
-    paa = aff.get("podAntiAffinity") or {}
-    return {
-        "req_aff": list(pa.get("requiredDuringSchedulingIgnoredDuringExecution") or []),
-        "req_anti": list(paa.get("requiredDuringSchedulingIgnoredDuringExecution") or []),
-        "pref_aff": list(pa.get("preferredDuringSchedulingIgnoredDuringExecution") or []),
-        "pref_anti": list(paa.get("preferredDuringSchedulingIgnoredDuringExecution") or []),
-    }
+    """Extract the four term families from a pod spec (memoized)."""
+    from ksim_tpu.state import objcache
+
+    def build() -> dict[str, list]:
+        aff = (pod.get("spec", {}).get("affinity") or {})
+        pa = aff.get("podAffinity") or {}
+        paa = aff.get("podAntiAffinity") or {}
+        return {
+            "req_aff": list(pa.get("requiredDuringSchedulingIgnoredDuringExecution") or []),
+            "req_anti": list(paa.get("requiredDuringSchedulingIgnoredDuringExecution") or []),
+            "pref_aff": list(pa.get("preferredDuringSchedulingIgnoredDuringExecution") or []),
+            "pref_anti": list(paa.get("preferredDuringSchedulingIgnoredDuringExecution") or []),
+        }
+
+    return objcache.cached("ipterms", pod, build)
+
+
+def parsed_terms(pod: JSON) -> dict[str, list[tuple[dict, str, str, int]]]:
+    """family -> [(ctx, canon_key, topologyKey, weight)] — everything
+    about a pod's affinity terms that is independent of the per-call
+    vocab, memoized per pod object so replay passes skip the JSON walk
+    AND the canonical-key dumps."""
+    from ksim_tpu.state import objcache
+
+    def build() -> dict[str, list[tuple[dict, str, str, int]]]:
+        owner_ns = namespace_of(pod) or "default"
+        fams = _pod_terms(pod)
+        out: dict[str, list[tuple[dict, str, str, int]]] = {}
+        for fam in ("req_aff", "req_anti"):
+            items = []
+            for term in fams[fam]:
+                ctx = term_context(term, owner_ns)
+                ck = _canon({"ns": ctx["namespaces"], "nsSel": ctx["ns_sel"], "sel": ctx["sel"]})
+                items.append((ctx, ck, term.get("topologyKey", ""), 1))
+            out[fam] = items
+        for fam in ("pref_aff", "pref_anti"):
+            items = []
+            for wt in fams[fam]:
+                term = wt.get("podAffinityTerm") or {}
+                ctx = objcache.cached(
+                    "ipctx", wt, lambda t=term, ns=owner_ns: _term_context(t, ns), owner_ns
+                )
+                ck = _canon({"ns": ctx["namespaces"], "nsSel": ctx["ns_sel"], "sel": ctx["sel"]})
+                items.append((ctx, ck, term.get("topologyKey", ""), int(wt.get("weight", 0))))
+            out[fam] = items
+        return out
+
+    return objcache.cached("ipparsed", pod, build)
 
 
 def has_any_affinity(pod: JSON) -> bool:
@@ -198,26 +246,14 @@ def encode_inter_pod(
 
     def terms_of(pod: JSON) -> dict[str, list[tuple[int, int, int]]]:
         """family -> [(term_id, ctx_id, weight)]"""
-        owner_ns = namespace_of(pod) or "default"
         out: dict[str, list[tuple[int, int, int]]] = {}
-        fams = _pod_terms(pod)
-        for fam in ("req_aff", "req_anti"):
-            items = []
-            for term in fams[fam]:
-                ctx = term_context(term, owner_ns)
-                u = vocab.ctx_id(ctx)
-                t = vocab.term_id(u, vocab.tk_id(term.get("topologyKey", "")))
-                items.append((t, u, 1))
-            out[fam] = items
-        for fam in ("pref_aff", "pref_anti"):
-            items = []
-            for wt in fams[fam]:
-                term = wt.get("podAffinityTerm") or {}
-                ctx = term_context(term, owner_ns)
-                u = vocab.ctx_id(ctx)
-                t = vocab.term_id(u, vocab.tk_id(term.get("topologyKey", "")))
-                items.append((t, u, int(wt.get("weight", 0))))
-            out[fam] = items
+        for fam, items in parsed_terms(pod).items():
+            mapped = []
+            for ctx, ck, tk, w in items:
+                u = vocab.ctx_id_by_key(ck, ctx)
+                t = vocab.term_id(u, vocab.tk_id(tk))
+                mapped.append((t, u, w))
+            out[fam] = mapped
         return out
 
     queue_terms = [terms_of(p) for p in pods]
@@ -263,13 +299,37 @@ def encode_inter_pod(
     ranti_dom = np.zeros((D1, T), dtype=np.int32)
     ew_dom = np.zeros((D1, T), dtype=np.int32)
     node_index = {name_of(n): i for i, n in enumerate(nodes)}
+
+    # Per-pod context-match rows, memoized on (pod object, final ctx
+    # vocab, namespace labels): churn replay re-encodes thousands of
+    # unchanged bound pods against a vocab that stabilizes after a few
+    # passes, so steady state is one dict lookup per pod.
+    from ksim_tpu.state import objcache
+
+    U0 = len(vocab.ctxs)
+    vocab_token = hash(tuple(vocab.ctx_ids))
+    ns_token = hash(_canon(ns_labels))
+
+    def match_row(pod: JSON) -> np.ndarray:
+        key = ("iprow", objcache.ref_id(pod), vocab_token, ns_token)
+        hit = objcache.get(key)
+        if hit is not objcache.MISS:
+            return hit
+        row = np.fromiter(
+            (context_matches(ctx, pod, ns_labels) for ctx in vocab.ctxs),
+            dtype=bool,
+            count=U0,
+        )
+        return objcache.put(key, row)
+
     for bp, terms in zip(bound_pods, bound_terms):
         ni = node_index.get(bp.get("spec", {}).get("nodeName", ""))
         if ni is None:
             continue
         doms = node_dom[ni]  # [TK]
-        for ui, ctx in enumerate(vocab.ctxs):
-            if context_matches(ctx, bp, ns_labels):
+        row = match_row(bp)
+        if row.any():
+            for ui in np.nonzero(row)[0]:
                 for d in doms:
                     if d >= 0:
                         match_dom[d, ui] += 1
@@ -299,13 +359,13 @@ def encode_inter_pod(
     pod_vw = np.zeros((p_padded, T), dtype=np.int32)
     pod_eat = np.zeros((p_padded, T), dtype=np.int32)
     for j, (pod, terms) in enumerate(zip(pods, queue_terms)):
-        for ui, ctx in enumerate(vocab.ctxs):
-            pod_ctx_match[j, ui] = context_matches(ctx, pod, ns_labels)
+        row = match_row(pod)
+        pod_ctx_match[j, :U0] = row
         self_ok = True
         for t, u, _w in terms["req_aff"]:
             req_aff[j, t] = True
             pod_vw[j, t] += hard_weight
-            self_ok = self_ok and context_matches(vocab.ctxs[u], pod, ns_labels)
+            self_ok = self_ok and bool(row[u])
         self_aff[j] = self_ok and bool(terms["req_aff"])
         for t, _u, _w in terms["req_anti"]:
             req_anti[j, t] = True

@@ -1,0 +1,83 @@
+"""Per-object parse memos for the host featurization path.
+
+Churn replay featurizes the whole cluster every scheduling pass, but most
+objects are unchanged between passes: the cluster store hands out the
+SAME dict object for an unchanged resource (``list(copy_objs=False)``)
+and a brand-new dict on every write (create/update/patch all deepcopy
+before storing, state/cluster.py).  ``id(obj)`` therefore identifies a
+frozen snapshot of an object's content for as long as that object is
+alive — and the memo keeps a strong reference to every key object so its
+id cannot be recycled while an entry exists.
+
+Sub-objects inherit the property: a pod's ``spec.affinity`` term dicts
+are replaced together with the pod, so they are valid memo keys too.
+
+Callers that build JSON by hand (tests, library use) must not mutate an
+object in place after featurizing it — mutate-and-refeaturize would see
+stale parses.  The store path never does this.  ``clear()`` drops
+everything (used by tests and when the table hits its size limit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_MISS = object()
+
+_DATA: dict[Any, Any] = {}
+_REFS: dict[int, Any] = {}
+
+# Entry limit: a 50k-event churn creates ~100k pod objects with a handful
+# of memo slots each; one mid-run clear is cheaper than unbounded growth.
+LIMIT = 1 << 19
+
+
+def ref_id(obj: Any) -> int:
+    """id(obj), pinned: the object stays alive while the memo does."""
+    i = id(obj)
+    if i not in _REFS:
+        _REFS[i] = obj
+    return i
+
+
+def get(key: Any) -> Any:
+    """Lookup; returns the module sentinel ``MISS`` when absent."""
+    return _DATA.get(key, _MISS)
+
+
+MISS = _MISS
+
+
+def put(key: Any, value: Any) -> Any:
+    """Store an entry.  Never clears inline: a clear here would unpin the
+    in-flight key object (its id was taken by the caller before the
+    clear), letting the id be recycled under a surviving entry.  Size
+    enforcement happens at safe points via maybe_flush()."""
+    _DATA[key] = value
+    return value
+
+
+def maybe_flush() -> None:
+    """Clear the table if it exceeds LIMIT.  Called at points where no
+    memo key is in flight (the featurizer's entry) so every surviving
+    entry's key object gets re-pinned by ref_id before reuse."""
+    if len(_DATA) >= LIMIT:
+        clear()
+
+
+def cached(slot: str, obj: Any, fn: Callable[[], Any], *extra: Any) -> Any:
+    """Memoize ``fn()`` under (slot, id(obj), *extra)."""
+    key = (slot, ref_id(obj), *extra)
+    hit = _DATA.get(key, _MISS)
+    if hit is not _MISS:
+        return hit
+    return put(key, fn())
+
+
+def clear() -> None:
+    _DATA.clear()
+    _REFS.clear()
+
+
+def stats() -> dict[str, int]:
+    return {"entries": len(_DATA), "refs": len(_REFS)}

@@ -89,7 +89,8 @@ def _max_into(acc: dict[str, int], other: dict[str, int]) -> None:
 
 
 def pod_requests(pod: JSON, *, non_zero: bool = False) -> dict[str, int]:
-    """Total scheduler-visible resource requests of a pod.
+    """Total scheduler-visible resource requests of a pod (memoized per
+    object — callers must treat the returned dict as frozen).
 
     Mirrors upstream resourcehelper.PodRequests (k8s.io/component-helpers,
     v1.30 with sidecar support): sum of app containers, PLUS restartable
@@ -101,6 +102,16 @@ def pod_requests(pod: JSON, *, non_zero: bool = False) -> dict[str, int]:
     containers missing cpu/memory requests (NonMissingContainerRequests in
     upstream noderesources/resource_allocation.go calculatePodResourceRequest).
     """
+    from ksim_tpu.state import objcache
+
+    key = ("preq", objcache.ref_id(pod), non_zero)
+    hit = objcache.get(key)
+    if hit is not objcache.MISS:
+        return hit
+    return objcache.put(key, _pod_requests(pod, non_zero))
+
+
+def _pod_requests(pod: JSON, non_zero: bool) -> dict[str, int]:
     spec = pod.get("spec", {})
 
     def container_req(c: JSON) -> dict[str, int]:
@@ -131,10 +142,17 @@ def pod_requests(pod: JSON, *, non_zero: bool = False) -> dict[str, int]:
 
 
 def node_allocatable(node: JSON) -> dict[str, int]:
-    """Node allocatable in scheduler units; falls back to capacity."""
-    status = node.get("status", {})
-    alloc = status.get("allocatable") or status.get("capacity") or {}
-    return _resource_list(alloc)
+    """Node allocatable in scheduler units; falls back to capacity.
+    Memoized per node object (returned dict is frozen) so the
+    featurizer's lower() rows can memoize on the dict's identity."""
+    from ksim_tpu.state import objcache
+
+    def build() -> dict[str, int]:
+        status = node.get("status", {})
+        alloc = status.get("allocatable") or status.get("capacity") or {}
+        return _resource_list(alloc)
+
+    return objcache.cached("nodealloc", node, build)
 
 
 def node_unschedulable(node: JSON) -> bool:

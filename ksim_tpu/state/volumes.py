@@ -128,6 +128,9 @@ class VolumeTensors:
     n_pools: int  # K (static info)
 
 
+_EMPTY_ROW = {"pv": (), "wffc": (), "vol": (), "rwop": (), "disk": (), "fail": 0}
+
+
 def _pod_volumes(pod: JSON) -> list[JSON]:
     return pod.get("spec", {}).get("volumes") or []
 
@@ -232,11 +235,16 @@ def encode_volumes(
     pod_rows: list[dict] = []
 
     def classify_pod(pod: JSON, register: bool):
-        """Walk a pod's volumes; returns per-pod row dict (queue pods)."""
+        """Walk a pod's volumes; returns per-pod row dict (queue pods).
+        Pods without volumes (the common churn case) share one frozen
+        empty row — consumers only iterate the rows."""
+        vols = _pod_volumes(pod)
+        if not vols:
+            return _EMPTY_ROW
         ns = namespace_of(pod) or "default"
         row = {"pv": [], "wffc": [], "vol": [], "rwop": [], "disk": []}
         fail = 0
-        for vol in _pod_volumes(pod):
+        for vol in vols:
             claim = _pvc_name(pod, vol)
             if claim is not None:
                 pvc = pvc_by_key.get(f"{ns}/{claim}")
