@@ -173,12 +173,6 @@ def _aux_host(aux: dict) -> tuple[dict, dict]:
     return out, axes
 
 
-def _device_aux(aux: dict) -> tuple[dict, dict]:
-    """_aux_host, transferred (standalone helper for tests/tools)."""
-    host, axes = _aux_host(aux)
-    return jax.tree_util.tree_map(_to_device, host), axes
-
-
 # One jitted unpack program per packing signature (grouped dtypes/shapes
 # are bucketed upstream, so churn replay sees only a handful).
 _UNPACK_CACHE: dict[tuple, Any] = {}

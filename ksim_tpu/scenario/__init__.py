@@ -15,11 +15,19 @@ from ksim_tpu.scenario.runner import (
     StepResult,
 )
 from ksim_tpu.scenario.generate import churn_scenario
+from ksim_tpu.scenario.spec import (
+    ScenarioSpecError,
+    load_scenario,
+    operations_from_spec,
+)
 
 __all__ = [
     "Operation",
     "ScenarioResult",
     "ScenarioRunner",
+    "ScenarioSpecError",
     "StepResult",
     "churn_scenario",
+    "load_scenario",
+    "operations_from_spec",
 ]

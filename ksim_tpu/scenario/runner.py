@@ -27,10 +27,10 @@ class Operation:
     patchOperation / deleteOperation at a step)."""
 
     step: int
-    op: str  # create | update | delete
+    op: str  # create | update | patch | delete | done
     kind: str
-    obj: JSON | None = None  # create/update payload
-    name: str = ""  # delete target
+    obj: JSON | None = None  # create/update payload; merge patch for "patch"
+    name: str = ""  # patch/delete target
     namespace: str = ""
 
 
@@ -52,6 +52,7 @@ class ScenarioResult:
     pods_scheduled: int = 0
     unschedulable_attempts: int = 0
     wall_seconds: float = 0.0
+    succeeded: bool = False  # a doneOperation step completed (KEP-140)
 
     @property
     def events_per_second(self) -> float:
@@ -98,10 +99,36 @@ class ScenarioRunner:
             self.store.create(op.kind, op.obj)
         elif op.op == "update":
             self.store.update(op.kind, op.obj)
+        elif op.op == "patch":
+            # KEP-140 PatchOperation: RFC 7386 merge patch (scenario/spec.py).
+            # Object identity is immutable under patch, like the apiserver:
+            # name/namespace/uid survive whatever the patch does to
+            # metadata (a patch can't rename or unkey an object).
+            from ksim_tpu.scenario.spec import ScenarioSpecError, merge_patch
+
+            def apply_merge(obj: JSON) -> None:
+                merged = merge_patch(obj, op.obj)
+                if not isinstance(merged, dict):
+                    raise ScenarioSpecError(
+                        f"patch for {op.kind}/{op.name} must produce an object"
+                    )
+                orig_md = obj.get("metadata", {})
+                md = merged.get("metadata")
+                md = dict(md) if isinstance(md, dict) else {}
+                for key in ("name", "namespace", "uid", "resourceVersion"):
+                    if orig_md.get(key) is not None:
+                        md[key] = orig_md[key]
+                merged["metadata"] = md
+                obj.clear()
+                obj.update(merged)
+
+            self.store.patch(op.kind, op.name, op.namespace, apply_merge)
         elif op.op == "delete":
             if op.kind == "nodes" and self._requeue:
                 self._requeue_pods_of(op.name)
             self.store.delete(op.kind, op.name, op.namespace)
+        elif op.op == "done":
+            pass  # handled in run(): terminates after this step
         else:
             raise ValueError(f"unknown op {op.op!r}")
 
@@ -127,8 +154,10 @@ class ScenarioRunner:
             by_step.setdefault(op.step, []).append(op)
         for step in sorted(by_step):
             batch = by_step[step]
+            done = False
             for op in batch:
                 self._apply(op)
+                done = done or op.op == "done"
             result.events_applied += len(batch)
             # The runner drives the store directly (no watch loop), so it
             # raises the capacity-freed/topology-changed signal itself:
@@ -154,5 +183,11 @@ class ScenarioRunner:
                     pending_after=self.service.pending_count(),
                 )
             )
+            if done:
+                # KEP-140 DoneOperation: "when finish the step
+                # DoneOperation belongs, this Scenario changes its status
+                # to Succeeded" — later steps are not run.
+                result.succeeded = True
+                break
         result.wall_seconds = time.perf_counter() - t0
         return result

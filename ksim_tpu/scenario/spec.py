@@ -1,0 +1,152 @@
+"""KEP-140 Scenario documents -> runner operations.
+
+The reference designed (but never built) a Scenario CRD whose
+``spec.operations`` drive timed create/patch/delete mutations with a
+``doneOperation`` terminator (reference
+keps/140-scenario-based-simulation/README.md, ScenarioOperation /
+CreateOperation / PatchOperation / DeleteOperation / DoneOperation).
+This module accepts that document shape — as a dict, JSON, or YAML —
+and lowers it to the library ``Operation`` stream:
+
+- ``createOperation.object``  -> Operation(op="create"), kind from the
+  object's ``kind``;
+- ``patchOperation``          -> Operation(op="patch") carrying an
+  RFC 7386 JSON merge patch (the KEP leaves PatchType open; merge patch
+  is the simulator-native choice — strategic merge is an apiserver
+  concept);
+- ``deleteOperation``         -> Operation(op="delete");
+- ``doneOperation``           -> Operation(op="done") — the runner marks
+  the scenario succeeded after finishing that step and ignores later
+  steps.
+
+Exactly one of the four must be set per operation, like the KEP's
+"one of the following four fields must be specified".
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Sequence
+
+from ksim_tpu.scenario.runner import Operation
+from ksim_tpu.state.resources import JSON as JSONObj
+
+# TypeMeta.kind -> store kind (the 7 snapshot kinds).
+KIND_MAP = {
+    "Pod": "pods",
+    "Node": "nodes",
+    "PersistentVolume": "persistentvolumes",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+    "StorageClass": "storageclasses",
+    "PriorityClass": "priorityclasses",
+    "Namespace": "namespaces",
+}
+
+
+class ScenarioSpecError(ValueError):
+    """Invalid Scenario document (the KEP's 'the scenario will fail')."""
+
+
+def _store_kind(type_kind: str, op_id: str) -> str:
+    kind = KIND_MAP.get(type_kind)
+    if kind is None:
+        raise ScenarioSpecError(
+            f"operation {op_id!r}: unsupported kind {type_kind!r} "
+            f"(supported: {sorted(KIND_MAP)})"
+        )
+    return kind
+
+
+def merge_patch(target: JSONObj, patch: Any) -> Any:
+    """RFC 7386 JSON merge patch: dicts merge recursively, null deletes,
+    everything else replaces."""
+    if not isinstance(patch, dict):
+        return patch
+    out = dict(target) if isinstance(target, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = merge_patch(out.get(k, {}), v)
+    return out
+
+
+def operations_from_spec(doc: JSONObj) -> list[Operation]:
+    """Lower a Scenario document (or bare ``{"operations": [...]}``) to
+    the runner's Operation list, sorted by step (stable within a step,
+    like the KEP's per-MajorStep batches)."""
+    spec = doc.get("spec", doc)
+    raw_ops = spec.get("operations")
+    if raw_ops is None:
+        raise ScenarioSpecError("document has no spec.operations")
+    out: list[Operation] = []
+    for i, rop in enumerate(raw_ops):
+        op_id = str(rop.get("id") or i)
+        step = int(rop.get("step", 0))
+        bodies = {
+            k: rop[k]
+            for k in ("createOperation", "patchOperation", "deleteOperation", "doneOperation")
+            if rop.get(k) is not None
+        }
+        if len(bodies) != 1:
+            raise ScenarioSpecError(
+                f"operation {op_id!r}: exactly one of createOperation/"
+                f"patchOperation/deleteOperation/doneOperation must be set "
+                f"(got {sorted(bodies) or 'none'})"
+            )
+        key, body = next(iter(bodies.items()))
+        if key == "createOperation":
+            obj = body.get("object")
+            if not isinstance(obj, dict) or not obj.get("kind"):
+                raise ScenarioSpecError(
+                    f"operation {op_id!r}: createOperation.object needs a kind"
+                )
+            out.append(
+                Operation(step=step, op="create", kind=_store_kind(obj["kind"], op_id), obj=obj)
+            )
+        elif key == "patchOperation":
+            kind = _store_kind((body.get("typeMeta") or {}).get("kind", ""), op_id)
+            meta = body.get("objectMeta") or {}
+            patch = body.get("patch")
+            if isinstance(patch, (str, bytes)):
+                patch = json.loads(patch)
+            out.append(
+                Operation(
+                    step=step,
+                    op="patch",
+                    kind=kind,
+                    obj=patch,
+                    name=meta.get("name", ""),
+                    namespace=meta.get("namespace", ""),
+                )
+            )
+        elif key == "deleteOperation":
+            kind = _store_kind((body.get("typeMeta") or {}).get("kind", ""), op_id)
+            meta = body.get("objectMeta") or {}
+            out.append(
+                Operation(
+                    step=step,
+                    op="delete",
+                    kind=kind,
+                    name=meta.get("name", ""),
+                    namespace=meta.get("namespace", ""),
+                )
+            )
+        else:  # doneOperation
+            out.append(Operation(step=step, op="done", kind=""))
+    out.sort(key=lambda o: o.step)
+    return out
+
+
+def load_scenario(text_or_doc: "str | bytes | JSONObj") -> list[Operation]:
+    """Parse a Scenario document from YAML/JSON text (or an already-parsed
+    dict) into runner operations."""
+    if isinstance(text_or_doc, (str, bytes)):
+        import yaml
+
+        doc = yaml.safe_load(text_or_doc)
+    else:
+        doc = text_or_doc
+    if not isinstance(doc, dict):
+        raise ScenarioSpecError("scenario document must be a mapping")
+    return operations_from_spec(doc)

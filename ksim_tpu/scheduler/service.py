@@ -180,7 +180,19 @@ class SchedulerService:
     # -- scheduler configuration (reference scheduler.go Service) -----------
 
     def get_scheduler_config(self) -> JSON:
-        return copy.deepcopy(self._config)
+        """Current KubeSchedulerConfiguration as a typed document.  When
+        nothing was ever applied this returns the scheme-defaulted shape
+        (kind/apiVersion + the default profile), like the reference's
+        DefaultSchedulerConfig (scheduler/config/config.go:19-26) feeding
+        the GET handler (handler/schedulerconfig.go:26-40)."""
+        cfg = copy.deepcopy(self._config)
+        cfg.setdefault("apiVersion", "kubescheduler.config.k8s.io/v1")
+        cfg.setdefault("kind", "KubeSchedulerConfiguration")
+        cfg.setdefault(
+            "profiles",
+            [{"schedulerName": name} for name in sorted(self._profiles)],
+        )
+        return cfg
 
     def apply_scheduler_config(self, cfg: JSON) -> None:
         """Compile-and-swap — the reference's RestartScheduler with

@@ -195,7 +195,7 @@ def encode_affinity(
     Q = _vpad(len(vocab.req_list))
     T = _vpad(len(vocab.term_list))
     Q0 = len(vocab.req_list)
-    reqs_token = hash(tuple(vocab.reqs))
+    reqs_token = tuple(vocab.reqs)
 
     def node_row(node: JSON) -> np.ndarray:
         key = ("affnode", objcache.ref_id(node), reqs_token)
@@ -317,7 +317,7 @@ def encode_taints(
         prefer[w] = t["effect"] == "PreferNoSchedule"
 
     W0 = len(taints)
-    taints_token = hash(tuple(vocab))
+    taints_token = tuple(vocab)
 
     def tol_rows(pod: JSON) -> tuple[np.ndarray, np.ndarray]:
         """(tolerated, tolerated_prefer) rows over the taint vocab,
@@ -514,7 +514,7 @@ def encode_topology_spread(
     # Per-pod selector-match rows, memoized on (pod object, selector
     # vocab) — the vocab stabilizes under churn, so unchanged pods cost
     # one lookup per pass.
-    sels_token = hash(tuple(sel_vocab))
+    sels_token = tuple(sel_vocab)
 
     def sel_row(pod: JSON) -> np.ndarray:
         key = ("spreadrow", objcache.ref_id(pod), sels_token)

@@ -223,12 +223,10 @@ class Featurizer:
                 exact = False
             units[r] = unit
 
-        from ksim_tpu.state import objcache
-
         # The requests dicts are memoized per pod object (pod_requests),
         # so lowered rows can be memoized on the dict's identity as long
         # as the unit scaling they were lowered with is part of the key.
-        units_token = hash((resources, tuple(units[r] for r in resources)))
+        units_token = (resources, tuple(units[r] for r in resources))
 
         def lower(d: dict[str, int]) -> np.ndarray:
             key = ("lower", objcache.ref_id(d), units_token)

@@ -307,8 +307,8 @@ def encode_inter_pod(
     from ksim_tpu.state import objcache
 
     U0 = len(vocab.ctxs)
-    vocab_token = hash(tuple(vocab.ctx_ids))
-    ns_token = hash(_canon(ns_labels))
+    vocab_token = tuple(vocab.ctx_ids)
+    ns_token = _canon(ns_labels)
 
     def match_row(pod: JSON) -> np.ndarray:
         key = ("iprow", objcache.ref_id(pod), vocab_token, ns_token)

@@ -22,6 +22,7 @@ Semantics preserved from the reference:
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
 from dataclasses import dataclass, field
@@ -156,6 +157,12 @@ class Syncer:
     # -- one object ---------------------------------------------------------
 
     def _prepare(self, kind: str, obj: JSON, event: str) -> JSON | None:
+        # Watch events share the SOURCE store's frozen dicts
+        # (cluster.py _notify); user filtering/mutating functions are
+        # allowed to mutate what they receive, so give them a private
+        # deep copy — corrupting the source store would also poison its
+        # per-object featurization memos (state/objcache.py).
+        obj = copy.deepcopy(obj)
         for fn in self._filtering.get(kind, ()):
             if not fn(obj, self._dest, event):
                 return None

@@ -1,0 +1,65 @@
+"""Per-object memo contracts: store writes invalidate by object identity,
+events share frozen objects without being corrupted by later writes."""
+
+import numpy as np
+
+from ksim_tpu.state import objcache
+from ksim_tpu.state.cluster import ClusterStore
+from ksim_tpu.state.featurizer import Featurizer
+from ksim_tpu.state.resources import pod_requests
+from tests.helpers import make_node, make_pod
+
+
+def test_store_write_yields_fresh_object_and_fresh_parse():
+    store = ClusterStore()
+    store.create("pods", make_pod("p1", cpu="1"))
+    before = store.list("pods", copy_objs=False)[0]
+    req1 = pod_requests(before)
+    assert req1["cpu"] == 1000
+    assert pod_requests(before) is req1  # memo hit on the same object
+
+    def bump(obj):
+        obj["spec"]["containers"][0]["resources"]["requests"]["cpu"] = "2"
+
+    store.patch("pods", "p1", "default", bump)
+    after = store.list("pods", copy_objs=False)[0]
+    assert after is not before  # writes replace, never mutate
+    assert pod_requests(after)["cpu"] == 2000
+    assert pod_requests(before)["cpu"] == 1000  # old object's parse intact
+
+
+def test_delete_event_does_not_mutate_shared_object():
+    store = ClusterStore()
+    store.create("nodes", make_node("n1"))
+    stored = store.list("nodes", copy_objs=False)[0]
+    rv_before = stored["metadata"]["resourceVersion"]
+    stream = store.watch(("nodes",))
+    store.delete("nodes", "n1")
+    # The DELETED event carries a bumped rv on a re-wrapped object; the
+    # previously shared dict keeps its original rv (frozen contract).
+    assert stored["metadata"]["resourceVersion"] == rv_before
+    ev = stream.next(timeout=1)
+    stream.close()
+    assert ev is not None and ev.event_type == "DELETED"
+    assert ev.obj["metadata"]["resourceVersion"] != rv_before
+
+
+def test_featurize_consistent_across_memo_flush():
+    nodes = [make_node(f"n{i}", cpu="4") for i in range(4)]
+    pods = [make_pod(f"p{i}", cpu="1") for i in range(6)]
+    f = Featurizer()
+    a = f.featurize(nodes, pods)
+    objcache.clear()
+    b = f.featurize(nodes, pods)
+    np.testing.assert_array_equal(a.nodes.allocatable, b.nodes.allocatable)
+    np.testing.assert_array_equal(a.pods.requests, b.pods.requests)
+
+
+def test_maybe_flush_respects_limit(monkeypatch):
+    objcache.clear()
+    monkeypatch.setattr(objcache, "LIMIT", 4)
+    for i in range(6):
+        objcache.put(("slot", i), i)
+    assert objcache.stats()["entries"] == 6  # put never clears inline
+    objcache.maybe_flush()
+    assert objcache.stats()["entries"] == 0

@@ -96,19 +96,31 @@ def test_service_config_apply_and_rollback():
     store = ClusterStore()
     store.create("nodes", make_node("n1"))
     svc = SchedulerService(store)
-    assert svc.get_scheduler_config() == {}
+    # Nothing applied: GET returns the scheme-defaulted document
+    # (reference DefaultSchedulerConfig, scheduler/config/config.go:19-26).
+    default_doc = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [{"schedulerName": "default-scheduler"}],
+    }
+    assert svc.get_scheduler_config() == default_doc
     good = {"profiles": [{"plugins": {"multiPoint": {
         "disabled": [{"name": "InterPodAffinity"}]}}}]}
+    good_doc = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1",
+        "kind": "KubeSchedulerConfiguration",
+        **good,
+    }
     svc.apply_scheduler_config(good)
-    assert svc.get_scheduler_config() == good
+    assert svc.get_scheduler_config() == good_doc
     bad = {"profiles": [{"plugins": {"score": {
         "enabled": [{"name": "Bogus"}]}}}]}
     with pytest.raises(ValueError):
         svc.apply_scheduler_config(bad)
     # Rollback: previous config still active.
-    assert svc.get_scheduler_config() == good
+    assert svc.get_scheduler_config() == good_doc
     svc.reset_scheduler_config()
-    assert svc.get_scheduler_config() == {}
+    assert svc.get_scheduler_config() == default_doc
 
 
 def test_service_schedules_by_profile_name():

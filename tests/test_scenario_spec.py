@@ -1,0 +1,106 @@
+"""KEP-140 Scenario document loading + patch/done replay semantics."""
+
+import pytest
+
+from ksim_tpu.scenario import (
+    ScenarioRunner,
+    ScenarioSpecError,
+    load_scenario,
+    operations_from_spec,
+)
+from ksim_tpu.scenario.spec import merge_patch
+from tests.helpers import make_node, make_pod
+
+
+def scenario_doc():
+    return {
+        "apiVersion": "simulation.sigs.x-k8s.io/v1alpha1",
+        "kind": "Scenario",
+        "metadata": {"name": "s1"},
+        "spec": {
+            "operations": [
+                {
+                    "id": "create-node",
+                    "step": 0,
+                    "createOperation": {"object": {"kind": "Node", **make_node("n1", cpu="8")}},
+                },
+                {
+                    "id": "create-pod",
+                    "step": 1,
+                    "createOperation": {"object": {"kind": "Pod", **make_pod("p1", cpu="1")}},
+                },
+                {
+                    "id": "label-node",
+                    "step": 2,
+                    "patchOperation": {
+                        "typeMeta": {"kind": "Node"},
+                        "objectMeta": {"name": "n1"},
+                        "patch": '{"metadata": {"labels": {"zone": "a"}}}',
+                    },
+                },
+                {"id": "finish", "step": 3, "doneOperation": {}},
+                {
+                    "id": "never-runs",
+                    "step": 4,
+                    "deleteOperation": {
+                        "typeMeta": {"kind": "Node"},
+                        "objectMeta": {"name": "n1"},
+                    },
+                },
+            ]
+        },
+    }
+
+
+def test_operations_from_spec_shapes():
+    ops = operations_from_spec(scenario_doc())
+    assert [o.op for o in ops] == ["create", "create", "patch", "done", "delete"]
+    assert ops[0].kind == "nodes" and ops[1].kind == "pods"
+    assert ops[2].name == "n1" and ops[2].obj == {"metadata": {"labels": {"zone": "a"}}}
+
+
+def test_yaml_round_trip():
+    import yaml
+
+    ops = load_scenario(yaml.safe_dump(scenario_doc()))
+    assert len(ops) == 5
+
+
+def test_replay_applies_patch_and_stops_at_done():
+    runner = ScenarioRunner()
+    res = runner.run(operations_from_spec(scenario_doc()))
+    assert res.succeeded
+    # done at step 3 halts before the delete at step 4.
+    assert [s.step for s in res.steps] == [0, 1, 2, 3]
+    node = runner.store.get("nodes", "n1")
+    assert node["metadata"]["labels"]["zone"] == "a"
+    assert res.pods_scheduled == 1
+    pod = runner.store.list("pods")[0]
+    assert pod["spec"]["nodeName"] == "n1"
+
+
+def test_invalid_operations_rejected():
+    with pytest.raises(ScenarioSpecError):
+        operations_from_spec({"spec": {"operations": [{"id": "x", "step": 0}]}})
+    with pytest.raises(ScenarioSpecError):
+        operations_from_spec(
+            {"spec": {"operations": [
+                {"step": 0, "createOperation": {"object": {"kind": "Pod"}},
+                 "doneOperation": {}},
+            ]}}
+        )
+    with pytest.raises(ScenarioSpecError):
+        operations_from_spec(
+            {"spec": {"operations": [
+                {"step": 0, "createOperation": {"object": {"kind": "Gadget",
+                                                           "metadata": {"name": "g"}}}},
+            ]}}
+        )
+    with pytest.raises(ScenarioSpecError):
+        operations_from_spec({})
+
+
+def test_merge_patch_rfc7386():
+    target = {"a": {"b": 1, "c": 2}, "d": [1, 2]}
+    patch = {"a": {"b": None, "e": 3}, "d": [9]}
+    assert merge_patch(target, patch) == {"a": {"c": 2, "e": 3}, "d": [9]}

@@ -92,7 +92,10 @@ def test_full_cycle_over_http(server):
     status, out = _req(server, "GET", "/api/v1/export")
     assert out["nodes"] == [] and out["pods"] == []
     _, got = _req(server, "GET", "/api/v1/schedulerconfiguration")
-    assert got == {}
+    # Reset returns the scheme-defaulted document (reference
+    # DefaultSchedulerConfig, scheduler/config/config.go:19-26).
+    assert got["profiles"] == [{"schedulerName": "default-scheduler"}]
+    assert got["kind"] == "KubeSchedulerConfiguration"
 
 
 def test_extender_routes_present(server):
