@@ -151,3 +151,17 @@ def data_provider_builder(name: str, provider: DataProvider, *, weight: int = 1)
         )
 
     return build
+
+
+# Ready-made config-plugin import target: enable NodeNumber purely from a
+# KubeSchedulerConfiguration (no code changes to the scheduler binary),
+# the reference's wasm-plugin capability (scheduler/config/wasm.go:14-58):
+#
+#   pluginConfig:
+#     - name: NodeNumber
+#       args:
+#         builderImport: "ksim_tpu.plugins.samples.nodenumber:NODE_NUMBER_PLUGIN"
+NODE_NUMBER_PLUGIN = {
+    "builder": node_number_builder(),
+    "extra_encoders": {"nodenumber": encode_node_number},
+}

@@ -75,7 +75,7 @@ def operations_from_spec(doc: JSONObj) -> list[Operation]:
     """Lower a Scenario document (or bare ``{"operations": [...]}``) to
     the runner's Operation list, sorted by step (stable within a step,
     like the KEP's per-MajorStep batches)."""
-    spec = doc.get("spec", doc)
+    spec = doc.get("spec") or doc
     raw_ops = spec.get("operations")
     if raw_ops is None:
         raise ScenarioSpecError("document has no spec.operations")
@@ -83,10 +83,12 @@ def operations_from_spec(doc: JSONObj) -> list[Operation]:
     for i, rop in enumerate(raw_ops):
         op_id = str(rop.get("id") or i)
         step = int(rop.get("step", 0))
+        # Key-present counts as set even with a null body: doneOperation
+        # is naturally empty ("doneOperation:" in YAML parses to None).
         bodies = {
-            k: rop[k]
+            k: rop[k] or {}
             for k in ("createOperation", "patchOperation", "deleteOperation", "doneOperation")
-            if rop.get(k) is not None
+            if k in rop
         }
         if len(bodies) != 1:
             raise ScenarioSpecError(

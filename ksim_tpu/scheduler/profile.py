@@ -171,6 +171,76 @@ def _build_volume(cls_name):
     return build
 
 
+def load_plugin_import(spec: str) -> tuple[Builder, dict]:
+    """Resolve a ``pkg.module:attr`` plugin import — the TPU-native form
+    of the reference's wasm-plugin loading, where out-of-tree plugins are
+    registered purely from configuration (reference
+    simulator/scheduler/config/wasm.go:14-58: a pluginConfig arg
+    ``guestURL`` names a wasm guest; here ``builderImport`` names an
+    importable Builder).
+
+    The attribute may be a Builder ``(feats, args) -> ScoredPlugin``, or
+    a dict/object exposing ``builder`` and optionally ``extra_encoders``
+    (aux key -> featurizer extra encoder) for plugins that ship their own
+    tensors."""
+    import importlib
+
+    mod, sep, attr = spec.partition(":")
+    if not sep or not mod or not attr:
+        raise ValueError(
+            f"plugin import {spec!r} must look like 'pkg.module:attr'"
+        )
+    try:
+        target = getattr(importlib.import_module(mod), attr)
+    except (ImportError, AttributeError) as e:
+        raise ValueError(f"cannot load plugin import {spec!r}: {e}") from e
+    if isinstance(target, dict):
+        builder = target.get("builder")
+        encoders = target.get("extra_encoders") or {}
+    else:
+        builder = getattr(target, "builder", target)
+        encoders = getattr(target, "extra_encoders", None) or {}
+    if not callable(builder):
+        raise ValueError(
+            f"plugin import {spec!r} does not provide a callable builder"
+        )
+    return builder, dict(encoders)
+
+
+def _load_config_plugins(
+    profile_cfg: dict, registry: dict[str, Builder], allow_imports: bool
+) -> tuple[dict[str, Builder], dict]:
+    """Scan a profile's pluginConfig for ``builderImport`` args and
+    register the loaded Builders (before plugin-set merging, like the
+    reference registers wasm plugins before config conversion —
+    pkg/debuggablescheduler/debuggable_scheduler.go:46-88).  Explicitly
+    passed registry entries win over config-loaded ones.
+
+    ``allow_imports`` gates the capability: importing a module executes
+    arbitrary code, so only operator-owned configs (boot config, CLI)
+    may use it — a config arriving over the debug HTTP API may not,
+    unless the operator opted in (service allow_plugin_imports /
+    KSIM_ALLOW_PLUGIN_IMPORTS=1).  The reference's wasm guests are
+    sandboxed; a Python import is not."""
+    encoders: dict = {}
+    for pc in profile_cfg.get("pluginConfig") or []:
+        name = pc.get("name")
+        spec = (pc.get("args") or {}).get("builderImport")
+        if not name or not spec:
+            continue
+        if not allow_imports:
+            raise ValueError(
+                f"pluginConfig {name!r} uses builderImport, which this "
+                "config source is not trusted for (enable with "
+                "allow_plugin_imports / KSIM_ALLOW_PLUGIN_IMPORTS=1)"
+            )
+        builder, enc = load_plugin_import(spec)
+        if name not in registry:
+            registry[name] = builder
+        encoders.update(enc)
+    return registry, encoders
+
+
 INTREE_BUILDERS: dict[str, Builder] = {
     "NodeUnschedulable": _build_node_unschedulable,
     "NodeName": _build_node_name,
@@ -207,9 +277,15 @@ class CompiledProfile:
     prebind_disabled: frozenset[str] = frozenset()
     # Plugins added only through a per-point set: name -> points enabled.
     point_only: dict[str, frozenset[str]] = field(default_factory=dict)
+    # Featurizer extra encoders shipped by config-loaded plugins
+    # (load_plugin_import).
+    extra_encoders: dict = field(default_factory=dict)
 
     def featurizer(self) -> Featurizer:
-        return Featurizer(interpod_hard_weight=self.hard_pod_affinity_weight)
+        return Featurizer(
+            interpod_hard_weight=self.hard_pod_affinity_weight,
+            extra_encoders=self.extra_encoders,
+        )
 
     def plugins(self, feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
         """The Engine plugin tuple — the jit-compiled unit.  Rebuilding
@@ -278,12 +354,17 @@ def compile_profile(
     profile_cfg: dict | None = None,
     *,
     registry: dict[str, Builder] | None = None,
+    allow_plugin_imports: bool = False,
 ) -> CompiledProfile:
     """One KubeSchedulerProfile dict -> CompiledProfile.  Raises ValueError
     on unknown enabled plugins (reference registry behavior) unless they
     are upstream defaults without kernels (recorded in ``skipped``)."""
     profile_cfg = profile_cfg or {}
-    registry = registry or {}
+    # Config-declared out-of-tree plugins register first (the reference's
+    # RegisterWasmPlugins-before-conversion ordering).
+    registry, loaded_encoders = _load_config_plugins(
+        profile_cfg, dict(registry or {}), allow_plugin_imports
+    )
     plugins_cfg = profile_cfg.get("plugins") or {}
     merged = _merge_plugin_set(DEFAULT_MULTIPOINT, plugins_cfg.get("multiPoint"))
 
@@ -366,6 +447,7 @@ def compile_profile(
         reserve_disabled=frozenset(reserve_off),
         prebind_disabled=frozenset(prebind_off),
         point_only={k: frozenset(v) for k, v in point_only.items()},
+        extra_encoders=loaded_encoders,
     )
 
 
@@ -373,9 +455,15 @@ def compile_configuration(
     cfg: dict | None,
     *,
     registry: dict[str, Builder] | None = None,
+    allow_plugin_imports: bool = False,
 ) -> list[CompiledProfile]:
     """KubeSchedulerConfiguration dict -> compiled profiles (defaulting to
     one default-scheduler profile, reference scheduler.go:143-150)."""
     cfg = cfg or {}
     profiles = cfg.get("profiles") or [{}]
-    return [compile_profile(p, registry=registry) for p in profiles]
+    return [
+        compile_profile(
+            p, registry=registry, allow_plugin_imports=allow_plugin_imports
+        )
+        for p in profiles
+    ]

@@ -26,6 +26,7 @@ the scheduling queue's backoff, not event-driven retry).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Callable, Sequence
 
@@ -82,8 +83,16 @@ class SchedulerService:
         preemption: bool = True,
         max_pods_per_pass: int | None = None,
         config_path: str | None = None,
+        allow_plugin_imports: bool | None = None,
     ) -> None:
         self._store = store
+        # builderImport in runtime-applied configs (HTTP / snapshot load)
+        # executes arbitrary imports; off unless the operator opts in.
+        if allow_plugin_imports is None:
+            allow_plugin_imports = (
+                os.environ.get("KSIM_ALLOW_PLUGIN_IMPORTS") == "1"
+            )
+        self._allow_plugin_imports = allow_plugin_imports
         # Deferred below: the boot-time apply must NOT rewrite the user's
         # file (the reference only rewrites on update calls).
         self._config_path = None
@@ -104,7 +113,10 @@ class SchedulerService:
         from ksim_tpu.state.priorities import build_priority_resolver
 
         self._priority_of = build_priority_resolver(())
-        self.apply_scheduler_config(copy.deepcopy(self._initial_config))
+        # The constructor config is operator-owned (code/CLI), so plugin
+        # imports are trusted here, like the reference's boot-time wasm
+        # registration from the mounted scheduler.yaml.
+        self.apply_scheduler_config(copy.deepcopy(self._initial_config), trusted=True)
         self._config_path = config_path
         self._own_rvs: set[str] = set()
         self._own_rvs_lock = threading.Lock()
@@ -188,19 +200,25 @@ class SchedulerService:
         cfg = copy.deepcopy(self._config)
         cfg.setdefault("apiVersion", "kubescheduler.config.k8s.io/v1")
         cfg.setdefault("kind", "KubeSchedulerConfiguration")
-        cfg.setdefault(
-            "profiles",
-            [{"schedulerName": name} for name in sorted(self._profiles)],
-        )
+        if not cfg.get("profiles"):
+            # Mirror compile_configuration's falsy test: an explicit empty
+            # list also compiles to the default profile, so report it.
+            cfg["profiles"] = [
+                {"schedulerName": name} for name in sorted(self._profiles)
+            ]
         return cfg
 
-    def apply_scheduler_config(self, cfg: JSON) -> None:
+    def apply_scheduler_config(self, cfg: JSON, *, trusted: bool = False) -> None:
         """Compile-and-swap — the reference's RestartScheduler with
         rollback (scheduler.go:90-111): a config that fails to compile
         leaves the previous profiles in place and raises."""
         from ksim_tpu.scheduler.extender import ExtenderService
 
-        profiles = compile_configuration(cfg, registry=self._registry)
+        profiles = compile_configuration(
+            cfg,
+            registry=self._registry,
+            allow_plugin_imports=trusted or self._allow_plugin_imports,
+        )
         extenders = ExtenderService((cfg or {}).get("extenders"))
         self._profiles = {p.scheduler_name: p for p in profiles}
         self._extenders = extenders
@@ -230,7 +248,7 @@ class SchedulerService:
 
     def reset_scheduler_config(self) -> None:
         """Back to the boot-time config (reference di.go initial cfg)."""
-        self.apply_scheduler_config(copy.deepcopy(self._initial_config))
+        self.apply_scheduler_config(copy.deepcopy(self._initial_config), trusted=True)
 
     @property
     def _scheduler_names(self) -> tuple[str, ...]:

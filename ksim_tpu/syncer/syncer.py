@@ -151,6 +151,11 @@ class Syncer:
             self._filtering.setdefault(kind, []).append(fn)
         for kind, fn in options.additional_filtering.items():
             self._filtering.setdefault(kind, []).append(fn)
+        # Kinds with USER extension functions get a private deep copy per
+        # event in _prepare (see there).
+        self._user_touched: dict[str, bool] = {}
+        for kind in (*options.additional_mutating, *options.additional_filtering):
+            self._user_touched[kind] = True
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -158,11 +163,14 @@ class Syncer:
 
     def _prepare(self, kind: str, obj: JSON, event: str) -> JSON | None:
         # Watch events share the SOURCE store's frozen dicts
-        # (cluster.py _notify); user filtering/mutating functions are
+        # (cluster.py _notify); USER filtering/mutating functions are
         # allowed to mutate what they receive, so give them a private
         # deep copy — corrupting the source store would also poison its
-        # per-object featurization memos (state/objcache.py).
-        obj = copy.deepcopy(obj)
+        # per-object featurization memos (state/objcache.py).  The
+        # mandatory built-in fns are copy-on-write, so no copy is needed
+        # when no user extension is registered for this kind.
+        if self._user_touched.get(kind):
+            obj = copy.deepcopy(obj)
         for fn in self._filtering.get(kind, ()):
             if not fn(obj, self._dest, event):
                 return None
