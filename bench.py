@@ -136,7 +136,12 @@ def run_churn(seed: int, n_nodes: int = 2_000, n_events: int = 50_000) -> dict:
 
     jax.config.update("jax_enable_x64", False)
     try:
-        runner = ScenarioRunner()
+        # Cap the per-pass pod batch and coarsen the pod bucket: the
+        # pending pool under saturation otherwise wanders through every
+        # power-of-two bucket up to 16384, and each new shape is another
+        # multi-second XLA compile (upstream schedules one pod per cycle;
+        # capping a batch just leaves the rest queued).
+        runner = ScenarioRunner(max_pods_per_pass=1024, pod_bucket_min=128)
         res = runner.run(
             churn_scenario(seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100)
         )
@@ -169,6 +174,11 @@ def main() -> None:
 
     import jax
 
+    from ksim_tpu.util import enable_compilation_cache
+
+    # One-time-per-machine XLA compiles (the large-shape scan programs
+    # cost 5-60s each to build; the bench is otherwise compile-dominated).
+    enable_compilation_cache()
     # Exact mode for the headline: int64/float64 scoring paths active.
     jax.config.update("jax_enable_x64", True)
 

@@ -23,6 +23,9 @@ logger = logging.getLogger(__name__)
 
 
 def run_scheduler(argv: list[str] | None = None) -> int:
+    from ksim_tpu.util import enable_compilation_cache
+
+    enable_compilation_cache()
     ap = argparse.ArgumentParser(prog="ksim-scheduler")
     ap.add_argument("--snapshot", required=True, help="reference-format snapshot JSON")
     ap.add_argument("--config", default=None, help="KubeSchedulerConfiguration yaml")

@@ -22,12 +22,22 @@ def start_simulator(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="ksim-simulator")
     ap.add_argument("--config", default=None, help="SimulatorConfiguration yaml")
     ap.add_argument("--port", type=int, default=None, help="override the port")
+    ap.add_argument("--host", default=None, help="bind address (0.0.0.0 for containers)")
+    ap.add_argument(
+        "--profile-dir",
+        default=None,
+        help="write a jax.profiler trace (TensorBoard format) of the "
+        "scheduling passes to this directory",
+    )
     args = ap.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
     )
 
+    from ksim_tpu.util import enable_compilation_cache
+
+    enable_compilation_cache()
     from ksim_tpu.config import load_config
     from ksim_tpu.oneshotimporter import OneShotImporter
     from ksim_tpu.server import DIContainer, SimulatorServer
@@ -38,6 +48,8 @@ def start_simulator(argv: list[str] | None = None) -> int:
     cfg = load_config(args.config)
     if args.port is not None:
         cfg.port = args.port
+    if args.host is not None:
+        cfg.host = args.host
 
     di = DIContainer(
         scheduler_config=cfg.initial_scheduler_cfg,
@@ -57,9 +69,12 @@ def start_simulator(argv: list[str] | None = None) -> int:
         else:
             syncer = Syncer(source, di.store).run()
 
+    if args.profile_dir:
+        di.scheduler_service.start_profiling(args.profile_dir)
     di.scheduler_service.start()
     server = SimulatorServer(
         di,
+        host=cfg.host,
         port=cfg.port,
         cors_allowed_origins=cfg.cors_allowed_origin_list,
     ).start()
@@ -77,6 +92,7 @@ def start_simulator(argv: list[str] | None = None) -> int:
         stop.wait()
     finally:
         server.shutdown_server()
+        di.scheduler_service.stop_profiling()
         if syncer is not None:
             syncer.stop()
         di.shutdown()

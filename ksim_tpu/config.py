@@ -26,6 +26,7 @@ DEFAULT_PORT = 1212
 
 @dataclass
 class SimulatorConfig:
+    host: str = "127.0.0.1"  # bind address; 0.0.0.0 for containers
     port: int = DEFAULT_PORT
     cors_allowed_origin_list: tuple[str, ...] = ()
     kube_scheduler_config_path: str = ""
@@ -64,6 +65,8 @@ def load_config(path: str | None = None) -> SimulatorConfig:
         port = DEFAULT_PORT if port_raw in (None, "") else int(port_raw)
     except (TypeError, ValueError):
         raise InvalidConfigError(f"invalid PORT {port_raw!r}") from None
+    # Namespaced env var: plain HOST is ambient in csh/CI images.
+    host = os.environ.get("KSIM_HOST") or raw.get("host") or "127.0.0.1"
     cors_env = os.environ.get("CORS_ALLOWED_ORIGIN_LIST", "")
     cors = (
         tuple(x for x in cors_env.split(",") if x)
@@ -98,6 +101,7 @@ def load_config(path: str | None = None) -> SimulatorConfig:
             sched_cfg = yaml.safe_load(f) or {}
 
     return SimulatorConfig(
+        host=host,
         port=port,
         cors_allowed_origin_list=cors,
         kube_scheduler_config_path=sched_path,

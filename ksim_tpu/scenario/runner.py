@@ -78,6 +78,7 @@ class ScenarioRunner:
         record: str = "selection",
         requeue_on_node_delete: bool = True,
         max_pods_per_pass: int | None = None,
+        pod_bucket_min: int | None = None,
     ) -> None:
         self.store = store if store is not None else ClusterStore()
         self.service = (
@@ -88,6 +89,7 @@ class ScenarioRunner:
                 record=record,
                 preemption=False,
                 max_pods_per_pass=max_pods_per_pass,
+                pod_bucket_min=pod_bucket_min,
             )
         )
         self._requeue = requeue_on_node_delete

@@ -281,10 +281,11 @@ class CompiledProfile:
     # (load_plugin_import).
     extra_encoders: dict = field(default_factory=dict)
 
-    def featurizer(self) -> Featurizer:
+    def featurizer(self, *, pod_bucket_min: int | None = None) -> Featurizer:
         return Featurizer(
             interpod_hard_weight=self.hard_pod_affinity_weight,
             extra_encoders=self.extra_encoders,
+            pod_bucket_min=pod_bucket_min,
         )
 
     def plugins(self, feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:

@@ -82,6 +82,7 @@ class SchedulerService:
         featurizer: Featurizer | None = None,
         preemption: bool = True,
         max_pods_per_pass: int | None = None,
+        pod_bucket_min: int | None = None,
         config_path: str | None = None,
         allow_plugin_imports: bool | None = None,
     ) -> None:
@@ -104,6 +105,9 @@ class SchedulerService:
         # under churn saturation — excess pods are simply deeper in the
         # queue, exactly as upstream's one-at-a-time loop would leave them.
         self._max_pods_per_pass = max_pods_per_pass
+        # Coarser pod buckets bound the number of distinct compiled scan
+        # shapes (each new padded shape is an XLA compile).
+        self._pod_bucket_min = pod_bucket_min
         # Direct-factory mode (library use) bypasses profile compilation.
         self._plugins_factory = plugins_factory
         self._featurizer_override = featurizer
@@ -113,6 +117,11 @@ class SchedulerService:
         from ksim_tpu.state.priorities import build_priority_resolver
 
         self._priority_of = build_priority_resolver(())
+        # Featurizers persist per profile across passes: they carry the
+        # incremental bound-pod aggregates (state/boundagg.py) keyed to
+        # an evolving cluster; a config change drops them (re-compile =
+        # the reference's scheduler restart).
+        self._featurizers: dict[str, Featurizer] = {}
         # The constructor config is operator-owned (code/CLI), so plugin
         # imports are trusted here, like the reference's boot-time wasm
         # registration from the mounted scheduler.yaml.
@@ -221,6 +230,9 @@ class SchedulerService:
         )
         extenders = ExtenderService((cfg or {}).get("extenders"))
         self._profiles = {p.scheduler_name: p for p in profiles}
+        # New kernel set -> fresh featurizers (drops incremental state).
+        if getattr(self, "_featurizers", None):
+            self._featurizers.clear()
         self._extenders = extenders
         self._config = copy.deepcopy(cfg) or {}
         # Persist the applied config like the reference rewrites the
@@ -293,11 +305,39 @@ class SchedulerService:
 
     # -- one scheduling pass ------------------------------------------------
 
+    def start_profiling(self, log_dir: str) -> None:
+        """Start a jax.profiler trace (TensorBoard/XPlane format) with a
+        StepTraceAnnotation per scheduling pass — kernel-level device
+        timing, the TPU-native layer on top of the metrics counters (the
+        reference's observability is the upstream scheduler's Prometheus
+        metrics + klog, SURVEY.md section 5)."""
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+        self._profiling = True
+
+    def stop_profiling(self) -> None:
+        if getattr(self, "_profiling", False):
+            import jax
+
+            jax.profiler.stop_trace()
+            self._profiling = False
+
     def schedule_pending(self) -> dict[str, str | None]:
         """Schedule every pending pod once (per profile group); returns
         namespace/name -> node name (None = unschedulable this pass).
         Results are recorded on the pods' annotations either way (the
         reference records every attempt; history accumulates)."""
+        if getattr(self, "_profiling", False):
+            import jax
+
+            with jax.profiler.StepTraceAnnotation(
+                "scheduling-pass", step_num=self._pass_count
+            ):
+                return self._schedule_pending_inner()
+        return self._schedule_pending_inner()
+
+    def _schedule_pending_inner(self) -> dict[str, str | None]:
         nodes = self._store.list("nodes", copy_objs=False)
         namespaces = self._store.list("namespaces", copy_objs=False)
         volume_kw = dict(
@@ -331,12 +371,21 @@ class SchedulerService:
             queue.sort(key=lambda p: queue_sort_key(p, self._priority_of))
             if self._max_pods_per_pass is not None:
                 queue = queue[: self._max_pods_per_pass]
+            featurizer = self._featurizer_override
+            if featurizer is None:
+                featurizer = self._featurizers.get(sched_name)
             if self._plugins_factory is not None:
-                featurizer = self._featurizer_override or Featurizer()
+                if featurizer is None:
+                    featurizer = self._featurizers[sched_name] = Featurizer(
+                        pod_bucket_min=self._pod_bucket_min
+                    )
                 factory: PluginsFactory = self._plugins_factory
             else:
                 prof = self._profiles[sched_name]
-                featurizer = self._featurizer_override or prof.featurizer()
+                if featurizer is None:
+                    featurizer = self._featurizers[sched_name] = prof.featurizer(
+                        pod_bucket_min=self._pod_bucket_min
+                    )
                 factory = prof.plugins
             if self._extenders:
                 # Webhook extenders need per-pod HTTP round-trips between

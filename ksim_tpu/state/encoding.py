@@ -427,14 +427,38 @@ def encode_topology_spread(
     bound_pods: Sequence[JSON],
     n_padded: int,
     p_padded: int,
+    *,
+    agg: dict | None = None,
+    bound_map: "dict[int, JSON] | None" = None,
+    changed_slots: "set[int] | None" = None,
+    slot_of: "dict[str, int] | None" = None,
 ) -> SpreadTensors:
+    """``agg``/``bound_map``/``changed_slots``/``slot_of`` come from a
+    persistent Featurizer (state/boundagg.py): the selector vocabulary
+    then persists append-only across calls and the per-node
+    selector-match counts over BOUND pods update by delta.  Without
+    ``agg`` every call is a one-shot rebuild (same code path, throwaway
+    state)."""
     from ksim_tpu.state.resources import namespace_of
     from ksim_tpu.state.selectors import match_label_selector
 
+    agg = agg if agg is not None else {}
+    if bound_map is None:
+        bound_map = {id(p): p for p in bound_pods}
+    changed_slots = changed_slots if changed_slots is not None else set()
+
     tk_vocab: dict[str, int] = {}
     dom_vocab: dict[tuple[int, str], int] = {}
-    sel_vocab: dict[str, int] = {}
-    sel_list: list[tuple[str, JSON]] = []  # (namespace, selector)
+    sels = agg.setdefault("spread_sels", {"vocab": {}, "list": []})
+    if len(sels["list"]) > 4096:
+        # Reset valve (same pattern as the interpod vocabularies): an
+        # adversarial stream of distinct selectors must not grow the
+        # vocabulary — and the (N x S) count arrays — without bound.
+        agg.pop("spread_sels", None)
+        agg.pop("spread_init", None)
+        sels = agg.setdefault("spread_sels", {"vocab": {}, "list": []})
+    sel_vocab: dict[str, int] = sels["vocab"]
+    sel_list: list[tuple[str, JSON]] = sels["list"]  # (namespace, selector)
 
     def tk_id(k: str) -> int:
         if k not in tk_vocab:
@@ -530,15 +554,36 @@ def encode_topology_spread(
         )
         return objcache.put(key, row)
 
-    init_counts = np.zeros((n_padded, S), dtype=np.int32)
-    node_index = {name_of(n): i for i, n in enumerate(nodes)}
-    for bp in bound_pods:
+    from ksim_tpu.state.boundagg import sync_family
+
+    node_index = slot_of if slot_of is not None else {
+        name_of(n): i for i, n in enumerate(nodes)
+    }
+    N0 = len(nodes)
+
+    def _init_record(bp: JSON):
         ni = node_index.get(bp.get("spec", {}).get("nodeName", ""))
-        if ni is None:
-            continue
-        row = sel_row(bp)
-        if row.any():
-            init_counts[ni, :S0] += row
+        if ni is None or ni >= N0:
+            return None
+        return (ni, sel_row(bp))
+
+    def _init_apply(arr, rec, sign: int) -> None:
+        ni, row = rec
+        if sign > 0:
+            arr[ni, : row.shape[0]] += row
+        else:
+            arr[ni, : row.shape[0]] -= row
+
+    init_counts = sync_family(
+        agg,
+        "spread_init",
+        (sels_token, S, S0, n_padded),
+        bound_map,
+        changed_slots,
+        make_arrays=lambda: np.zeros((n_padded, S), dtype=np.int32),
+        record_of=_init_record,
+        apply=_init_apply,
+    ).copy()
 
     pod_sel_match = np.zeros((p_padded, S), dtype=bool)
     for j, pod in enumerate(pods):

@@ -140,8 +140,8 @@ class Featurizer:
     def __init__(
         self,
         *,
-        node_bucket_min: int = 8,
-        pod_bucket_min: int = 8,
+        node_bucket_min: int | None = None,
+        pod_bucket_min: int | None = None,
         interpod_hard_weight: int | None = None,
         extra_encoders: "dict[str, Any] | None" = None,
     ) -> None:
@@ -153,10 +153,20 @@ class Featurizer:
             from ksim_tpu.state.interpod import DEFAULT_HARD_POD_AFFINITY_WEIGHT
 
             interpod_hard_weight = DEFAULT_HARD_POD_AFFINITY_WEIGHT
-        self._node_bucket_min = node_bucket_min
-        self._pod_bucket_min = pod_bucket_min
+        self._node_bucket_min = node_bucket_min if node_bucket_min else 8
+        self._pod_bucket_min = pod_bucket_min if pod_bucket_min else 8
         self._interpod_hard_weight = interpod_hard_weight
         self._extra_encoders = dict(extra_encoders or {})
+        # Incremental bound-pod aggregation across featurizations of the
+        # SAME evolving cluster (state/boundagg.py): node-name slots keep
+        # the node axis stable under churn, and the additive aggregates
+        # update by delta instead of re-walking every bound pod.  A fresh
+        # instance behaves exactly like the one-shot path (slot order =
+        # first-seen order = the caller's order).
+        from ksim_tpu.state.boundagg import NodeSlots
+
+        self._slots = NodeSlots()
+        self._agg: dict[str, Any] = {}
 
     def featurize(
         self,
@@ -179,6 +189,8 @@ class Featurizer:
         # flight here (see objcache.maybe_flush).
         objcache.maybe_flush()
 
+        from ksim_tpu.state.boundagg import sync_family
+
         sched_pods = list(queue_pods) if queue_pods else [
             p for p in pods if not pod_is_scheduled(p)
         ]
@@ -189,16 +201,54 @@ class Featurizer:
             and (p.get("status", {}).get("phase") not in ("Succeeded", "Failed"))
         ]
 
+        # Stable node slots: churn must not shift the node axis under the
+        # incremental aggregates.  For a fresh featurizer this is the
+        # caller's order.
+        nodes, changed_slots = self._slots.sync(nodes)
+        bound_map = {id(p): p for p in bound_pods}
+
         node_alloc = [node_allocatable(n) for n in nodes]
         pod_reqs = [pod_requests(p) for p in sched_pods]
         pod_nz_reqs = [pod_requests(p, non_zero=True) for p in sched_pods]
-        bound_reqs = [pod_requests(p) for p in bound_pods]
-        bound_nz_reqs = [pod_requests(p, non_zero=True) for p in bound_pods]
+
+        # Bound pods' raw request values as an incrementally-maintained
+        # multiset per resource: the resource axis and exact gcd units
+        # need every value that enters math, without an O(bound) walk.
+        def _resvals_record(p: JSON):
+            pairs = []
+            for non_zero in (False, True):
+                for r, v in pod_requests(p, non_zero=non_zero).items():
+                    if v:
+                        pairs.append((r, v))
+            return (-1, tuple(pairs))
+
+        def _resvals_apply(counters: dict, rec, sign: int) -> None:
+            for r, v in rec[1]:
+                c = counters.setdefault(r, {})
+                nv = c.get(v, 0) + sign
+                if nv:
+                    c[v] = nv
+                else:
+                    del c[v]
+                    if not c:
+                        del counters[r]
+
+        bound_vals: dict[str, dict[int, int]] = sync_family(
+            self._agg,
+            "resvals",
+            (),
+            bound_map,
+            set(),  # node-independent
+            make_arrays=dict,
+            record_of=_resvals_record,
+            apply=_resvals_apply,
+        )
 
         # Resource axis: base prefix + extended resources seen anywhere.
         seen: set[str] = set()
-        for d in (*node_alloc, *pod_reqs, *bound_reqs):
+        for d in (*node_alloc, *pod_reqs):
             seen.update(d.keys())
+        seen.update(bound_vals.keys())
         seen.discard(PODS)
         extended = sorted(seen - set(BASE_RESOURCES))
         resources = BASE_RESOURCES + tuple(extended)
@@ -213,8 +263,9 @@ class Featurizer:
         # Exact gcd units per resource across every value that enters math.
         units: dict[str, int] = {}
         for r in resources:
-            vals = [d.get(r, 0) for d in (*node_alloc, *pod_reqs, *pod_nz_reqs, *bound_reqs, *bound_nz_reqs)]
+            vals = [d.get(r, 0) for d in (*node_alloc, *pod_reqs, *pod_nz_reqs)]
             vals = [v for v in vals if v]
+            vals.extend(bound_vals.get(r, ()))
             unit = _gcd_unit(vals)
             max_scaled = max((v // unit for v in vals), default=0)
             if max_scaled > MAX_EXACT_SCALED:
@@ -246,15 +297,10 @@ class Featurizer:
 
         alloc = np.zeros((NP, R), dtype=np.int32)
         allowed_pods = np.zeros(NP, dtype=np.int32)
-        # Accumulate in int64: per-value bounds don't bound the SUM over
-        # bound pods; clamp (and drop exactness) only if the sum overflows.
-        requested = np.zeros((NP, R), dtype=np.int64)
-        nz_requested = np.zeros((NP, R), dtype=np.int64)
-        pod_count = np.zeros(NP, dtype=np.int32)
         unsched = np.zeros(NP, dtype=bool)
         nvalid = np.zeros(NP, dtype=bool)
         node_names = [name_of(n) for n in nodes]
-        node_index = {nm: i for i, nm in enumerate(node_names)}
+        node_index = self._slots.slot_of
 
         for i, n in enumerate(nodes):
             alloc[i] = lower(node_alloc[i])
@@ -262,13 +308,47 @@ class Featurizer:
             unsched[i] = node_unschedulable(n)
             nvalid[i] = True
 
-        for p, req, nz in zip(bound_pods, bound_reqs, bound_nz_reqs):
-            i = node_index.get(pod_node_name(p))
-            if i is None:
-                continue
-            requested[i] += lower(req)
-            nz_requested[i] += lower(nz)
-            pod_count[i] += 1
+        # Per-node request sums from bound pods, maintained by delta.
+        # Masters accumulate in int64: per-value bounds don't bound the
+        # SUM over bound pods; clamp (and drop exactness) on the copies
+        # only if a sum overflows.
+        def _req_record(p: JSON):
+            ni = node_index.get(pod_node_name(p))
+            if ni is None or ni >= N:
+                return None
+            return (
+                ni,
+                (lower(pod_requests(p)), lower(pod_requests(p, non_zero=True))),
+            )
+
+        def _req_apply(arrays, rec, sign: int) -> None:
+            ni, (row, nzrow) = rec
+            if sign > 0:
+                arrays["req"][ni] += row
+                arrays["nz"][ni] += nzrow
+                arrays["cnt"][ni] += 1
+            else:
+                arrays["req"][ni] -= row
+                arrays["nz"][ni] -= nzrow
+                arrays["cnt"][ni] -= 1
+
+        reqagg = sync_family(
+            self._agg,
+            "requested",
+            (units_token, NP),
+            bound_map,
+            changed_slots,
+            make_arrays=lambda: {
+                "req": np.zeros((NP, R), dtype=np.int64),
+                "nz": np.zeros((NP, R), dtype=np.int64),
+                "cnt": np.zeros(NP, dtype=np.int32),
+            },
+            record_of=_req_record,
+            apply=_req_apply,
+        )
+        requested = reqagg["req"].copy()
+        nz_requested = reqagg["nz"].copy()
+        pod_count = reqagg["cnt"].copy()
 
         if requested.max(initial=0) > MAX_EXACT_SCALED or nz_requested.max(initial=0) > MAX_EXACT_SCALED:
             exact = False
@@ -313,10 +393,16 @@ class Featurizer:
         aux = {
             "affinity": encode_affinity(nodes, sched_pods, NP, PP),
             "taints": encode_taints(nodes, sched_pods, NP, PP),
-            "spread": encode_topology_spread(nodes, sched_pods, bound_pods, NP, PP),
+            "spread": encode_topology_spread(
+                nodes, sched_pods, bound_pods, NP, PP,
+                agg=self._agg, bound_map=bound_map,
+                changed_slots=changed_slots, slot_of=node_index,
+            ),
             "interpod": encode_inter_pod(
                 nodes, sched_pods, bound_pods, namespaces, NP, PP,
                 hard_weight=self._interpod_hard_weight,
+                agg=self._agg, bound_map=bound_map,
+                changed_slots=changed_slots, slot_of=node_index,
             ),
             "nodename": encode_node_name(nodes, sched_pods, PP),
             "nodeports": encode_node_ports(nodes, sched_pods, bound_pods, NP, PP),

@@ -240,8 +240,40 @@ def encode_inter_pod(
     p_padded: int,
     *,
     hard_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT,
+    agg: dict | None = None,
+    bound_map: "dict[int, JSON] | None" = None,
+    changed_slots: "set[int] | None" = None,
+    slot_of: "dict[str, int] | None" = None,
 ) -> InterPodTensors:
-    vocab = _Vocab()
+    """With ``agg`` (a persistent Featurizer's state, state/boundagg.py)
+    the context/term/domain vocabularies persist append-only across
+    calls — ids stay stable — and the existing-pod domain aggregates
+    (match counts, required-anti counts, signed score weights) update by
+    delta over the bound population.  The match aggregate rebuilds when
+    the context vocabulary or namespace labels change (a new context can
+    match pods that did not themselves change); the term aggregates only
+    depend on each pod's own terms, so they survive vocabulary growth.
+    Without ``agg``, one-shot rebuild with throwaway state (identical
+    results)."""
+    from ksim_tpu.state.boundagg import sync_family
+    from ksim_tpu.state.featurizer import vocab_pad
+
+    agg = agg if agg is not None else {}
+    if bound_map is None:
+        bound_map = {id(p): p for p in bound_pods}
+    changed_slots = changed_slots if changed_slots is not None else set()
+
+    # Persistent vocabularies, with a reset valve: adversarial streams
+    # could grow them without bound (every reset is just one full
+    # rebuild).
+    vocab: _Vocab = agg.setdefault("ip_vocab", _Vocab())
+    dom_vocab: dict[tuple[int, str], int] = agg.setdefault("ip_doms", {})
+    if len(vocab.ctxs) > 4096 or len(vocab.terms) > 4096 or len(dom_vocab) > (1 << 17):
+        for k in ("ip_vocab", "ip_doms", "ip_seen", "ip_match", "ip_terms"):
+            agg.pop(k, None)
+        vocab = agg.setdefault("ip_vocab", _Vocab())
+        dom_vocab = agg.setdefault("ip_doms", {})
+
     ns_labels = {name_of(ns): dict(labels_of(ns)) for ns in namespaces}
 
     def terms_of(pod: JSON) -> dict[str, list[tuple[int, int, int]]]:
@@ -256,10 +288,19 @@ def encode_inter_pod(
             out[fam] = mapped
         return out
 
+    # Registration pre-pass: every CURRENT pod's contexts/terms must be
+    # in the vocab before any vocab-derived token or array is built.
+    # Queue pods register every call (cheap, the queue is bounded);
+    # bound pods register once (persistent ``ip_seen``).
     queue_terms = [terms_of(p) for p in pods]
-    bound_terms = [terms_of(p) for p in bound_pods]
-
-    from ksim_tpu.state.featurizer import vocab_pad
+    seen: set[int] = agg.setdefault("ip_seen", set())
+    # In-place: ``seen &= dict.keys()`` would REBIND the local to a new
+    # set and orphan the persisted one.
+    seen.intersection_update(bound_map.keys())
+    for pid, p in bound_map.items():
+        if pid not in seen:
+            terms_of(p)
+            seen.add(pid)
 
     # Padded terms are inert: term_u/term_tk 0 with all-zero pod columns.
     U = vocab_pad(len(vocab.ctxs))
@@ -272,8 +313,8 @@ def encode_inter_pod(
         term_u[ti] = u
         term_tk[ti] = tk
 
-    # Topology domains from node labels.
-    dom_vocab: dict[tuple[int, str], int] = {}
+    # Topology domains from node labels (domain ids persist append-only,
+    # so bound-pod contribution records stay valid across passes).
     node_dom = np.full((n_padded, TK), -1, dtype=np.int32)
     for ni, node in enumerate(nodes):
         lbls = labels_of(node)
@@ -285,25 +326,19 @@ def encode_inter_pod(
                 node_dom[ni, ki] = dom_vocab[dk]
 
     n_domains = max(len(dom_vocab), 1)
-    D1 = n_domains + 1  # +1 write-only junk row
-    dom_tk = np.full(D1, -1, dtype=np.int32)
+    D = vocab_pad(n_domains + 1)  # +1 keeps a write-only junk row
+    dom_tk = np.full(D, -1, dtype=np.int32)
     for (ki, _val), d in dom_vocab.items():
         dom_tk[d] = ki
 
-    # Existing-pod state (the carry init), accumulated in domain space: a
-    # bound pod on node ni contributes to ni's domain for EVERY topology
-    # key (match counts) / for its term's topology key (term counts); a
-    # node missing the key contributes nowhere (no topologyPair exists —
-    # upstream filtering.go only counts nodes that carry the key).
-    match_dom = np.zeros((D1, U), dtype=np.int32)
-    ranti_dom = np.zeros((D1, T), dtype=np.int32)
-    ew_dom = np.zeros((D1, T), dtype=np.int32)
-    node_index = {name_of(n): i for i, n in enumerate(nodes)}
+    node_index = slot_of if slot_of is not None else {
+        name_of(n): i for i, n in enumerate(nodes)
+    }
+    N0 = len(nodes)
 
     # Per-pod context-match rows, memoized on (pod object, final ctx
-    # vocab, namespace labels): churn replay re-encodes thousands of
-    # unchanged bound pods against a vocab that stabilizes after a few
-    # passes, so steady state is one dict lookup per pod.
+    # vocab, namespace labels): with a persistent vocab the token is
+    # stable, so steady state is one dict lookup per pod.
     from ksim_tpu.state import objcache
 
     U0 = len(vocab.ctxs)
@@ -322,33 +357,84 @@ def encode_inter_pod(
         )
         return objcache.put(key, row)
 
-    for bp, terms in zip(bound_pods, bound_terms):
+    # Existing-pod state (the carry init), accumulated in domain space: a
+    # bound pod on node ni contributes to ni's domain for EVERY topology
+    # key (match counts) / for its term's topology key (term counts); a
+    # node missing the key contributes nowhere (no topologyPair exists —
+    # upstream filtering.go only counts nodes that carry the key).
+
+    def _match_record(bp: JSON):
         ni = node_index.get(bp.get("spec", {}).get("nodeName", ""))
-        if ni is None:
-            continue
-        doms = node_dom[ni]  # [TK]
+        if ni is None or ni >= N0:
+            return None
+        doms = [int(d) for d in node_dom[ni] if d >= 0]
         row = match_row(bp)
-        if row.any():
-            for ui in np.nonzero(row)[0]:
-                for d in doms:
-                    if d >= 0:
-                        match_dom[d, ui] += 1
+        uis = [int(ui) for ui in np.nonzero(row)[0]]
+        if not doms or not uis:
+            return (ni, ())
+        return (ni, tuple((d, ui) for ui in uis for d in doms))
+
+    def _match_apply(arr, rec, sign: int) -> None:
+        for d, ui in rec[1]:
+            arr[d, ui] += sign
+
+    match_dom = sync_family(
+        agg,
+        "ip_match",
+        (D, U, U0, len(vocab.tk_ids), ns_token, n_padded),
+        bound_map,
+        changed_slots,
+        make_arrays=lambda: np.zeros((D, U), dtype=np.int32),
+        record_of=_match_record,
+        apply=_match_apply,
+    )
+
+    def _terms_record(bp: JSON):
+        ni = node_index.get(bp.get("spec", {}).get("nodeName", ""))
+        if ni is None or ni >= N0:
+            return None
+        terms = terms_of(bp)
+        doms = node_dom[ni]
+        entries = []  # (d, t, ranti_delta, ew_delta)
         for t, _u, _w in terms["req_anti"]:
             d = doms[term_tk[t]]
             if d >= 0:
-                ranti_dom[d, t] += 1
+                entries.append((int(d), t, 1, 0))
         for t, _u, _w in terms["req_aff"]:
             d = doms[term_tk[t]]
             if d >= 0:
-                ew_dom[d, t] += hard_weight
+                entries.append((int(d), t, 0, hard_weight))
         for t, _u, w in terms["pref_aff"]:
             d = doms[term_tk[t]]
             if d >= 0:
-                ew_dom[d, t] += w
+                entries.append((int(d), t, 0, w))
         for t, _u, w in terms["pref_anti"]:
             d = doms[term_tk[t]]
             if d >= 0:
-                ew_dom[d, t] -= w
+                entries.append((int(d), t, 0, -w))
+        return (ni, tuple(entries))
+
+    def _terms_apply(arrays, rec, sign: int) -> None:
+        ranti, ew = arrays
+        for d, t, dr, dw in rec[1]:
+            if dr:
+                ranti[d, t] += sign * dr
+            if dw:
+                ew[d, t] += sign * dw
+
+    ranti_dom, ew_dom = sync_family(
+        agg,
+        "ip_terms",
+        (D, T, hard_weight, n_padded),
+        bound_map,
+        changed_slots,
+        make_arrays=lambda: (
+            np.zeros((D, T), dtype=np.int32),
+            np.zeros((D, T), dtype=np.int32),
+        ),
+        record_of=_terms_record,
+        apply=_terms_apply,
+    )
 
     # Queue-pod tables.
     pod_ctx_match = np.zeros((p_padded, U), dtype=bool)

@@ -12,10 +12,23 @@ id cannot be recycled while an entry exists.
 Sub-objects inherit the property: a pod's ``spec.affinity`` term dicts
 are replaced together with the pod, so they are valid memo keys too.
 
+Eviction is generational, not clear-all: entries touched recently
+survive, entries untouched for a few generations are swept and their key
+objects unpinned (a clear-all would force a cold re-parse of the whole
+working set at once).  Note that with the incremental bound-pod
+aggregation (state/boundagg.py) an unchanged bound pod's parse entries
+may legitimately go untouched for many passes — its contribution lives
+in the aggregate's records instead — so a sweep can evict entries for
+still-live pods; the cost surfaces only as a one-pass cold re-parse on
+the next full rebuild (vocabulary growth or unit rescale), which is the
+same cost the rebuild itself already carries.  By convention ``key[1]``
+is the pinned object's id (see ``ref_id``), which is how the sweep knows
+which pins survive.
+
 Callers that build JSON by hand (tests, library use) must not mutate an
 object in place after featurizing it — mutate-and-refeaturize would see
 stale parses.  The store path never does this.  ``clear()`` drops
-everything (used by tests and when the table hits its size limit).
+everything.
 """
 
 from __future__ import annotations
@@ -24,12 +37,21 @@ from typing import Any, Callable
 
 _MISS = object()
 
-_DATA: dict[Any, Any] = {}
+# key -> [value, last_access_generation]; key[1] is the pinned id.
+_DATA: dict[Any, list] = {}
 _REFS: dict[int, Any] = {}
+_GEN = 0
 
-# Entry limit: a 50k-event churn creates ~100k pod objects with a handful
-# of memo slots each; one mid-run clear is cheaper than unbounded growth.
+# Sweep trigger: ~10 slots per live pod means 512k entries ≈ 50k live
+# objects — far above any benchmarked cluster, so sweeps are rare.  The
+# working limit doubles whenever a sweep can't reclaim half the table
+# (see maybe_flush); LIMIT is the starting point.
 LIMIT = 1 << 19
+_limit: "int | None" = None  # set past LIMIT when sweeps can't reclaim
+# Entries untouched for this many generations are considered dead.  Live
+# objects are touched every featurization; 4 covers multi-profile setups
+# where alternating profiles featurize disjoint queues.
+STALE_GENERATIONS = 4
 
 
 def ref_id(obj: Any) -> int:
@@ -42,42 +64,68 @@ def ref_id(obj: Any) -> int:
 
 def get(key: Any) -> Any:
     """Lookup; returns the module sentinel ``MISS`` when absent."""
-    return _DATA.get(key, _MISS)
+    entry = _DATA.get(key)
+    if entry is None:
+        return _MISS
+    entry[1] = _GEN
+    return entry[0]
 
 
 MISS = _MISS
 
 
 def put(key: Any, value: Any) -> Any:
-    """Store an entry.  Never clears inline: a clear here would unpin the
-    in-flight key object (its id was taken by the caller before the
-    clear), letting the id be recycled under a surviving entry.  Size
+    """Store an entry.  Never evicts inline: an eviction here could unpin
+    the in-flight key object (its id was taken by the caller before the
+    sweep), letting the id be recycled under a surviving entry.  Size
     enforcement happens at safe points via maybe_flush()."""
-    _DATA[key] = value
+    _DATA[key] = [value, _GEN]
     return value
 
 
 def maybe_flush() -> None:
-    """Clear the table if it exceeds LIMIT.  Called at points where no
-    memo key is in flight (the featurizer's entry) so every surviving
-    entry's key object gets re-pinned by ref_id before reuse."""
-    if len(_DATA) >= LIMIT:
-        clear()
+    """Advance the generation; sweep stale entries when over the limit.
+
+    Called at points where no memo key is in flight (the featurizer's
+    entry), so surviving entries' key objects stay pinned and swept ids
+    are only unpinned when no entry references them.
+
+    If a sweep frees little (the working set is genuinely that large),
+    the limit doubles so the O(table) sweep scan stays amortized instead
+    of running — and evicting nothing — on every subsequent pass."""
+    global _GEN, _limit
+    _GEN += 1
+    limit = _limit if _limit is not None else LIMIT
+    if len(_DATA) < limit:
+        return
+    floor = _GEN - STALE_GENERATIONS
+    for key in [k for k, e in _DATA.items() if e[1] < floor]:
+        del _DATA[key]
+    live_ids = {k[1] for k in _DATA}
+    for i in [i for i in _REFS if i not in live_ids]:
+        del _REFS[i]
+    if len(_DATA) > limit // 2:
+        _limit = limit * 2
+    elif _limit is not None and len(_DATA) < LIMIT // 2:
+        _limit = None  # working set shrank back; restore the baseline
 
 
 def cached(slot: str, obj: Any, fn: Callable[[], Any], *extra: Any) -> Any:
     """Memoize ``fn()`` under (slot, id(obj), *extra)."""
     key = (slot, ref_id(obj), *extra)
-    hit = _DATA.get(key, _MISS)
+    hit = get(key)
     if hit is not _MISS:
         return hit
     return put(key, fn())
 
 
 def clear() -> None:
+    global _GEN, _limit
     _DATA.clear()
     _REFS.clear()
+    _GEN = 0
+    _limit = None
 
 
 def stats() -> dict[str, int]:
-    return {"entries": len(_DATA), "refs": len(_REFS)}
+    return {"entries": len(_DATA), "refs": len(_REFS), "generation": _GEN}

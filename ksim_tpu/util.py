@@ -2,11 +2,39 @@
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, TypeVar
 
 T = TypeVar("T")
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> None:
+    """Turn on JAX's persistent (on-disk) compilation cache.
+
+    XLA compiles of the scheduling scan at large shapes cost seconds to
+    tens of seconds each; the disk cache makes them one-time per machine
+    instead of per process (measured: a 5k-event churn replay drops
+    46s -> 18s on its second cold-process run).  Called by the product
+    entrypoints (simulator/scheduler CLIs, bench) — NOT on library
+    import, so embedding applications keep control of jax.config.
+
+    ``KSIM_COMPILE_CACHE`` overrides the location; set it to ``off`` to
+    disable."""
+    env = os.environ.get("KSIM_COMPILE_CACHE")
+    if env == "off":
+        return
+    cache_dir = env or cache_dir or os.path.expanduser("~/.cache/ksim_tpu/jax")
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        # Read-only HOME (containers): run without the persistent cache.
+        return
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 def retry_with_exponential_backoff(

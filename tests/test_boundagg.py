@@ -1,0 +1,173 @@
+"""Incremental bound-pod aggregation (state/boundagg.py): a persistent
+Featurizer replaying cluster mutations must be engine-equivalent to a
+fresh featurization of the same snapshot.
+
+The persistent path orders nodes by stable slot (first-seen, swap-remove)
+while a fresh featurizer uses the caller's order, so outputs are compared
+per NODE NAME.  ``selected`` is excluded: selection breaks score ties by
+node index, which legitimately differs between orderings."""
+
+from __future__ import annotations
+
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from ksim_tpu.engine import Engine
+from ksim_tpu.engine.profiles import default_plugins
+from ksim_tpu.state.boundagg import NodeSlots
+from ksim_tpu.state.featurizer import Featurizer
+from tests.helpers import make_node, make_pod
+
+
+def test_node_slots_swap_remove():
+    slots = NodeSlots()
+    a, b, c = make_node("a"), make_node("b"), make_node("c")
+    ordered, changed = slots.sync([a, b, c])
+    assert [n["metadata"]["name"] for n in ordered] == ["a", "b", "c"]
+    assert changed == {0, 1, 2}
+    # Deleting "a" moves "c" (last) into slot 0.
+    ordered, changed = slots.sync([b, c])
+    assert [n["metadata"]["name"] for n in ordered] == ["c", "b"]
+    assert 0 in changed and 2 in changed  # slot 0 re-occupied, slot 2 gone
+    # Same set, same objects: nothing changes.
+    ordered, changed = slots.sync([c, b])
+    assert [n["metadata"]["name"] for n in ordered] == ["c", "b"]
+    assert changed == set()
+    # Replacing an object (same name) flags its slot.
+    b2 = copy.deepcopy(b)
+    ordered, changed = slots.sync([b2, c])
+    assert changed == {1}
+
+
+def _rand_pod(rng: random.Random, seq: int) -> dict:
+    pod = make_pod(
+        f"p{seq}",
+        cpu=f"{rng.choice([100, 250, 500])}m",
+        memory=f"{rng.choice([128, 256])}Mi",
+    )
+    labels = {"app": rng.choice(["web", "db", "cache"])}
+    pod["metadata"]["labels"] = labels
+    spec = pod["spec"]
+    if rng.random() < 0.5:
+        spec["topologySpreadConstraints"] = [{
+            "maxSkew": 1,
+            "topologyKey": "zone",
+            "whenUnsatisfiable": rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+            "labelSelector": {"matchLabels": {"app": labels["app"]}},
+        }]
+    if rng.random() < 0.5:
+        term = {
+            "topologyKey": rng.choice(["zone", "kubernetes.io/hostname"]),
+            "labelSelector": {"matchLabels": {"app": rng.choice(["web", "db"])}},
+        }
+        aff = spec.setdefault("affinity", {})
+        if rng.random() < 0.5:
+            aff["podAntiAffinity"] = {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": rng.randint(1, 50), "podAffinityTerm": term}
+                ]
+            }
+        else:
+            aff["podAffinity"] = {
+                "requiredDuringSchedulingIgnoredDuringExecution": [term]
+            }
+    return pod
+
+
+def _rand_node(rng: random.Random, seq: int) -> dict:
+    node = make_node(f"n{seq}", cpu="4", memory="8Gi")
+    node["metadata"]["labels"] = {
+        "zone": rng.choice(["az-1", "az-2", "az-3"]),
+        "kubernetes.io/hostname": f"n{seq}",
+    }
+    return node
+
+
+def _engine_view(feats):
+    eng = Engine(feats, default_plugins(feats), record="full")
+    res = eng.evaluate_batch()
+    return eng, res
+
+
+def test_persistent_featurizer_matches_fresh_replay():
+    rng = random.Random(7)
+    persistent = Featurizer()
+    nodes = [_rand_node(rng, i) for i in range(6)]
+    pods: list[dict] = []
+    node_seq, pod_seq = 6, 0
+
+    for step in range(14):
+        # Mutate like the store would: objects are replaced, not edited.
+        for _ in range(rng.randint(1, 6)):
+            r = rng.random()
+            if r < 0.45 or not pods:
+                pods.append(_rand_pod(rng, pod_seq))
+                pod_seq += 1
+            elif r < 0.65 and any(p["spec"].get("nodeName") for p in pods):
+                bound = [i for i, p in enumerate(pods) if p["spec"].get("nodeName")]
+                pods.pop(rng.choice(bound))
+            elif r < 0.8:
+                # Bind a pending pod (new object, like the store's patch).
+                pending = [i for i, p in enumerate(pods) if not p["spec"].get("nodeName")]
+                if pending:
+                    i = rng.choice(pending)
+                    p = copy.deepcopy(pods[i])
+                    p["spec"]["nodeName"] = rng.choice(nodes)["metadata"]["name"]
+                    pods[i] = p
+            elif r < 0.93 and len(nodes) > 3:
+                # Drain/replace a node; its pods go pending (new objects).
+                gone = nodes.pop(rng.randrange(len(nodes)))
+                gname = gone["metadata"]["name"]
+                for i, p in enumerate(pods):
+                    if p["spec"].get("nodeName") == gname:
+                        p2 = copy.deepcopy(p)
+                        p2["spec"].pop("nodeName", None)
+                        pods[i] = p2
+                nodes.append(_rand_node(rng, node_seq))
+                node_seq += 1
+            else:
+                # Relabel a node in place on the axis (new object).
+                i = rng.randrange(len(nodes))
+                n2 = copy.deepcopy(nodes[i])
+                n2["metadata"]["labels"]["zone"] = rng.choice(["az-1", "az-2", "az-3"])
+                nodes[i] = n2
+
+        queue = [p for p in pods if not p["spec"].get("nodeName")]
+        if not queue:
+            continue
+        feats_p = persistent.featurize(list(nodes), list(pods), queue_pods=queue)
+        feats_f = Featurizer().featurize(list(nodes), list(pods), queue_pods=queue)
+
+        # Node-name alignment: permutation from fresh order to persistent.
+        names_p = feats_p.nodes.names
+        names_f = feats_f.nodes.names
+        assert sorted(names_p) == sorted(names_f)
+        perm = [names_p.index(nm) for nm in names_f]
+
+        np.testing.assert_array_equal(
+            feats_p.nodes.requested[perm], feats_f.nodes.requested[: len(perm)],
+            err_msg=f"step {step}: requested diverged",
+        )
+        np.testing.assert_array_equal(
+            feats_p.nodes.pod_count[perm], feats_f.nodes.pod_count[: len(perm)]
+        )
+
+        _, res_p = _engine_view(feats_p)
+        _, res_f = _engine_view(feats_f)
+        P = len(queue)
+        np.testing.assert_array_equal(
+            res_p.feasible[:P], res_f.feasible[:P],
+            err_msg=f"step {step}: feasibility diverged",
+        )
+        np.testing.assert_array_equal(
+            (res_p.reason_bits[:P][:, :, perm] != 0),
+            (res_f.reason_bits[:P][:, :, : len(perm)] != 0),
+            err_msg=f"step {step}: filter masks diverged",
+        )
+        np.testing.assert_array_equal(
+            res_p.total[:P][:, perm], res_f.total[:P][:, : len(perm)],
+            err_msg=f"step {step}: total scores diverged",
+        )

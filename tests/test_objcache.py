@@ -55,11 +55,30 @@ def test_featurize_consistent_across_memo_flush():
     np.testing.assert_array_equal(a.pods.requests, b.pods.requests)
 
 
-def test_maybe_flush_respects_limit(monkeypatch):
+def test_maybe_flush_sweeps_only_stale_entries(monkeypatch):
     objcache.clear()
     monkeypatch.setattr(objcache, "LIMIT", 4)
-    for i in range(6):
-        objcache.put(("slot", i), i)
-    assert objcache.stats()["entries"] == 6  # put never clears inline
+    objs = [{"i": i} for i in range(6)]
+    for i, o in enumerate(objs):
+        objcache.cached("slot", o, lambda i=i: i)
+    assert objcache.stats()["entries"] == 6  # put never evicts inline
+    # A sweep while everything is fresh reclaims nothing and doubles the
+    # working limit instead of rescanning every pass.
     objcache.maybe_flush()
-    assert objcache.stats()["entries"] == 0
+    assert objcache.stats()["entries"] == 6
+    # Keep the first two warm; age the rest past STALE_GENERATIONS, then
+    # grow the table over the doubled limit to trigger the next sweep.
+    for _ in range(objcache.STALE_GENERATIONS + 1):
+        objcache.maybe_flush()
+        for o in objs[:2]:
+            objcache.cached("slot", o, lambda: None)
+    fresh = [{"j": j} for j in range(3)]
+    for j, o in enumerate(fresh):
+        objcache.cached("slot", o, lambda j=j: j)
+    objcache.maybe_flush()
+    st = objcache.stats()
+    assert st["entries"] == 5  # 2 warm + 3 fresh; 4 stale swept
+    assert st["refs"] == 5
+    # Warm entries still serve their original values.
+    assert objcache.cached("slot", objs[0], lambda: "recomputed") == 0
+    objcache.clear()
