@@ -112,6 +112,67 @@ def _marshal(obj) -> str:
     return json.dumps(obj, sort_keys=True, separators=(",", ":"))
 
 
+class RenderCtx:
+    """Per-pass shared state for rendering many pods' results: sorted
+    node-name order, pre-JSON'd node/plugin names, the all-pass filter
+    row, and a cross-pod reason-bit decode memo.  Build once per
+    scheduling pass (the maps are assembled as JSON text directly — at
+    10k pods x 5k nodes the per-entry dict building + json.dumps of the
+    nested maps dominated the product path)."""
+
+    def __init__(self, feats: FeaturizedSnapshot, plugins: Sequence[ScoredPlugin]) -> None:
+        import numpy as np
+
+        self.node_names = feats.nodes.names
+        self.filter_plugins = [sp for sp in plugins if sp.filter_enabled]
+        self.score_plugins = [sp for sp in plugins if sp.score_enabled]
+        names = self.node_names
+        # json.dumps per atom keeps byte-compatibility with _marshal
+        # (escaping, ensure_ascii) while the maps are joined by hand.
+        self.node_json = [json.dumps(nm) for nm in names]
+        order = sorted(range(len(names)), key=lambda i: names[i])
+        self.rank = np.empty(len(names), dtype=np.int64)
+        for r, i in enumerate(order):
+            self.rank[i] = r
+        fnames = [sp.plugin.name for sp in self.filter_plugins]
+        self.fname_json = [json.dumps(n) for n in fnames]
+        passed = json.dumps(PASSED_FILTER_MESSAGE)
+        self.passed_row = "{" + ",".join(
+            f"{k}:{passed}" for k in sorted(self.fname_json)
+        ) + "}"
+        # Inner score rows list plugin names sorted (Go map marshal order).
+        sorder = sorted(range(len(self.score_plugins)),
+                        key=lambda s: self.score_plugins[s].plugin.name)
+        self.score_order = sorder
+        self.sname_json = [json.dumps(self.score_plugins[s].plugin.name) for s in sorder]
+        # Vectorized-assembly pieces: '"node":' prefixes (full and in
+        # key-sorted node order) and the per-plugin score-row separators
+        # ('{"p1":"', '","p2":"', ...).
+        self.sorted_order_arr = np.asarray(order, dtype=np.int64)
+        self.node_json_prefix_arr = np.asarray([nj + ":" for nj in self.node_json])
+        self.node_json_sorted_prefix = [self.node_json[i] + ":" for i in order]
+        self.score_prefix = [
+            ("{" if s == 0 else '",') + self.sname_json[s] + ':"'
+            for s in range(len(sorder))
+        ]
+        # (fi, bits) -> rendered filter row JSON, shared across pods.
+        self.fail_row_memo: dict[tuple[int, int], str] = {}
+
+    def fail_row(self, fi: int, bits: int) -> str:
+        """Row for a node whose first filter failure is plugin ``fi``
+        with ``bits``: upstream RunFilterPlugins stops at the first
+        failure, so plugins after ``fi`` are absent from the row."""
+        key = (fi, bits)
+        row = self.fail_row_memo.get(key)
+        if row is None:
+            msg = ", ".join(self.filter_plugins[fi].plugin.decode_reasons(bits))
+            entries = {self.fname_json[i]: json.dumps(PASSED_FILTER_MESSAGE) for i in range(fi)}
+            entries[self.fname_json[fi]] = json.dumps(msg)
+            row = "{" + ",".join(f"{k}:{v}" for k, v in sorted(entries.items())) + "}"
+            self.fail_row_memo[key] = row
+        return row
+
+
 def render_pod_results(
     feats: FeaturizedSnapshot,
     plugins: Sequence[ScoredPlugin],
@@ -119,47 +180,53 @@ def render_pod_results(
     pi: int,
     *,
     postfilter: dict | None = None,
+    ctx: "RenderCtx | None" = None,
 ) -> dict[str, str]:
     """The 13 result annotations for queue pod ``pi`` (all keys present,
     empty maps as "{}", mirroring GetStoredResult's unconditional adds).
     ``postfilter`` is the {node: {plugin: msg}} map recorded by the
-    PostFilter wrapper when preemption ran (wrappedplugin.go:550-577)."""
+    PostFilter wrapper when preemption ran (wrappedplugin.go:550-577).
+    Pass a shared ``ctx`` when rendering many pods of one pass."""
     if res.reason_bits is None:
         raise ValueError("render_pod_results needs record='full' results")
     import numpy as np
 
-    node_names = feats.nodes.names
-    filter_plugins = [sp for sp in plugins if sp.filter_enabled]
-    score_plugins = [sp for sp in plugins if sp.score_enabled]
+    if ctx is None:
+        ctx = RenderCtx(feats, plugins)
+    node_names = ctx.node_names
+    filter_plugins = ctx.filter_plugins
+    score_plugins = ctx.score_plugins
+    N = len(node_names)
 
-    # Decode reason bits through a per-plugin memo: clusters repeat a
-    # handful of distinct bit patterns across thousands of nodes, and the
-    # rendered results are the product's hot output path at 10k x 5k
-    # (SURVEY hard part 7).
-    bits_pi = np.asarray(res.reason_bits[pi])  # [F, N]
-    decode_memo: list[dict[int, str]] = []
-    for fi, sp in enumerate(filter_plugins):
-        memo: dict[int, str] = {0: PASSED_FILTER_MESSAGE}
-        for b in np.unique(bits_pi[fi, : len(node_names)]):
-            if int(b) != 0:
-                memo[int(b)] = ", ".join(sp.plugin.decode_reasons(int(b)))
-        decode_memo.append(memo)
+    bits_pi = np.asarray(res.reason_bits[pi])[:, :N]  # [F, N]
+    failed = bits_pi != 0
+    any_fail = failed.any(axis=0)
+    # First failing plugin per node (argmax finds the first True); with
+    # no filter plugins every node is feasible and argmax is undefined.
+    if bits_pi.shape[0]:
+        first_fail = np.argmax(failed, axis=0)
+    else:
+        first_fail = np.zeros(N, dtype=np.int64)
+    feasible_nodes = np.nonzero(~any_fail)[0]
 
-    filter_map: dict[str, dict[str, str]] = {}
-    feasible_nodes: list[int] = []
-    plugin_names_f = [sp.plugin.name for sp in filter_plugins]
-    for ni, node in enumerate(node_names):
-        row: dict[str, str] = {}
-        ok = True
-        for fi in range(len(filter_plugins)):
-            bits = int(bits_pi[fi, ni])
-            row[plugin_names_f[fi]] = decode_memo[fi][bits]
-            if bits != 0:
-                ok = False
-                break  # upstream RunFilterPlugins early exit
-        filter_map[node] = row
-        if ok:
-            feasible_nodes.append(ni)
+    # filter-result: every node gets a row; rows are shared strings.
+    # Nodes share a handful of distinct rows (the all-pass row or one per
+    # (first failing plugin, bits) pattern): classify every node to a
+    # pattern code in bulk, render each distinct row once, then join.
+    so = ctx.sorted_order_arr
+    ff_s = first_fail[so].astype(np.int64)
+    bits_at_ff = bits_pi[ff_s, so].astype(np.int64)
+    codes = np.where(any_fail[so], (ff_s << 32) | (bits_at_ff & 0xFFFFFFFF), -1)
+    uniq, inv = np.unique(codes, return_inverse=True)
+    row_strs = []
+    for code in uniq:
+        if code < 0:
+            row_strs.append(ctx.passed_row)
+        else:
+            row_strs.append(ctx.fail_row(int(code >> 32), int(code & 0xFFFFFFFF)))
+    prefixes = ctx.node_json_sorted_prefix
+    parts = [prefixes[k] + row_strs[i] for k, i in enumerate(inv)]
+    filter_json = "{" + ",".join(parts) + "}"
 
     # Upstream schedulePod returns right after filtering when exactly one
     # node is feasible (schedule_one.go findNodesThatFitPod early return):
@@ -167,19 +234,27 @@ def render_pod_results(
     # empty score maps.  Zero feasible nodes goes to PostFilter, likewise
     # without scoring.
     ran_scoring = len(feasible_nodes) > 1
-    score_map: dict[str, dict[str, str]] = {}
-    final_map: dict[str, dict[str, str]] = {}
+    score_json = "{}"
+    final_json = "{}"
     if res.scores is not None and score_plugins and ran_scoring:
-        for ni in feasible_nodes:
-            node = node_names[ni]
-            score_map[node] = {
-                sp.plugin.name: str(int(res.scores[pi, si, ni]))
-                for si, sp in enumerate(score_plugins)
-            }
-            final_map[node] = {
-                sp.plugin.name: str(int(res.final_scores[pi, si, ni]))
-                for si, sp in enumerate(score_plugins)
-            }
+        # Feasible nodes in key-sorted order; values stringified in bulk.
+        feas = feasible_nodes[np.argsort(ctx.rank[feasible_nodes], kind="stable")]
+        raw = np.char.mod("%d", np.asarray(res.scores[pi])[:, feas][ctx.score_order])
+        fin = np.char.mod("%d", np.asarray(res.final_scores[pi])[:, feas][ctx.score_order])
+
+        def rows_json(vals: np.ndarray) -> np.ndarray:
+            # '"p1":"V1","p2":"V2",...' assembled as S vectorized string
+            # concatenations over the feasible axis (python-level per-cell
+            # loops dominated the product path at 10k x 5k).
+            row = np.char.add(ctx.score_prefix[0], vals[0])
+            for s in range(1, vals.shape[0]):
+                row = np.char.add(row, ctx.score_prefix[s])
+                row = np.char.add(row, vals[s])
+            return np.char.add(row, '"}')
+
+        node_pre = ctx.node_json_prefix_arr[feas]
+        score_json = "{" + ",".join(np.char.add(node_pre, rows_json(raw)).tolist()) + "}"
+        final_json = "{" + ",".join(np.char.add(node_pre, rows_json(fin)).tolist()) + "}"
 
     prefilter_status = {
         sp.plugin.name: SUCCESS_MESSAGE
@@ -215,11 +290,11 @@ def render_pod_results(
     out = {
         PRE_FILTER_RESULT_KEY: _marshal({}),
         PRE_FILTER_STATUS_KEY: _marshal(prefilter_status),
-        FILTER_RESULT_KEY: _marshal(filter_map),
+        FILTER_RESULT_KEY: filter_json,
         POST_FILTER_RESULT_KEY: _marshal(postfilter or {}),
         PRE_SCORE_RESULT_KEY: _marshal(prescore),
-        SCORE_RESULT_KEY: _marshal(score_map),
-        FINAL_SCORE_RESULT_KEY: _marshal(final_map),
+        SCORE_RESULT_KEY: score_json,
+        FINAL_SCORE_RESULT_KEY: final_json,
         RESERVE_RESULT_KEY: _marshal(reserve_map),
         PERMIT_RESULT_KEY: _marshal({}),
         PERMIT_TIMEOUT_RESULT_KEY: _marshal({}),

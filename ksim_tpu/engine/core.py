@@ -366,17 +366,60 @@ class _Program:
         best = jnp.argmax(masked).astype(jnp.int32)
         return jnp.where(feasible, best, -1)
 
+    def _result_dtypes(self):
+        """Smallest SAFE dtypes for the recorded result tensors, decided
+        statically from plugin declarations — the device->host transfer
+        of [P,F,N]/[P,S,N] tensors is the record="full" bottleneck on a
+        bandwidth-limited link, and bytes scale with dtype width.
+
+        - reason bits: each filter plugin declares ``reason_bit_width``
+          (low bits it can set); missing declaration means int32.
+        - final scores: each score plugin declares ``final_score_bound``
+          (max post-normalize value); final = bound x weight per plugin.
+          Raw scores stay int32 (data-dependent magnitudes)."""
+        widths = [
+            getattr(sp.plugin, "reason_bit_width", 31)
+            for sp in self.plugins
+            if sp.filter_enabled
+        ]
+        maxw = max(widths, default=0)
+        bits_dtype = (
+            jnp.int8 if maxw <= 7 else jnp.int16 if maxw <= 15 else jnp.int32
+        )
+        fmax = 0
+        for sp in self.plugins:
+            if not sp.score_enabled:
+                continue
+            bound = getattr(sp.plugin, "final_score_bound", None)
+            if bound is None:
+                fmax = None
+                break
+            fmax = max(fmax, bound * max(sp.weight, 1))
+        final_dtype = (
+            jnp.int16 if fmax is not None and fmax < 2**15 else jnp.int32
+        )
+        return bits_dtype, final_dtype
+
     def _pod_outputs(self, pv, best, bits, raw, final, total) -> dict:
         # No separate feasible output: selected >= 0 iff (valid & any node
         # passed), so _to_result derives it — one fewer device->host pull
         # per chunk (each costs ~150ms over a high-latency link).
         out = dict(selected=jnp.where(pv, best, -1))
         n = total.shape[0]
+        bits_dtype, final_dtype = self._result_dtypes()
         if self.record in ("full", "final"):
             out["total"] = total
-            out["final"] = jnp.stack(final) if final else jnp.zeros((0, n), jnp.int32)
+            out["final"] = (
+                jnp.stack(final).astype(final_dtype)
+                if final
+                else jnp.zeros((0, n), final_dtype)
+            )
         if self.record == "full":
-            out["bits"] = jnp.stack(bits) if bits else jnp.zeros((0, n), jnp.int32)
+            out["bits"] = (
+                jnp.stack(bits).astype(bits_dtype)
+                if bits
+                else jnp.zeros((0, n), bits_dtype)
+            )
             out["raw"] = jnp.stack(raw) if raw else jnp.zeros((0, n), jnp.int32)
         return out
 

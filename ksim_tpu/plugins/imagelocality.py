@@ -29,6 +29,10 @@ MAX_CONTAINER_THRESHOLD = 1000 * MB
 
 
 class ImageLocality:
+    # Static reason-bit width: result tensors downcast when every
+    # filter plugin's bits fit a narrower dtype (engine/core.py).
+    reason_bit_width = 1
+    final_score_bound = 100  # post-normalize max (MaxNodeScore)
     name = NAME
 
     def __init__(self, img: ImageTensors) -> None:

@@ -53,6 +53,10 @@ EXISTING_ANTI_BIT = 4
 
 
 class InterPodAffinity:
+    # Static reason-bit width: result tensors downcast when every
+    # filter plugin's bits fit a narrower dtype (engine/core.py).
+    reason_bit_width = 3
+    final_score_bound = 100  # post-normalize max (MaxNodeScore)
     name = NAME
 
     def __init__(self, ipa: InterPodTensors) -> None:

@@ -51,6 +51,10 @@ def required_affinity_match(aux, pod: PodView) -> jnp.ndarray:
 
 
 class NodeAffinity:
+    # Static reason-bit width: result tensors downcast when every
+    # filter plugin's bits fit a narrower dtype (engine/core.py).
+    reason_bit_width = 2
+    final_score_bound = 100  # post-normalize max (MaxNodeScore)
     name = NAME
 
     def filter(self, state: NodeStateView, pod: PodView, aux) -> FilterOutput:

@@ -18,6 +18,9 @@ ERR_REASON = "node(s) didn't match the requested node name"
 
 
 class NodeName:
+    # Static reason-bit width: result tensors downcast when every
+    # filter plugin's bits fit a narrower dtype (engine/core.py).
+    reason_bit_width = 1
     name = NAME
 
     def static_sig(self) -> tuple:

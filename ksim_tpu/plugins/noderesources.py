@@ -64,6 +64,10 @@ class NodeResourcesFit:
         self._score_spec = tuple(
             (idx[r], w) for r, w in score_resources if r in idx
         )
+        # Bit 0 = "Too many pods", bit 1+r per resource (capped): the
+        # engine downcasts result tensors when all widths fit (core.py).
+        self.reason_bit_width = 1 + min(len(resources), MAX_RESOURCE_BITS)
+        self.final_score_bound = 100  # LeastAllocated is 0..MaxNodeScore
 
     def static_sig(self) -> tuple:
         return (FIT_NAME, self._base_count, self._score_spec)
@@ -129,6 +133,7 @@ class NodeResourcesFit:
 
 class NodeResourcesBalancedAllocation:
     """Balanced-allocation score (upstream defaults: cpu, memory)."""
+    final_score_bound = 100  # post-normalize max (MaxNodeScore)
 
     name = BALANCED_NAME
 

@@ -19,6 +19,9 @@ ERR_REASON_UNSCHEDULABLE = "node(s) were unschedulable"
 
 
 class NodeUnschedulable:
+    # Static reason-bit width: result tensors downcast when every
+    # filter plugin's bits fit a narrower dtype (engine/core.py).
+    reason_bit_width = 2
     name = NAME
 
     def filter(self, state: NodeStateView, pod: PodView, aux=None) -> FilterOutput:

@@ -91,6 +91,10 @@ def _mm(a, b):
 
 
 class PodTopologySpread:
+    # Static reason-bit width: result tensors downcast when every
+    # filter plugin's bits fit a narrower dtype (engine/core.py).
+    reason_bit_width = 2
+    final_score_bound = 100  # post-normalize max (MaxNodeScore)
     name = NAME
     normalize_needs_ctx = True
 

@@ -37,10 +37,15 @@ def forbidding_taints_tolerated(aux, pod: PodView) -> jnp.ndarray:
 
 
 class TaintToleration:
+    final_score_bound = 100  # post-normalize max (MaxNodeScore)
     name = NAME
 
     def __init__(self, taints: TaintTensors) -> None:
         self._taints = taints  # host-side vocab for decode
+        # The reason is a 1-based INDEX into the taint vocabulary (not a
+        # bit mask), so the width the engine's dtype downcast may rely on
+        # is the vocabulary size's bit length (engine/core.py).
+        self.reason_bit_width = (taints.n_taints + 1).bit_length()
 
     def static_sig(self) -> tuple:
         return (NAME,)  # the vocab only feeds host-side decode

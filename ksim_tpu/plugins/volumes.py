@@ -55,6 +55,9 @@ def _dot_bool(mat: jnp.ndarray, vec: jnp.ndarray) -> jnp.ndarray:
 
 
 class VolumeBinding:
+    # Static reason-bit width: result tensors downcast when every
+    # filter plugin's bits fit a narrower dtype (engine/core.py).
+    reason_bit_width = 4
     name = VOLUME_BINDING
 
     def __init__(self, vt: VolumeTensors) -> None:
@@ -101,6 +104,9 @@ class VolumeBinding:
 
 
 class VolumeZone:
+    # Static reason-bit width: result tensors downcast when every
+    # filter plugin's bits fit a narrower dtype (engine/core.py).
+    reason_bit_width = 1
     name = VOLUME_ZONE
 
     def __init__(self, vt: VolumeTensors) -> None:
@@ -125,6 +131,9 @@ class VolumeZone:
 
 
 class NodeVolumeLimits:
+    # Static reason-bit width: result tensors downcast when every
+    # filter plugin's bits fit a narrower dtype (engine/core.py).
+    reason_bit_width = 1
     name = NODE_VOLUME_LIMITS
 
     def __init__(self, vt: VolumeTensors) -> None:
@@ -168,6 +177,9 @@ class NodeVolumeLimits:
 
 
 class VolumeRestrictions:
+    # Static reason-bit width: result tensors downcast when every
+    # filter plugin's bits fit a narrower dtype (engine/core.py).
+    reason_bit_width = 2
     name = VOLUME_RESTRICTIONS
 
     def __init__(self, vt: VolumeTensors) -> None:

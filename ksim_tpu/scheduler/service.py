@@ -33,7 +33,7 @@ from typing import Callable, Sequence
 import copy
 
 from ksim_tpu.engine import Engine
-from ksim_tpu.engine.annotations import apply_results_to_pod, render_pod_results
+from ksim_tpu.engine.annotations import RenderCtx, apply_results_to_pod, render_pod_results
 from ksim_tpu.engine.core import ScoredPlugin
 from ksim_tpu.scheduler.profile import (
     DEFAULT_SCHEDULER_NAME,
@@ -554,6 +554,7 @@ class SchedulerService:
             placements[f"{namespace_of(pod)}/{name_of(pod)}"] = selected
 
     def _bind_results(self, queue, feats, plugins, res, placements) -> None:
+        render_ctx = RenderCtx(feats, plugins) if self._record == "full" else None
         for j, pod in enumerate(queue):
             sel = int(res.selected[j])
             node_name = feats.nodes.names[sel] if sel >= 0 else None
@@ -563,25 +564,40 @@ class SchedulerService:
                     pod, feats, plugins, res, j
                 )
             anno = (
-                render_pod_results(feats, plugins, res, j, postfilter=postfilter)
+                render_pod_results(
+                    feats, plugins, res, j, postfilter=postfilter, ctx=render_ctx
+                )
                 if self._record == "full"
                 else {}
             )
 
-            def mutate(obj: JSON) -> None:
-                annos = obj.setdefault("metadata", {}).setdefault("annotations", {})
+            def rebuild(obj: JSON) -> JSON:
+                # Shallow re-wrap (store.rewrap contract): share the
+                # unchanged substructures, never mutate the old object —
+                # deep-copying megabytes of accumulated result-history
+                # per attempt dominated the record="full" product path.
+                new = dict(obj)
+                md = dict(obj.get("metadata") or {})
+                annos = dict(md.get("annotations") or {})
                 if anno:
                     apply_results_to_pod(annos, anno)
+                md["annotations"] = annos
+                new["metadata"] = md
+                spec = dict(obj.get("spec") or {})
+                status = dict(obj.get("status") or {})
                 if node_name:
-                    obj.setdefault("spec", {})["nodeName"] = node_name
-                    obj.setdefault("status", {})["phase"] = "Running"
+                    spec["nodeName"] = node_name
+                    status["phase"] = "Running"
                     # The apiserver clears any earlier nomination on bind.
-                    obj.get("status", {}).pop("nominatedNodeName", None)
+                    status.pop("nominatedNodeName", None)
                 elif nominated:
-                    obj.setdefault("status", {})["nominatedNodeName"] = nominated
+                    status["nominatedNodeName"] = nominated
+                new["spec"] = spec
+                new["status"] = status
+                return new
 
-            updated = self._store.patch(
-                "pods", name_of(pod), namespace_of(pod), mutate
+            updated = self._store.rewrap(
+                "pods", name_of(pod), namespace_of(pod), rebuild
             )
             with self._own_rvs_lock:
                 self._own_rvs.add(updated["metadata"]["resourceVersion"])

@@ -45,7 +45,10 @@ class NodeSlots:
     def __init__(self) -> None:
         self.slot_of: dict[str, int] = {}
         self._names: list[str] = []
-        self._ids: list[int] = []  # id() of the node object last seen
+        # The node OBJECT last seen per slot (a strong ref, compared by
+        # identity): comparing bare id() values would miss a replacement
+        # whose new dict recycled the old dict's address.
+        self._objs: list[JSON] = []
 
     def sync(self, nodes: Sequence[JSON]) -> tuple[list[JSON], set[int]]:
         """Update the assignment for the current node set.
@@ -66,11 +69,11 @@ class NodeSlots:
             if s != last:
                 moved = self._names[last]
                 self._names[s] = moved
-                self._ids[s] = self._ids[last]
+                self._objs[s] = self._objs[last]
                 self.slot_of[moved] = s
                 changed.add(s)
             self._names.pop()
-            self._ids.pop()
+            self._objs.pop()
             changed.discard(last)
             changed.add(last)  # slot vanished (or shrank away)
 
@@ -81,10 +84,10 @@ class NodeSlots:
                 s = len(self._names)
                 self.slot_of[nm] = s
                 self._names.append(nm)
-                self._ids.append(id(n))
+                self._objs.append(n)
                 changed.add(s)
-            elif self._ids[s] != id(n):
-                self._ids[s] = id(n)
+            elif self._objs[s] is not n:
+                self._objs[s] = n
                 changed.add(s)
 
         ordered = [by_name[nm] for nm in self._names]
@@ -103,7 +106,6 @@ def sync_family(
     make_arrays: Callable[[], Any],
     record_of: Callable[[JSON], "tuple[int, Any] | None"],
     apply: Callable[[Any, Any, int], None],
-    migrate: Callable[[Any, Any], bool] | None = None,
 ) -> Any:
     """Maintain one additive aggregate over the bound-pod population.
 
@@ -112,25 +114,13 @@ def sync_family(
     ``record_of``: pod -> (slot, contribution) or None (no contribution;
     e.g. the pod's node does not exist).
     ``apply``: apply a contribution to the arrays with sign +1/-1.
-    ``migrate``: optional (old_arrays, new_arrays_factory-made) -> bool;
-    when the token changes, a migrate that returns True preserves the
-    records (used for pure axis-resize reallocation where slot ids and
-    contributions stay valid); otherwise a full rebuild runs.
 
     Returns the family's arrays (the live master — callers must treat
     them as read-only and copy before handing them to the engine).
     """
     fam = state.get(name)
     if fam is not None and fam["token"] != token:
-        if migrate is not None:
-            new_arrays = make_arrays()
-            if migrate(fam["arrays"], new_arrays):
-                fam["arrays"] = new_arrays
-                fam["token"] = token
-            else:
-                fam = None
-        else:
-            fam = None
+        fam = None
     if fam is None:
         arrays = make_arrays()
         records: dict[int, tuple[JSON, Any]] = {}

@@ -164,6 +164,35 @@ class ClusterStore:
             self._notify(WatchEvent(kind, MODIFIED, obj))
             return copy.deepcopy(obj)
 
+    def rewrap(
+        self, kind: str, name: str, namespace: str, build: Callable[[JSON], JSON]
+    ) -> JSON:
+        """Atomic replace from a shallow re-wrap: ``build(current)``
+        returns a NEW top-level object that may SHARE unmodified
+        substructures with ``current`` (which is frozen — writes replace,
+        never mutate).  This skips the full deepcopy ``patch`` pays,
+        which matters on the scheduler's bind path: pods accumulate
+        megabytes of result-history annotations and deep-copying them on
+        every attempt dominated the record="full" product path.
+
+        Contract: ``build`` must not mutate ``current`` or any shared
+        substructure, must return a fresh ``metadata`` dict (it gets the
+        new resourceVersion), and the returned object is stored AND
+        shared with watch events — the caller must treat it as frozen.
+        """
+        self._check_kind(kind)
+        with self._lock:
+            key = _key(kind, name, namespace)
+            current = self._objects[kind].get(key)
+            if current is None:
+                raise NotFoundError(f"{kind} {key!r} not found")
+            obj = build(current)
+            md = obj["metadata"] = dict(obj.get("metadata") or {})
+            md["resourceVersion"] = str(next(self._rv))
+            self._objects[kind][key] = obj
+            self._notify(WatchEvent(kind, MODIFIED, obj))
+            return obj
+
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
         self._check_kind(kind)
         with self._lock:
