@@ -1,0 +1,47 @@
+"""One-shot SchedulerSimulation entrypoint (the reference's KEP-184
+scenario-runner container: read a Scenario from a file, run it in a
+simulator built from the spec, store the result to a file).
+
+Run: ``python -m ksim_tpu.cmd.simulation sim.yaml [--result out.json]``.
+Exit code 0 on Succeeded, 1 on Failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def run_simulation(argv: "list[str] | None" = None) -> int:
+    from ksim_tpu.util import enable_compilation_cache
+
+    enable_compilation_cache()
+    ap = argparse.ArgumentParser(prog="ksim-simulation")
+    ap.add_argument("document", help="SchedulerSimulation YAML/JSON document")
+    ap.add_argument(
+        "--result", default=None, help="override spec.scenarioResultFilePath"
+    )
+    args = ap.parse_args(argv)
+
+    import yaml
+
+    from ksim_tpu.scenario.simulation import run_scheduler_simulation
+
+    with open(args.document) as f:
+        doc = yaml.safe_load(f)
+    if args.result:
+        doc.setdefault("spec", {})["scenarioResultFilePath"] = args.result
+    out = run_scheduler_simulation(doc)
+    status = out.get("status", {})
+    json.dump(status, sys.stdout, indent=1)
+    print()
+    return 0 if status.get("phase") == "Succeeded" else 1
+
+
+def main() -> None:
+    raise SystemExit(run_simulation())
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,7 @@ from ksim_tpu.scenario.spec import (
     load_scenario,
     operations_from_spec,
 )
+from ksim_tpu.scenario.simulation import run_scheduler_simulation
 
 __all__ = [
     "Operation",
@@ -30,4 +31,5 @@ __all__ = [
     "churn_scenario",
     "load_scenario",
     "operations_from_spec",
+    "run_scheduler_simulation",
 ]

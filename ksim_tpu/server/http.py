@@ -90,7 +90,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_OPTIONS(self) -> None:  # CORS preflight
         self.send_response(204)
         self._cors()
-        self.send_header("Access-Control-Allow-Methods", "GET, POST, PUT, OPTIONS")
+        self.send_header("Access-Control-Allow-Methods", "GET, POST, PUT, DELETE, OPTIONS")
         self.send_header("Access-Control-Allow-Headers", "Content-Type")
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -115,6 +115,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, self.server.di.scheduler_service.metrics.snapshot())
         elif url.path == "/api/v1/listwatchresources":
             self._list_watch(parse_qs(url.query))
+        elif url.path.startswith("/api/v1/resources/"):
+            self._resource("GET", url.path, parse_qs(url.query))
         else:
             self._json(404, {"message": "Not Found"})
 
@@ -132,11 +134,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._no_content(200)
         elif url.path.startswith("/api/v1/extender/"):
             self._extender(url.path)
+        elif url.path.startswith("/api/v1/resources/"):
+            self._resource("POST", url.path)
         else:
             self._json(404, {"message": "Not Found"})
 
     def do_PUT(self) -> None:
-        if urlparse(self.path).path == "/api/v1/reset":
+        url = urlparse(self.path)
+        if url.path == "/api/v1/reset":
             try:
                 self.server.di.reset_service.reset()
             except Exception:
@@ -144,10 +149,81 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(500, {"message": "Internal Server Error"})
                 return
             self._no_content(202)
+        elif url.path.startswith("/api/v1/resources/"):
+            self._resource("PUT", url.path)
+        else:
+            self._json(404, {"message": "Not Found"})
+
+    def do_DELETE(self) -> None:
+        url = urlparse(self.path)
+        if url.path.startswith("/api/v1/resources/"):
+            self._resource("DELETE", url.path)
         else:
             self._json(404, {"message": "Not Found"})
 
     # -- handlers -----------------------------------------------------------
+
+    def _resource(self, method: str, path: str, query: dict | None = None) -> None:
+        """Per-resource CRUD.  The reference UI talks straight to the
+        KWOK kube-apiserver for this (web/api/v1/pod.ts etc.); the
+        in-memory store takes that role here, so the simulator server
+        exposes it:
+
+        - ``GET /api/v1/resources/<kind>[?namespace=ns]`` — list (all
+          namespaces unless filtered);
+        - item routes: ``<kind>/<name>`` (cluster-scoped) or
+          ``<kind>/<ns>/<name>`` (namespaced — both segments required);
+        - ``POST <kind>`` create, ``PUT`` item update (path and body
+          identity must agree, like the apiserver), ``DELETE`` item."""
+        from ksim_tpu.errors import ConflictError, NotFoundError
+        from ksim_tpu.state.cluster import KINDS, NAMESPACED_KINDS
+        from ksim_tpu.state.resources import name_of, namespace_of
+
+        parts = [p for p in path.split("/") if p]  # api, v1, resources, kind, ...
+        kind = parts[3] if len(parts) > 3 else ""
+        if kind not in KINDS:
+            self._json(404, {"message": f"unknown kind {kind!r}"})
+            return
+        store = self.server.di.store
+        rest = parts[4:]
+        namespaced = kind in NAMESPACED_KINDS
+        if namespaced and len(rest) == 1 and method != "POST":
+            self._json(
+                400,
+                {"message": f"{kind} item routes need /{kind}/<namespace>/<name>"},
+            )
+            return
+        namespace = rest[0] if namespaced and len(rest) == 2 else ""
+        name = rest[-1] if rest else ""
+        try:
+            if method == "GET" and not name:
+                ns_filter = (query or {}).get("namespace", [""])[0]
+                self._json(200, {"items": store.list(kind, ns_filter)})
+            elif method == "GET":
+                self._json(200, store.get(kind, name, namespace))
+            elif method == "POST":
+                self._json(201, store.create(kind, self._body()))
+            elif method == "PUT":
+                body = self._body()
+                if name_of(body) != name or (
+                    namespaced and (namespace_of(body) or "default") != namespace
+                ):
+                    self._json(
+                        400,
+                        {"message": "path and body name/namespace differ"},
+                    )
+                    return
+                self._json(200, store.update(kind, body))
+            elif method == "DELETE":
+                store.delete(kind, name, namespace)
+                self._no_content(200)
+        except NotFoundError:
+            self._json(404, {"message": "Not Found"})
+        except ConflictError as e:
+            self._json(409, {"message": str(e)})
+        except Exception:
+            logger.exception("resource %s %s failed", method, path)
+            self._json(400, {"message": "Bad Request"})
 
     def _apply_scheduler_config(self) -> None:
         """Only .profiles and .extenders are taken from the payload

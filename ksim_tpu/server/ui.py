@@ -1,12 +1,15 @@
-"""Minimal built-in web UI.
+"""Built-in web UI.
 
 The reference ships a ~5k-LoC Nuxt2/Vuetify app (reference web/) that is
 a pure client of the REST + annotation contract; this single-file page
-demonstrates that contract end-to-end against THIS server: live
-node/pod tables fed by the streaming /api/v1/listwatchresources
-endpoint, per-plugin Filter/Score/FinalScore tables decoded from the 13
-result annotations (the SchedulingResults.vue analogue), and the
-export/reset top-bar operations.  Served at / by SimulatorServer."""
+covers that app's workflow against THIS server: live tables for all 7
+resource kinds fed by the streaming /api/v1/listwatchresources endpoint,
+per-plugin Filter/Score/FinalScore tables decoded from the 13 result
+annotations (the SchedulingResults.vue analogue), resource create (from
+prefilled templates, ResourceAddButton.vue) and delete through the
+/api/v1/resources CRUD, a scheduler-configuration editor
+(SchedulerConfigurationEditButton.vue), snapshot export/import and reset
+(TopBar/), and a metrics panel.  Served at / by SimulatorServer."""
 
 INDEX_HTML = """<!doctype html>
 <html>
@@ -14,71 +17,165 @@ INDEX_HTML = """<!doctype html>
 <meta charset="utf-8"/>
 <title>ksim-tpu simulator</title>
 <style>
-  body { font-family: system-ui, sans-serif; margin: 1.5rem; color: #222; }
-  h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.2rem; }
+  body { font-family: system-ui, sans-serif; margin: 1.2rem; color: #222; }
+  h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.1rem; }
   table { border-collapse: collapse; margin-top: .4rem; font-size: .85rem; }
-  th, td { border: 1px solid #ccc; padding: .25rem .5rem; text-align: left; }
+  th, td { border: 1px solid #ccc; padding: .22rem .5rem; text-align: left; }
   th { background: #f3f3f3; }
   .pill { display: inline-block; padding: 0 .5rem; border-radius: 999px;
           background: #e8f0fe; margin-right: .3rem; }
   .pending { background: #fde8e8; }
-  button { margin-right: .6rem; }
-  #results pre { background: #f8f8f8; padding: .5rem; overflow-x: auto; }
-  tr.sel { background: #fffbe6; cursor: pointer; } tr[data-pod] { cursor: pointer; }
+  button { margin-right: .4rem; }
+  #results pre, #metrics pre, pre { background: #f8f8f8; padding: .5rem; overflow-x: auto; }
+  tr[data-pod] { cursor: pointer; }
+  .tab { cursor: pointer; padding: .2rem .7rem; border: 1px solid #ccc;
+         border-bottom: none; display: inline-block; background: #f3f3f3; }
+  .tab.active { background: #fff; font-weight: 600; }
+  textarea { width: 100%; min-height: 10rem; font-family: monospace; }
+  .panel { border: 1px solid #ccc; padding: .6rem; margin-top: .4rem; }
+  .del { color: #a00; cursor: pointer; }
 </style>
 </head>
 <body>
 <h1>ksim-tpu scheduler simulator</h1>
 <div>
   <button onclick="doExport()">Export snapshot</button>
+  <button onclick="importFile.click()">Import snapshot</button>
+  <input type="file" id="importFile" style="display:none" onchange="doImport(this)"/>
   <button onclick="doReset()">Reset cluster</button>
+  <button onclick="toggle('config', loadConfig)">Scheduler config</button>
+  <button onclick="toggle('metrics', loadMetrics)">Metrics</button>
   <span id="status" class="pill">connecting…</span>
 </div>
-<h2>Nodes (<span id="nodecount">0</span>)</h2>
-<table id="nodes"><thead><tr><th>name</th><th>cpu</th><th>memory</th><th>pods</th></tr></thead><tbody></tbody></table>
-<h2>Pods (<span id="podcount">0</span>)</h2>
-<table id="pods"><thead><tr><th>namespace/name</th><th>node</th><th>phase</th><th>selected-node annotation</th></tr></thead><tbody></tbody></table>
-<h2>Scheduling results <small>(click a pod)</small></h2>
+
+<div id="config" class="panel" style="display:none">
+  <b>KubeSchedulerConfiguration</b> (JSON; applying compiles the new
+  kernel set — the reference's scheduler restart)<br/>
+  <textarea id="configText"></textarea><br/>
+  <button onclick="applyConfig()">Apply</button>
+  <button onclick="loadConfig()">Reload current</button>
+  <span id="configMsg"></span>
+</div>
+
+<div id="metrics" class="panel" style="display:none"><pre id="metricsPre"></pre></div>
+
+<div style="margin-top:1rem" id="tabs"></div>
+<div class="panel" id="tabpanel">
+  <div>
+    <b id="kindTitle"></b>
+    <button onclick="showAdd()">Add…</button>
+    <span id="kindCount" class="pill"></span>
+  </div>
+  <div id="addPanel" style="display:none">
+    <textarea id="addText"></textarea><br/>
+    <button onclick="doAdd()">Create</button>
+    <span id="addMsg"></span>
+  </div>
+  <table id="resTable"><thead></thead><tbody></tbody></table>
+</div>
+
+<h2>Scheduling results <small>(click a pod row)</small></h2>
 <div id="results">none selected</div>
+
 <script>
-const nodes = new Map(), pods = new Map();
 const PREFIX = "kube-scheduler-simulator.sigs.k8s.io/";
-// All interpolated data is escaped: snapshots/extender results are
-// untrusted input and reach this page via annotations.
+const KINDS = ["pods","nodes","persistentvolumes","persistentvolumeclaims",
+               "storageclasses","priorityclasses","namespaces"];
+const store = Object.fromEntries(KINDS.map(k => [k, new Map()]));
+let activeKind = "pods";
+
+// New-resource templates (the reference's web/components/lib/templates).
+const TEMPLATES = {
+  pods: {metadata:{name:"pod-new",namespace:"default"},spec:{containers:[
+    {name:"c",image:"registry.k8s.io/pause:3.9",resources:{requests:{cpu:"100m",memory:"128Mi"}}}]}},
+  nodes: {metadata:{name:"node-new"},status:{allocatable:{cpu:"4",memory:"8Gi",pods:"110"},
+    capacity:{cpu:"4",memory:"8Gi",pods:"110"}}},
+  persistentvolumes: {metadata:{name:"pv-new"},spec:{capacity:{storage:"1Gi"},
+    accessModes:["ReadWriteOnce"],persistentVolumeReclaimPolicy:"Delete"},status:{phase:"Available"}},
+  persistentvolumeclaims: {metadata:{name:"pvc-new",namespace:"default"},spec:{
+    accessModes:["ReadWriteOnce"],resources:{requests:{storage:"1Gi"}}}},
+  storageclasses: {metadata:{name:"sc-new"},provisioner:"kubernetes.io/no-provisioner",
+    volumeBindingMode:"WaitForFirstConsumer"},
+  priorityclasses: {metadata:{name:"pc-new"},value:1000},
+  namespaces: {metadata:{name:"ns-new"}},
+};
+
+// All interpolated data is escaped: snapshots/annotations are untrusted.
 function esc(s) {
   return String(s).replace(/[&<>"']/g, c => ({
     "&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 }
+function keyOf(obj) {
+  const md = (obj||{}).metadata||{};
+  return (md.namespace ? md.namespace+"/" : "") + md.name;
+}
+
+function renderTabs() {
+  document.getElementById("tabs").innerHTML = KINDS.map(k =>
+    `<span class="tab ${k===activeKind?"active":""}" onclick="setKind('${k}')">${k} (${store[k].size})</span>`).join("");
+}
+function setKind(k) { activeKind = k; document.getElementById("addPanel").style.display = "none"; render(); }
+
+const COLS = {
+  pods: ["node", "phase", "selected-node"],
+  nodes: ["cpu", "memory", "pods", "unschedulable"],
+  persistentvolumes: ["capacity", "phase", "claimRef"],
+  persistentvolumeclaims: ["volumeName", "storageClassName"],
+  storageclasses: ["provisioner", "bindingMode"],
+  priorityclasses: ["value"],
+  namespaces: [],
+};
+function cols(kind, o) {
+  const md = o.metadata||{}, spec = o.spec||{}, st = o.status||{};
+  switch (kind) {
+    case "pods": return [spec.nodeName||"", st.phase||"Pending",
+      ((md.annotations||{})[PREFIX+"selected-node"])||""];
+    case "nodes": { const a = st.allocatable||{};
+      return [a.cpu||"", a.memory||"", a.pods||"", spec.unschedulable?"true":""]; }
+    case "persistentvolumes": return [((spec.capacity||{}).storage)||"", st.phase||"",
+      spec.claimRef ? keyOf({metadata:spec.claimRef}) : ""];
+    case "persistentvolumeclaims": return [spec.volumeName||"", spec.storageClassName||""];
+    case "storageclasses": return [o.provisioner||"", o.volumeBindingMode||""];
+    case "priorityclasses": return [String(o.value ?? "")];
+    default: return [];
+  }
+}
 
 function render() {
-  const nb = document.querySelector("#nodes tbody"); nb.innerHTML = "";
-  for (const n of [...nodes.values()].sort((a,b)=>a.metadata.name.localeCompare(b.metadata.name))) {
-    const a = (n.status||{}).allocatable||{};
-    nb.insertAdjacentHTML("beforeend",
-      `<tr><td>${esc(n.metadata.name)}</td><td>${esc(a.cpu||"")}</td><td>${esc(a.memory||"")}</td><td>${esc(a.pods||"")}</td></tr>`);
+  renderTabs();
+  const kind = activeKind;
+  document.getElementById("kindTitle").textContent = kind;
+  document.getElementById("kindCount").textContent = store[kind].size + " objects";
+  const head = ["name", ...COLS[kind], ""].map(c=>`<th>${esc(c)}</th>`).join("");
+  document.querySelector("#resTable thead").innerHTML = `<tr>${head}</tr>`;
+  const tb = document.querySelector("#resTable tbody"); tb.innerHTML = "";
+  for (const [key, o] of [...store[kind].entries()].sort()) {
+    const extra = cols(kind, o).map(v=>`<td>${esc(v)}</td>`).join("");
+    const podAttr = kind === "pods" ? ` data-pod="${esc(key)}"` : "";
+    const cls = kind === "pods" && !(o.spec||{}).nodeName ? ' class="pending"' : "";
+    tb.insertAdjacentHTML("beforeend",
+      `<tr${podAttr}${cls}><td>${esc(key)}</td>${extra}<td><span class="del" data-key="${esc(key)}">delete</span></td></tr>`);
   }
-  document.getElementById("nodecount").textContent = nodes.size;
-  const pb = document.querySelector("#pods tbody"); pb.innerHTML = "";
-  for (const [key,p] of [...pods.entries()].sort()) {
-    const sel = ((p.metadata||{}).annotations||{})[PREFIX+"selected-node"]||"";
-    const nn = (p.spec||{}).nodeName||"";
-    pb.insertAdjacentHTML("beforeend",
-      `<tr data-pod="${esc(key)}" class="${nn?"":"pending"}"><td>${esc(key)}</td><td>${esc(nn)}</td><td>${esc((p.status||{}).phase||"Pending")}</td><td>${esc(sel)}</td></tr>`);
-  }
-  document.getElementById("podcount").textContent = pods.size;
+  // Handlers read dataset values — never inline JS with interpolated
+  // strings (entity escaping is undone before the JS engine parses an
+  // inline handler, which would turn a crafted resource name into
+  // stored script injection).
+  for (const el of document.querySelectorAll(".del"))
+    el.onclick = (ev) => { ev.stopPropagation(); doDelete(el.dataset.key); };
   for (const tr of document.querySelectorAll("tr[data-pod]"))
     tr.onclick = () => showResults(tr.dataset.pod);
 }
 
 function showResults(key) {
-  const p = pods.get(key); if (!p) return;
+  const p = store.pods.get(key); if (!p) return;
   const annos = ((p.metadata||{}).annotations)||{};
   const cats = ["filter-result","score-result","finalscore-result","postfilter-result",
-                "prefilter-result-status","prescore-result","selected-node","result-history"];
+                "prefilter-result-status","prescore-result","reserve-result","bind-result",
+                "selected-node","result-history"];
   let html = `<b>${esc(key)}</b>`;
   for (const c of cats) {
     const raw = annos[PREFIX+c]; if (raw === undefined) continue;
-    let body = raw;
+    let body;
     try {
       const obj = JSON.parse(raw);
       if (c.endsWith("-result") && obj && typeof obj === "object" && !Array.isArray(obj)) {
@@ -110,15 +207,64 @@ async function watch() {
       const line = buf.slice(0, i); buf = buf.slice(i+1);
       if (!line.trim()) continue;
       const ev = JSON.parse(line);
-      const md = (ev.Obj||{}).metadata||{};
-      const key = (md.namespace ? md.namespace+"/" : "") + md.name;
-      const map = ev.Kind === "nodes" ? nodes : ev.Kind === "pods" ? pods : null;
-      if (!map) continue;
+      const map = store[ev.Kind]; if (!map) continue;
+      const key = keyOf(ev.Obj);
       if (ev.EventType === "DELETED") map.delete(key); else map.set(key, ev.Obj);
     }
     render();
   }
   document.getElementById("status").textContent = "disconnected";
+}
+
+function resourcePath(kind, key) {
+  const [a, b] = key.includes("/") ? key.split("/") : [null, key];
+  return `/api/v1/resources/${kind}/` + (a ? `${a}/${b}` : b);
+}
+async function doDelete(key) {
+  await fetch(resourcePath(activeKind, key), {method: "DELETE"});
+}
+function showAdd() {
+  const t = document.getElementById("addText");
+  t.value = JSON.stringify(TEMPLATES[activeKind], null, 1);
+  document.getElementById("addPanel").style.display = "block";
+  document.getElementById("addMsg").textContent = "";
+}
+async function doAdd() {
+  const msg = document.getElementById("addMsg");
+  try {
+    const body = JSON.parse(document.getElementById("addText").value);
+    const r = await fetch(`/api/v1/resources/${activeKind}`, {
+      method: "POST", headers: {"Content-Type": "application/json"},
+      body: JSON.stringify(body)});
+    msg.textContent = r.ok ? "created" : `error ${r.status}: ${await r.text()}`;
+    if (r.ok) document.getElementById("addPanel").style.display = "none";
+  } catch (e) { msg.textContent = String(e); }
+}
+
+function toggle(id, onShow) {
+  const el = document.getElementById(id);
+  const show = el.style.display === "none";
+  el.style.display = show ? "block" : "none";
+  if (show && onShow) onShow();
+}
+async function loadConfig() {
+  const r = await fetch("/api/v1/schedulerconfiguration");
+  document.getElementById("configText").value = JSON.stringify(await r.json(), null, 1);
+  document.getElementById("configMsg").textContent = "";
+}
+async function applyConfig() {
+  const msg = document.getElementById("configMsg");
+  try {
+    const body = JSON.parse(document.getElementById("configText").value);
+    const r = await fetch("/api/v1/schedulerconfiguration", {
+      method: "POST", headers: {"Content-Type": "application/json"},
+      body: JSON.stringify(body)});
+    msg.textContent = r.ok ? "applied (kernel set recompiled)" : `rejected ${r.status}: ${await r.text()}`;
+  } catch (e) { msg.textContent = String(e); }
+}
+async function loadMetrics() {
+  const r = await fetch("/api/v1/metrics");
+  document.getElementById("metricsPre").textContent = JSON.stringify(await r.json(), null, 1);
 }
 
 async function doExport() {
@@ -127,10 +273,18 @@ async function doExport() {
   const a = document.createElement("a");
   a.href = URL.createObjectURL(blob); a.download = "snapshot.json"; a.click();
 }
+async function doImport(input) {
+  const file = input.files[0]; if (!file) return;
+  await fetch("/api/v1/import", {method: "POST", body: await file.text(),
+    headers: {"Content-Type": "application/json"}});
+  input.value = "";
+}
 async function doReset() {
   await fetch("/api/v1/reset", {method: "PUT"});
-  nodes.clear(); pods.clear(); render();
+  for (const k of KINDS) store[k].clear();
+  render();
 }
+render();
 watch();
 </script>
 </body>

@@ -155,3 +155,32 @@ def test_reserve_prebind_record_volume_binding():
     annos2 = store2.get("pods", "p0")["metadata"]["annotations"]
     assert json.loads(annos2[RESERVE_RESULT_KEY]) == {}
     assert json.loads(annos2[PRE_BIND_RESULT_KEY]) == {"VolumeBinding": "success"}
+
+
+def test_reason_dtype_grows_with_taint_vocab():
+    """TaintToleration's reason is a 1-based taint-vocabulary INDEX; the
+    engine's result-tensor downcast must widen with the vocabulary so a
+    large cluster's indices don't wrap (engine/core.py _result_dtypes)."""
+    import numpy as np
+
+    from ksim_tpu.engine.core import _Program, ScoredPlugin
+    from ksim_tpu.engine.profiles import default_plugins
+    from ksim_tpu.state.featurizer import Featurizer
+    from tests.helpers import make_node, make_pod
+
+    def eval_bits_dtype(n_taints):
+        nodes = []
+        for i in range(max(n_taints, 2)):
+            n = make_node(f"n{i}")
+            n["spec"]["taints"] = [
+                {"key": f"k{i}", "value": "v", "effect": "NoSchedule"}
+            ]
+            nodes.append(n)
+        feats = Featurizer().featurize(nodes, [], queue_pods=[make_pod("p")])
+        plugins = default_plugins(feats)
+        prog = _Program(tuple(plugins), "full")
+        bits_dtype, _final = prog._result_dtypes()
+        return np.dtype(bits_dtype)
+
+    assert eval_bits_dtype(4) == np.int8
+    assert eval_bits_dtype(200) == np.int16

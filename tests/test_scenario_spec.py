@@ -104,3 +104,50 @@ def test_merge_patch_rfc7386():
     target = {"a": {"b": 1, "c": 2}, "d": [1, 2]}
     patch = {"a": {"b": None, "e": 3}, "d": [9]}
     assert merge_patch(target, patch) == {"a": {"c": 2, "e": 3}, "d": [9]}
+
+
+def test_scheduler_simulation_document(tmp_path):
+    """KEP-184 one-shot run: simulator spec + scenario file -> status +
+    result file (keps/184-scheduler-simulation/README.md)."""
+    import json
+    import yaml
+
+    from ksim_tpu.scenario.simulation import run_scheduler_simulation
+
+    scenario_path = tmp_path / "scenario.yaml"
+    scenario_path.write_text(yaml.safe_dump(scenario_doc()))
+    result_path = tmp_path / "result.json"
+    doc = {
+        "kind": "SchedulerSimulation",
+        "metadata": {"name": "sim1"},
+        "spec": {
+            "simulator": {
+                "schedulerConfig": {"profiles": [{"plugins": {"multiPoint": {
+                    "disabled": [{"name": "InterPodAffinity"}]}}}]},
+                "recordMode": "full",
+            },
+            "scenarioTemplateFilePath": str(scenario_path),
+            "scenarioResultFilePath": str(result_path),
+        },
+    }
+    out = run_scheduler_simulation(doc)
+    assert out["status"]["phase"] == "Succeeded"
+    assert out["status"]["result"]["podsScheduled"] == 1
+    stored = json.loads(result_path.read_text())
+    assert stored["status"]["result"]["eventsApplied"] == 4
+
+
+def test_scheduler_simulation_failure_phase():
+    from ksim_tpu.scenario.simulation import run_scheduler_simulation
+
+    out = run_scheduler_simulation({
+        "spec": {
+            "scenario": {"spec": {"operations": [
+                {"step": 0, "deleteOperation": {
+                    "typeMeta": {"kind": "Node"},
+                    "objectMeta": {"name": "missing"}}},
+            ]}},
+        }
+    })
+    assert out["status"]["phase"] == "Failed"
+    assert "NotFound" in out["status"]["message"]

@@ -195,3 +195,40 @@ def test_metrics_and_ui(server):
     body = r.read().decode()
     c.close()
     assert r.status == 200 and "listwatchresources" in body
+
+
+def test_resource_crud_routes(server):
+    """Per-resource CRUD under /api/v1/resources — the role the KWOK
+    apiserver plays for the reference UI (web/api/v1/*.ts)."""
+    node = make_node("crud-n1", cpu="2")
+    status, created = _req(server, "POST", "/api/v1/resources/nodes", node)
+    assert status == 201 and created["metadata"]["resourceVersion"]
+    status, got = _req(server, "GET", "/api/v1/resources/nodes/crud-n1")
+    assert status == 200 and got["metadata"]["name"] == "crud-n1"
+    got["metadata"]["labels"] = {"zone": "a"}
+    status, updated = _req(server, "PUT", "/api/v1/resources/nodes/crud-n1", got)
+    assert status == 200 and updated["metadata"]["labels"] == {"zone": "a"}
+    status, listing = _req(server, "GET", "/api/v1/resources/nodes")
+    assert status == 200 and any(
+        n["metadata"]["name"] == "crud-n1" for n in listing["items"]
+    )
+    # Namespaced kind: pods default to the "default" namespace.
+    pod = make_pod("crud-p1", cpu="100m")
+    status, _ = _req(server, "POST", "/api/v1/resources/pods", pod)
+    assert status == 201
+    status, got = _req(server, "GET", "/api/v1/resources/pods/default/crud-p1")
+    assert status == 200
+    status, _ = _req(server, "DELETE", "/api/v1/resources/pods/default/crud-p1")
+    assert status == 200
+    status, _ = _req(server, "GET", "/api/v1/resources/pods/default/crud-p1")
+    assert status == 404
+    status, _ = _req(server, "DELETE", "/api/v1/resources/nodes/crud-n1")
+    assert status == 200
+    # Unknown kind and double-create conflict.
+    status, _ = _req(server, "GET", "/api/v1/resources/gadgets")
+    assert status == 404
+    status, _ = _req(server, "POST", "/api/v1/resources/nodes", make_node("c2"))
+    assert status == 201
+    status, _ = _req(server, "POST", "/api/v1/resources/nodes", make_node("c2"))
+    assert status == 409
+    _req(server, "DELETE", "/api/v1/resources/nodes/c2")
