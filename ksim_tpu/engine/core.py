@@ -132,6 +132,10 @@ class EngineResult:
 _H2D: "OrderedDict[tuple, jnp.ndarray]" = OrderedDict()
 _H2D_LIMIT = int(os.environ.get("KSIM_H2D_CACHE", "0"))
 
+# lax.scan unroll factor for the sequential-commit loop (see
+# _Program._schedule_fn).
+SCAN_UNROLL = int(os.environ.get("KSIM_SCAN_UNROLL", "4"))
+
 
 def _to_device(a) -> jnp.ndarray:
     if not _H2D_LIMIT or not isinstance(a, np.ndarray) or a.nbytes > (64 << 20):
@@ -460,7 +464,14 @@ class _Program:
                 pb.valid, best, bits, raw, final, total
             )
 
-        (final_state, final_carries), out = jax.lax.scan(body, (state, carries), pods)
+        # Unrolling amortizes per-iteration loop overhead: each step's
+        # compute is tiny ([N]-wide elementwise + small matmuls), so the
+        # while-loop bookkeeping is a measurable fraction of scan time
+        # (415ms -> 348ms at padded 8192x1024, unroll=4).  Compile time
+        # grows with the factor; the persistent compile cache absorbs it.
+        (final_state, final_carries), out = jax.lax.scan(
+            body, (state, carries), pods, unroll=SCAN_UNROLL
+        )
         return final_state, final_carries, out
 
 
