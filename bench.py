@@ -3,18 +3,38 @@
 Runs the full sequential-commit scheduling scan (every pod x node pair
 filtered AND scored by every enabled plugin, with capacity/topology commit
 between pods) and the one-shot record="full" batch evaluation (the
-product's recorded-results path), on whatever jax default backend is live
-(TPU under the driver), over a ladder of cluster sizes ending at the
-BASELINE config-4 shape (10k pods x 5k nodes).
+product's recorded-results path) over a ladder of cluster sizes ending at
+the BASELINE config-4 shape (10k pods x 5k nodes), plus the config-5
+50k-event churn replay.
 
 The headline runs in EXACT mode — x64 enabled, so the int64/float64
 scoring paths are active and final scores are bit-exact vs the upstream
-plugins (XLA emulates s64/f64 on TPU; verified by
-tests/tpu_parity_main.py on a real v5e).  Each rung also reports the
-float32 fast mode (documented ±1 rounding tolerance at integer-ratio
-boundaries) as ``sched_pairs_per_sec_f32``.
+plugins (XLA emulates s64/f64 on TPU; verified by tests/tpu_parity_main.py
+on a real v5e).  Each rung also reports the float32 fast mode (documented
+±1 rounding tolerance at integer-ratio boundaries) as
+``sched_pairs_per_sec_f32``.
 
-Each rung is isolated: a crash at one size still reports the others.
+Crash containment (the round-1/round-2 driver failures):
+
+- The parent process imports ONLY the stdlib — never jax.  On this image a
+  wedged TPU makes jax backend init block indefinitely even with
+  ``JAX_PLATFORMS=cpu`` (the axon sitecustomize on PYTHONPATH touches the
+  dead chip), so anything the parent must guarantee cannot depend on jax
+  importing.
+- The backend is probed in a subprocess under a hard watchdog.  If the
+  default (TPU) backend does not come up, the ladder falls back to CPU in
+  a sanitized environment (axon dropped from PYTHONPATH,
+  ``JAX_PLATFORMS=cpu``) so a recorded number exists under ANY chip state.
+- Every rung runs in its own subprocess with its own timeout: a TPU
+  worker kernel fault (the BENCH_r01.json crash) or a hang loses that one
+  rung, not the run.
+- The final JSON line is guaranteed: partial results are flushed to
+  ``bench_partial.json`` after every rung, and SIGTERM/SIGINT/atexit all
+  route to a print-once emitter, so an external ``timeout`` kill still
+  yields a parseable stdout line.
+- A wall-clock budget (``BENCH_BUDGET_S``, default 1500 s) stops new rungs
+  in time to emit the line before any external watchdog fires.
+
 Prints ONE JSON line with the headline metric (exact sequential-scan
 pairs/sec at the largest completed rung):
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N/50000, "rungs": {...}}
@@ -24,15 +44,55 @@ Baseline: >= 50k pairs/sec north star (BASELINE.json).
 from __future__ import annotations
 
 import argparse
+import atexit
 import json
+import os
+import signal
+import subprocess
 import sys
+import tempfile
 import time
 import traceback
 
 LADDER = [(1_000, 200), (5_000, 1_000), (10_000, 5_000)]
+CPU_LADDER = [(1_000, 200)]
+
+# Per-stage subprocess timeouts (seconds).  Cold XLA compiles of the
+# large-shape scan programs cost 5-60 s each; the persistent compile cache
+# (~/.cache/ksim_tpu/jax) makes reruns much faster.
+PROBE_TIMEOUT = 90
+RUNG_TIMEOUT = {"1000x200": 420, "5000x1000": 480, "10000x5000": 600}
+CPU_RUNG_TIMEOUT = 420
+CHURN_TIMEOUT = 900
+EMIT_RESERVE = 20  # seconds kept back for collection + emit
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def run_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
+# ---------------------------------------------------------------------------
+# Child payloads (these import jax; they only ever run in subprocesses).
+# ---------------------------------------------------------------------------
+
+
+def _child_setup() -> None:
+    import jax
+
+    from ksim_tpu.util import enable_compilation_cache
+
+    # One-time-per-machine XLA compiles, shared across rung subprocesses.
+    enable_compilation_cache()
+    # Exact mode for the headline: int64/float64 scoring paths active.
+    jax.config.update("jax_enable_x64", True)
+
+
+def child_probe() -> dict:
+    import jax
+
+    devs = jax.devices()
+    return {"platform": devs[0].platform, "device_count": len(devs)}
+
+
+def child_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
     import jax
 
     from ksim_tpu.engine import Engine
@@ -40,6 +100,7 @@ def run_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
     from ksim_tpu.state.featurizer import Featurizer
     from tests.helpers import random_cluster
 
+    _child_setup()
     t0 = time.perf_counter()
     nodes, pods = random_cluster(seed, n_nodes=n_nodes, n_pods=n_pods, bound_fraction=0.0)
     t1 = time.perf_counter()
@@ -50,11 +111,12 @@ def run_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
         f"P={feats.pods.valid.shape[0]} N={feats.nodes.padded} "
         f"on {jax.devices()[0].platform}",
         file=sys.stderr,
+        flush=True,
     )
     pairs = n_pods * n_nodes
 
     # Sequential-commit scan (the real scheduling semantics), exact mode
-    # (x64 active, set by main) — headline.
+    # (x64 active) — headline.
     eng = Engine(feats, default_plugins(feats), record="selection")
     eng.schedule()  # compile + warmup
     times = []
@@ -111,6 +173,7 @@ def run_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
         "batch_s": round(batch_s, 3),
         "pods_scheduled": n_sched,
         "exact": True,
+        "platform": jax.devices()[0].platform,
     }
     print(
         f"[{n_pods}x{n_nodes}] scan-exact {sched_s*1e3:.0f}ms "
@@ -118,35 +181,33 @@ def run_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
         f"scan-f32 {sched32_s*1e3:.0f}ms ({pairs/sched32_s/1e6:.2f}M pairs/s), "
         f"batch-full {batch_s*1e3:.0f}ms ({pairs/batch_s/1e6:.2f}M pairs/s)",
         file=sys.stderr,
+        flush=True,
     )
     return rung
 
 
-def run_churn(seed: int, n_nodes: int = 2_000, n_events: int = 50_000) -> dict:
+def child_churn(seed: int, n_nodes: int, n_events: int) -> dict:
     """BASELINE config 5: churn replay — rolling pod arrivals/completions
     + node drain/replace over the full default plugin set, sequential
     scheduling semantics per step.  Runs in float32 fast mode: this rung
-    measures end-to-end wall-clock over 500 scheduling passes, where the
-    x64-emulation overhead compounds ~10x (48 vs ~500 ev/s measured) —
-    score exactness is covered by the ladder rungs and the TPU parity
-    tier."""
+    measures end-to-end wall-clock over ~500 scheduling passes, where the
+    x64-emulation overhead compounds ~10x — score exactness is covered by
+    the ladder rungs and the TPU parity tier."""
     import jax
 
     from ksim_tpu.scenario import ScenarioRunner, churn_scenario
 
+    _child_setup()
     jax.config.update("jax_enable_x64", False)
-    try:
-        # Cap the per-pass pod batch and coarsen the pod bucket: the
-        # pending pool under saturation otherwise wanders through every
-        # power-of-two bucket up to 16384, and each new shape is another
-        # multi-second XLA compile (upstream schedules one pod per cycle;
-        # capping a batch just leaves the rest queued).
-        runner = ScenarioRunner(max_pods_per_pass=1024, pod_bucket_min=128)
-        res = runner.run(
-            churn_scenario(seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100)
-        )
-    finally:
-        jax.config.update("jax_enable_x64", True)
+    # Cap the per-pass pod batch and coarsen the pod bucket: the pending
+    # pool under saturation otherwise wanders through every power-of-two
+    # bucket up to 16384, and each new shape is another multi-second XLA
+    # compile (upstream schedules one pod per cycle; capping a batch just
+    # leaves the rest queued).
+    runner = ScenarioRunner(max_pods_per_pass=1024, pod_bucket_min=128)
+    res = runner.run(
+        churn_scenario(seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100)
+    )
     out = {
         "events": res.events_applied,
         "wall_s": round(res.wall_seconds, 1),
@@ -154,13 +215,177 @@ def run_churn(seed: int, n_nodes: int = 2_000, n_events: int = 50_000) -> dict:
         "pods_scheduled": res.pods_scheduled,
         "unschedulable_attempts": res.unschedulable_attempts,
         "steps": len(res.steps),
+        "platform": jax.devices()[0].platform,
     }
     print(
         f"[churn {n_events}ev/{n_nodes}n] {res.wall_seconds:.1f}s "
         f"({res.events_per_second:.0f} ev/s, {res.pods_scheduled} scheduled)",
         file=sys.stderr,
+        flush=True,
     )
     return out
+
+
+def _child_main(args: argparse.Namespace) -> None:
+    """Entry for --child invocations: run the payload, write its JSON to
+    --out (atomic rename), exit 0.  Any exception leaves a JSON error
+    record instead, so the parent can distinguish crash kinds."""
+    try:
+        if args.child == "probe":
+            out = child_probe()
+        elif args.child == "rung":
+            out = child_rung(args.pods, args.nodes, args.seed, args.repeats)
+        elif args.child == "churn":
+            out = child_churn(args.seed, args.churn_nodes, args.churn_events)
+        else:  # pragma: no cover
+            raise ValueError(f"unknown child mode {args.child!r}")
+    except BaseException:
+        traceback.print_exc(file=sys.stderr)
+        out = {"error": traceback.format_exc(limit=1).strip().splitlines()[-1]}
+        _write_json(args.out, out)
+        sys.exit(1)
+    _write_json(args.out, out)
+
+
+def _write_json(path: str, obj: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Parent orchestrator (stdlib only — never imports jax).
+# ---------------------------------------------------------------------------
+
+
+def _sanitized_env() -> dict:
+    """CPU-fallback environment: drop the axon TPU sitecustomize from
+    PYTHONPATH (it blocks on a dead chip even under JAX_PLATFORMS=cpu) and
+    force the CPU backend.  Single source of truth lives in tests.helpers
+    (stdlib-only, safe for this jax-free parent)."""
+    sys.path.insert(0, _REPO)
+    try:
+        from tests.helpers import sanitized_cpu_env
+    finally:
+        sys.path.pop(0)
+    return sanitized_cpu_env()
+
+
+class _Orchestrator:
+    def __init__(self, budget_s: float) -> None:
+        self.t0 = time.monotonic()
+        self.budget_s = budget_s
+        self.payload: dict = {
+            "metric": "sched_pairs_per_sec",
+            "value": 0,
+            "unit": (
+                "pod-node pairs/s (sequential-commit scan, bit-exact "
+                "finalscore mode, largest completed rung)"
+            ),
+            "vs_baseline": 0.0,
+            "platform": None,
+            "rungs": {},
+        }
+        self._emitted = False
+        self._child: subprocess.Popen | None = None
+        atexit.register(self.emit)
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, self._on_signal)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _on_signal(self, signum, _frame) -> None:
+        print(f"bench: caught signal {signum}, emitting partial results", file=sys.stderr)
+        if self._child is not None and self._child.poll() is None:
+            _kill_tree(self._child)
+        self.payload.setdefault("interrupted", signal.Signals(signum).name)
+        self.emit()
+        os._exit(0)
+
+    def remaining(self) -> float:
+        return self.budget_s - (time.monotonic() - self.t0) - EMIT_RESERVE
+
+    def emit(self) -> None:
+        if self._emitted:
+            return
+        self._emitted = True
+        rungs = self.payload["rungs"]
+        headline = 0
+        for key, r in rungs.items():
+            if key != "churn" and isinstance(r, dict) and "sched_pairs_per_sec" in r:
+                headline = r["sched_pairs_per_sec"]
+        self.payload["value"] = headline
+        self.payload["vs_baseline"] = round(headline / 50_000, 2)
+        line = json.dumps(self.payload)
+        print(line, flush=True)
+        try:
+            _write_json(os.path.join(_REPO, "bench_partial.json"), self.payload)
+        except OSError:
+            pass
+
+    def flush_partial(self) -> None:
+        try:
+            _write_json(os.path.join(_REPO, "bench_partial.json"), self.payload)
+        except OSError:
+            pass
+
+    # -- subprocess driver -------------------------------------------------
+
+    def run_child(self, mode: str, extra: list[str], env: dict, timeout: float) -> dict:
+        """Run one child payload under a watchdog; returns its JSON result
+        or an {"error": ...} record.  Never raises."""
+        timeout = min(timeout, max(self.remaining(), 5))
+        fd, out_path = tempfile.mkstemp(prefix=f"bench_{mode}_", suffix=".json")
+        os.close(fd)
+        os.unlink(out_path)
+        cmd = [
+            sys.executable,
+            os.path.abspath(__file__),
+            "--child",
+            mode,
+            "--out",
+            out_path,
+            *extra,
+        ]
+        try:
+            self._child = subprocess.Popen(
+                cmd, cwd=_REPO, env=env, start_new_session=True
+            )
+            try:
+                rc = self._child.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                _kill_tree(self._child)
+                return {"error": f"timeout after {timeout:.0f}s"}
+        except OSError as e:
+            # fork/spawn failure on a degraded host: record, keep going.
+            return {"error": f"spawn failed: {e}"}
+        finally:
+            self._child = None
+        try:
+            with open(out_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"error": f"child exited rc={rc} with no result"}
+        finally:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+
+
+def _kill_tree(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        try:
+            proc.kill()
+        except ProcessLookupError:
+            pass
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        pass
 
 
 def main() -> None:
@@ -170,57 +395,95 @@ def main() -> None:
     ap.add_argument("--only", type=str, default="", help="pods x nodes, e.g. 10000x5000")
     ap.add_argument("--skip-churn", action="store_true")
     ap.add_argument("--churn-events", type=int, default=50_000)
+    ap.add_argument("--churn-nodes", type=int, default=2_000)
+    try:
+        default_budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    except ValueError:
+        default_budget = 1500.0
+    ap.add_argument(
+        "--budget",
+        type=float,
+        default=default_budget,
+        help="wall-clock budget (s); rungs stop in time to emit the JSON line",
+    )
+    # Internal: subprocess payload modes.
+    ap.add_argument("--child", choices=["probe", "rung", "churn"], default=None)
+    ap.add_argument("--pods", type=int, default=0)
+    ap.add_argument("--nodes", type=int, default=0)
+    ap.add_argument("--out", type=str, default="")
     args = ap.parse_args()
 
-    import jax
+    if args.child:
+        _child_main(args)
+        return
 
-    from ksim_tpu.util import enable_compilation_cache
+    orch = _Orchestrator(args.budget)
+    payload = orch.payload
 
-    # One-time-per-machine XLA compiles (the large-shape scan programs
-    # cost 5-60s each to build; the bench is otherwise compile-dominated).
-    enable_compilation_cache()
-    # Exact mode for the headline: int64/float64 scoring paths active.
-    jax.config.update("jax_enable_x64", True)
+    # Backend probe: default env (TPU under the driver) first, CPU-fallback
+    # sanitized env second.  Probing runs in subprocesses because jax
+    # backend init can block indefinitely on a wedged chip.
+    env = dict(os.environ)
+    probe = orch.run_child("probe", [], env, PROBE_TIMEOUT)
+    fallback = False
+    if "error" in probe:
+        payload["probe_error"] = probe["error"]
+        print(f"bench: default backend probe failed ({probe['error']}); "
+              "falling back to CPU", file=sys.stderr)
+        env = _sanitized_env()
+        probe = orch.run_child("probe", [], env, 60)
+        fallback = True
+        if "error" in probe:
+            payload["error"] = f"no usable backend: {probe['error']}"
+            orch.emit()
+            return
+    payload["platform"] = probe.get("platform")
+    payload["fallback_cpu"] = fallback
+    print(f"bench: backend={probe.get('platform')} "
+          f"devices={probe.get('device_count')} fallback={fallback}",
+          file=sys.stderr)
 
-    ladder = LADDER
+    ladder = CPU_LADDER if fallback else LADDER
     if args.only:
         p, n = args.only.lower().split("x")
         ladder = [(int(p), int(n))]
 
-    rungs: dict[str, dict] = {}
-    headline = None
+    common = ["--seed", str(args.seed), "--repeats", str(args.repeats)]
     for n_pods, n_nodes in ladder:
         key = f"{n_pods}x{n_nodes}"
-        try:
-            rungs[key] = run_rung(n_pods, n_nodes, args.seed, args.repeats)
-            headline = rungs[key]["sched_pairs_per_sec"]
-        except Exception:
-            traceback.print_exc(file=sys.stderr)
-            rungs[key] = {"error": traceback.format_exc(limit=1).strip().splitlines()[-1]}
+        cap = CPU_RUNG_TIMEOUT if fallback else RUNG_TIMEOUT.get(key, 600)
+        if orch.remaining() < 30:
+            payload["rungs"][key] = {"error": "skipped: budget exhausted"}
+            continue
+        payload["rungs"][key] = orch.run_child(
+            "rung", ["--pods", str(n_pods), "--nodes", str(n_nodes), *common], env, cap
+        )
+        orch.flush_partial()
 
     if not args.skip_churn and not args.only:
-        try:
-            rungs["churn"] = run_churn(args.seed, n_events=args.churn_events)
-        except Exception:
-            traceback.print_exc(file=sys.stderr)
-            rungs["churn"] = {"error": traceback.format_exc(limit=1).strip().splitlines()[-1]}
+        churn_events = args.churn_events
+        churn_nodes = args.churn_nodes
+        if fallback:
+            # CPU can't chew 50k events inside the budget; a reduced replay
+            # still exercises the full dynamic-state path.
+            churn_events = min(churn_events, 2_000)
+            churn_nodes = min(churn_nodes, 500)
+        if orch.remaining() < 60:
+            payload["rungs"]["churn"] = {"error": "skipped: budget exhausted"}
+        else:
+            payload["rungs"]["churn"] = orch.run_child(
+                "churn",
+                [
+                    "--seed", str(args.seed),
+                    "--churn-events", str(churn_events),
+                    "--churn-nodes", str(churn_nodes),
+                ],
+                env,
+                CHURN_TIMEOUT,
+            )
+            orch.flush_partial()
 
-    value = headline or 0
-    print(
-        json.dumps(
-            {
-                "metric": "sched_pairs_per_sec",
-                "value": value,
-                "unit": (
-                    "pod-node pairs/s (sequential-commit scan, bit-exact "
-                    "finalscore mode, largest completed rung)"
-                ),
-                "vs_baseline": round(value / 50_000, 2),
-                "platform": jax.devices()[0].platform,
-                "rungs": rungs,
-            }
-        )
-    )
+    orch.emit()
 
 
 if __name__ == "__main__":

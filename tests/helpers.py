@@ -2,10 +2,36 @@
 
 from __future__ import annotations
 
+import os
 import random
 from typing import Any
 
 JSON = dict[str, Any]
+
+
+def sanitized_cpu_env(extra: dict[str, str] | None = None) -> dict[str, str]:
+    """Subprocess environment for CPU smoke tests.
+
+    Drops the axon TPU sitecustomize from PYTHONPATH — on this image it
+    blocks on a wedged chip during jax backend init even under
+    ``JAX_PLATFORMS=cpu`` — and pins the CPU backend, so entrypoint
+    subprocess tests stay hermetic under any hardware condition.  Only
+    ``tests/test_tpu_parity.py`` deliberately keeps the axon path (it
+    wants the real chip, behind its own watchdog)."""
+    env = dict(os.environ)
+    pp = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in os.path.basename(p.rstrip("/"))
+    ]
+    if pp:
+        env["PYTHONPATH"] = os.pathsep.join(pp)
+    else:
+        env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if extra:
+        env.update(extra)
+    return env
 
 
 def make_node(

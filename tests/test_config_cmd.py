@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import json
-import os
 import subprocess
 import sys
 from pathlib import Path
@@ -12,7 +11,7 @@ import pytest
 
 from ksim_tpu.config import load_config
 from ksim_tpu.errors import InvalidConfigError
-from tests.helpers import make_node, make_pod
+from tests.helpers import make_node, make_pod, sanitized_cpu_env
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -66,16 +65,15 @@ def test_import_modes_mutually_exclusive(tmp_path, clean_env):
 
 
 def _run_cmd(args, timeout=120):
-    env = dict(os.environ)
-    # CPU is plenty for entrypoint smoke tests.
-    env["JAX_PLATFORMS"] = "cpu"
+    # CPU is plenty for entrypoint smoke tests; sanitized_cpu_env keeps the
+    # subprocess off the TPU plugin path so a wedged chip can't hang it.
     return subprocess.run(
         [sys.executable, "-m", *args],
         capture_output=True,
         text=True,
         timeout=timeout,
         cwd=REPO,
-        env=env,
+        env=sanitized_cpu_env(),
     )
 
 

