@@ -57,17 +57,30 @@ def start_simulator(argv: list[str] | None = None) -> int:
     )
 
     syncer = None
+    kube_source = None
     if cfg.external_import_enabled or cfg.resource_sync_enabled:
-        with open(cfg.external_snapshot_path) as f:
-            snap_data = json.load(f)
-        source = ClusterStore()
-        SnapshotService(source).load(snap_data, ignore_err=True)
-        if cfg.external_import_enabled:
-            OneShotImporter(
-                di.snapshot_service, SnapshotService(source)
-            ).import_cluster_resources(cfg.resource_import_label_selector)
+        if cfg.kube_config:
+            # Live kube-apiserver source (reference cmd/simulator/
+            # simulator.go:59-71 builds external clients from kubeConfig).
+            from ksim_tpu.syncer.kubeapi import KubeApiSource
+
+            kube_source = KubeApiSource.from_kubeconfig(cfg.kube_config)
+            export_side: object = kube_source
+            sync_source: object = kube_source
         else:
-            syncer = Syncer(source, di.store).run()
+            # Static snapshot-file source.
+            with open(cfg.external_snapshot_path) as f:
+                snap_data = json.load(f)
+            file_store = ClusterStore()
+            SnapshotService(file_store).load(snap_data, ignore_err=True)
+            export_side = SnapshotService(file_store)
+            sync_source = file_store
+        if cfg.external_import_enabled:
+            OneShotImporter(di.snapshot_service, export_side).import_cluster_resources(
+                cfg.resource_import_label_selector
+            )
+        else:
+            syncer = Syncer(sync_source, di.store).run()
 
     if args.profile_dir:
         di.scheduler_service.start_profiling(args.profile_dir)
@@ -95,6 +108,8 @@ def start_simulator(argv: list[str] | None = None) -> int:
         di.scheduler_service.stop_profiling()
         if syncer is not None:
             syncer.stop()
+        if kube_source is not None:
+            kube_source.close()
         di.shutdown()
     return 0
 

@@ -33,6 +33,7 @@ class SimulatorConfig:
     external_import_enabled: bool = False
     resource_sync_enabled: bool = False
     external_snapshot_path: str = ""
+    kube_config: str = ""  # live-cluster source (reference config.go:88-114)
     resource_import_label_selector: JSON | None = None
     initial_scheduler_cfg: JSON = field(default_factory=dict)
 
@@ -83,16 +84,22 @@ def load_config(path: str | None = None) -> SimulatorConfig:
     snap_path = os.environ.get("EXTERNAL_SNAPSHOT_PATH") or raw.get(
         "externalSnapshotPath", ""
     )
+    kube_config = os.environ.get("KUBE_CONFIG") or raw.get("kubeConfig", "")
     if ext_import and sync:
         # Reference: mutually exclusive (config.go:88-90).
         raise InvalidConfigError(
             "externalImportEnabled and resourceSyncEnabled cannot be used "
             "simultaneously"
         )
-    if (ext_import or sync) and not snap_path:
+    if (ext_import or sync) and not (snap_path or kube_config):
         raise InvalidConfigError(
-            "externalSnapshotPath must be set when external import or "
-            "resource sync is enabled"
+            "externalSnapshotPath or kubeConfig must be set when external "
+            "import or resource sync is enabled"
+        )
+    if (ext_import or sync) and snap_path and kube_config:
+        raise InvalidConfigError(
+            "externalSnapshotPath and kubeConfig are mutually exclusive "
+            "import sources"
         )
 
     sched_cfg: JSON = {}
@@ -108,6 +115,7 @@ def load_config(path: str | None = None) -> SimulatorConfig:
         external_import_enabled=ext_import,
         resource_sync_enabled=sync,
         external_snapshot_path=snap_path,
+        kube_config=kube_config,
         resource_import_label_selector=raw.get("resourceImportLabelSelector"),
         initial_scheduler_cfg=sched_cfg,
     )

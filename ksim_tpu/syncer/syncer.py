@@ -223,7 +223,13 @@ class Syncer:
         # Subscribe BEFORE listing so nothing between list and watch is
         # lost; duplicate ADDED events collapse through apply().
         stream = self._source.watch(self._kinds)
-        self.sync_once()
+        try:
+            self.sync_once()
+        except BaseException:
+            # A network-backed source's initial LIST can fail; the stream
+            # already started reader threads that must not outlive us.
+            stream.close()
+            raise
         self._stop.clear()
 
         def loop() -> None:

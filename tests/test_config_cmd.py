@@ -25,6 +25,7 @@ def clean_env(monkeypatch):
         "EXTERNAL_IMPORT_ENABLED",
         "RESOURCE_SYNC_ENABLED",
         "EXTERNAL_SNAPSHOT_PATH",
+        "KUBE_CONFIG",
     ):
         monkeypatch.delenv(k, raising=False)
     return monkeypatch
@@ -62,6 +63,18 @@ def test_import_modes_mutually_exclusive(tmp_path, clean_env):
     cfg_file.write_text("port: 1212\nexternalImportEnabled: true\n")
     with pytest.raises(InvalidConfigError):
         load_config(str(cfg_file))  # import without a source
+    # kubeConfig is an alternative source (reference config.go:88-114)...
+    cfg_file.write_text(
+        "port: 1212\nresourceSyncEnabled: true\nkubeConfig: /tmp/kc.yaml\n"
+    )
+    assert load_config(str(cfg_file)).kube_config == "/tmp/kc.yaml"
+    # ...but not alongside a snapshot file.
+    cfg_file.write_text(
+        "port: 1212\nexternalImportEnabled: true\nkubeConfig: /tmp/kc.yaml\n"
+        "externalSnapshotPath: /tmp/x.json\n"
+    )
+    with pytest.raises(InvalidConfigError):
+        load_config(str(cfg_file))
 
 
 def _run_cmd(args, timeout=120):
