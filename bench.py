@@ -254,6 +254,14 @@ def _write_json(path: str, obj: dict) -> None:
     os.replace(tmp, path)
 
 
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 # ---------------------------------------------------------------------------
 # Parent orchestrator (stdlib only — never imports jax).
 # ---------------------------------------------------------------------------
@@ -309,7 +317,6 @@ class _Orchestrator:
     def emit(self) -> None:
         if self._emitted:
             return
-        self._emitted = True
         rungs = self.payload["rungs"]
         headline = 0
         for key, r in rungs.items():
@@ -317,8 +324,13 @@ class _Orchestrator:
                 headline = r["sched_pairs_per_sec"]
         self.payload["value"] = headline
         self.payload["vs_baseline"] = round(headline / 50_000, 2)
-        line = json.dumps(self.payload)
-        print(line, flush=True)
+        # The leading newline terminates any partially-written line if a
+        # signal interrupted an in-flight print; the flag flips only AFTER
+        # the line is out, so a signal handler re-entering emit() mid-print
+        # re-prints a complete line rather than silently losing it.
+        sys.stdout.write("\n" + json.dumps(self.payload) + "\n")
+        sys.stdout.flush()
+        self._emitted = True
         try:
             _write_json(os.path.join(_REPO, "bench_partial.json"), self.payload)
         except OSError:
@@ -349,29 +361,37 @@ class _Orchestrator:
             *extra,
         ]
         try:
-            self._child = subprocess.Popen(
-                cmd, cwd=_REPO, env=env, start_new_session=True
-            )
             try:
-                rc = self._child.wait(timeout=timeout)
-            except subprocess.TimeoutExpired:
-                _kill_tree(self._child)
-                return {"error": f"timeout after {timeout:.0f}s"}
-        except OSError as e:
-            # fork/spawn failure on a degraded host: record, keep going.
-            return {"error": f"spawn failed: {e}"}
+                self._child = subprocess.Popen(
+                    cmd, cwd=_REPO, env=env, start_new_session=True
+                )
+                try:
+                    rc = self._child.wait(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    _kill_tree(self._child)
+                    # The child may have finished its write just as the
+                    # watchdog fired — a complete result beats a timeout
+                    # error record.
+                    late = _read_json(out_path)
+                    if late is not None:
+                        late["late_after_timeout"] = True
+                        return late
+                    return {"error": f"timeout after {timeout:.0f}s"}
+            except OSError as e:
+                # fork/spawn failure on a degraded host: record, keep going.
+                return {"error": f"spawn failed: {e}"}
+            finally:
+                self._child = None
+            result = _read_json(out_path)
+            if result is None:
+                return {"error": f"child exited rc={rc} with no result"}
+            return result
         finally:
-            self._child = None
-        try:
-            with open(out_path) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return {"error": f"child exited rc={rc} with no result"}
-        finally:
-            try:
-                os.unlink(out_path)
-            except OSError:
-                pass
+            for p in (out_path, out_path + ".tmp"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
 
 
 def _kill_tree(proc: subprocess.Popen) -> None:

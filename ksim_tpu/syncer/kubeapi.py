@@ -127,8 +127,11 @@ def load_kubeconfig(path: str, context: str | None = None) -> dict[str, Any]:
 
     token = user.get("token")
     if not token and user.get("tokenFile"):
-        with open(os.path.expanduser(user["tokenFile"])) as f:
-            token = f.read().strip()
+        try:
+            with open(os.path.expanduser(user["tokenFile"])) as f:
+                token = f.read().strip()
+        except OSError as e:
+            raise InvalidConfigError(f"kubeconfig {path!r}: tokenFile: {e}") from None
     if token:
         headers["Authorization"] = f"Bearer {token}"
     elif user.get("username") is not None:
@@ -137,48 +140,58 @@ def load_kubeconfig(path: str, context: str | None = None) -> dict[str, Any]:
 
     ssl_context: ssl.SSLContext | None = None
     if server.startswith("https"):
-        ssl_context = ssl.create_default_context()
-        if cluster.get("insecure-skip-tls-verify"):
-            ssl_context.check_hostname = False
-            ssl_context.verify_mode = ssl.CERT_NONE
-        elif cluster.get("certificate-authority-data"):
-            ssl_context.load_verify_locations(
-                cadata=base64.b64decode(cluster["certificate-authority-data"]).decode()
-            )
-        elif cluster.get("certificate-authority"):
-            ssl_context.load_verify_locations(
-                cafile=os.path.expanduser(cluster["certificate-authority"])
-            )
-        cert = user.get("client-certificate")
-        key = user.get("client-key")
-        # Inline -data material goes through short-lived temp files only
-        # because load_cert_chain requires paths; it reads them eagerly,
-        # so they are unlinked before returning — the decoded private key
-        # never outlives this call on disk.
-        temp_files: list[str] = []
         try:
-            if user.get("client-certificate-data"):
-                cert = _b64_to_tempfile(user["client-certificate-data"], ".crt")
-                temp_files.append(cert)
-            if user.get("client-key-data"):
-                key = _b64_to_tempfile(user["client-key-data"], ".key")
-                temp_files.append(key)
-            if cert and key:
-                ssl_context.load_cert_chain(
-                    os.path.expanduser(cert), os.path.expanduser(key)
-                )
-        finally:
-            for p in temp_files:
-                try:
-                    os.unlink(p)
-                except OSError:
-                    pass
+            ssl_context = _build_ssl_context(path, cluster, user)
+        except (OSError, ssl.SSLError) as e:
+            # Missing/garbled CA or client-cert files surface as config
+            # errors, per this function's contract.
+            raise InvalidConfigError(f"kubeconfig {path!r}: TLS material: {e}") from None
 
     return {
         "server": server,
         "headers": headers,
         "ssl_context": ssl_context,
     }
+
+
+def _build_ssl_context(path: str, cluster: dict, user: dict) -> ssl.SSLContext:
+    ssl_context = ssl.create_default_context()
+    if cluster.get("insecure-skip-tls-verify"):
+        ssl_context.check_hostname = False
+        ssl_context.verify_mode = ssl.CERT_NONE
+    elif cluster.get("certificate-authority-data"):
+        ssl_context.load_verify_locations(
+            cadata=base64.b64decode(cluster["certificate-authority-data"]).decode()
+        )
+    elif cluster.get("certificate-authority"):
+        ssl_context.load_verify_locations(
+            cafile=os.path.expanduser(cluster["certificate-authority"])
+        )
+    cert = user.get("client-certificate")
+    key = user.get("client-key")
+    # Inline -data material goes through short-lived temp files only
+    # because load_cert_chain requires paths; it reads them eagerly, so
+    # they are unlinked before returning — the decoded private key never
+    # outlives this call on disk.
+    temp_files: list[str] = []
+    try:
+        if user.get("client-certificate-data"):
+            cert = _b64_to_tempfile(user["client-certificate-data"], ".crt")
+            temp_files.append(cert)
+        if user.get("client-key-data"):
+            key = _b64_to_tempfile(user["client-key-data"], ".key")
+            temp_files.append(key)
+        if cert and key:
+            ssl_context.load_cert_chain(
+                os.path.expanduser(cert), os.path.expanduser(key)
+            )
+    finally:
+        for p in temp_files:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    return ssl_context
 
 
 class KubeApiSource:
@@ -306,13 +319,24 @@ class KubeWatchStream:
 
     def close(self) -> None:
         self._stop.set()
-        for resp in list(self._responses.values()):
-            try:
-                resp.close()  # unblocks a reader parked in readline()
-            except Exception:
-                pass
-        for t in self._threads:
-            t.join(timeout=5)
+        # Close-and-join in a sweep loop: a reader that was mid-reconnect
+        # registers its response AFTER the first sweep, so keep closing
+        # whatever appears while the joins drain.  A reader blocked inside
+        # urlopen() itself cannot be interrupted (daemon thread; it
+        # notices _stop as soon as the connect returns and closes its own
+        # response before exiting — see _run_kind).
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            for resp in list(self._responses.values()):
+                try:
+                    resp.close()  # unblocks a reader parked in readline()
+                except Exception:
+                    pass
+            alive = [t for t in self._threads if t.is_alive()]
+            if not alive:
+                break
+            for t in alive:
+                t.join(timeout=0.2)
 
     # -- reader side ---------------------------------------------------------
 
@@ -366,6 +390,10 @@ class KubeWatchStream:
                 resp = self._source._open(path, query, WATCH_TIMEOUT_S + 30)
                 self._responses[kind] = resp
                 try:
+                    # close() may have swept before we registered; don't
+                    # park on a stream nobody will close again.
+                    if self._stop.is_set():
+                        return
                     for line in resp:
                         if self._stop.is_set():
                             return

@@ -1,0 +1,287 @@
+"""Hand-derived parity fixtures from upstream kube-scheduler v1.30 formulas.
+
+These expected values were computed BY HAND from the upstream plugin
+algorithms (files cited per block), with every arithmetic step documented
+— NOT by running the repo's oracle or kernels.  They exist to break the
+oracle-validates-kernel circularity: tests/test_upstream_fixtures.py
+asserts that the pure-Python oracle AND the JAX kernels both reproduce
+these independently-derived numbers.  If either implementation
+mis-derives an upstream formula, it now disagrees with a number computed
+straight from the formula's definition rather than with its twin.
+
+Sources are unavailable to vendoring in this environment, so scenarios
+are original (not copies of upstream test tables), but each follows the
+canonical shapes those tables exercise.  Float-sensitive expectations
+were evaluated with IEEE-754 float64 arithmetic (identical in Go and
+Python); integer expectations use the upstream integer division order.
+"""
+
+from __future__ import annotations
+
+MB = 1024 * 1024
+GI = 1024 * 1024 * 1024
+
+# Upstream nonzero.go: GetNonzeroRequests defaults when a pod declares no
+# request for the resource (DefaultMilliCPURequest / DefaultMemoryRequest).
+NONZERO_CPU_MILLI = 100
+NONZERO_MEMORY = 200 * MB
+
+# ---------------------------------------------------------------------------
+# NodeResourcesBalancedAllocation
+# (pkg/scheduler/framework/plugins/noderesources/balanced_allocation.go,
+#  balancedResourceScorer): fraction_r = requested_r / allocatable_r
+# (clamped to 1), std = |f_cpu - f_mem| / 2 for two resources, score =
+# int64((1 - std) * 100) — float64 arithmetic throughout.
+#
+# Node quantities: cpu in milli, memory in bytes.  Pods declare explicit
+# requests unless noted (the no-request case exercises the nonzero
+# defaults above).
+# ---------------------------------------------------------------------------
+
+BALANCED_ALLOCATION_CASES = [
+    {
+        # f_cpu = 3000/4000 = 0.75, f_mem = 5000/10000 = 0.5
+        # std = |0.75 - 0.5| / 2 = 0.125 -> int((1 - 0.125) * 100) = 87
+        "name": "skewed-cpu",
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "want": 87,
+    },
+    {
+        # f_cpu = 3000/6000 = 0.5, f_mem = 0.5 -> std 0 -> 100
+        "name": "perfectly-balanced",
+        "node_cpu_milli": 6000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "want": 100,
+    },
+    {
+        # f_cpu = 0.5, f_mem = 0.4 -> std = 0.05
+        # float64: (1 - 0.05) * 100 = 95.00000000000001 -> 95
+        "name": "small-skew-float64-rounding",
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 2000,
+        "pod_mem": 4000,
+        "want": 95,
+    },
+    {
+        # No requests -> nonzero defaults 100m / 200Mi on a 1-CPU / 1-Gi
+        # node: f_cpu = 100/1000 = 0.1, f_mem = 200Mi/1Gi = 0.1953125
+        # std = 0.04765625 -> int(95.234375) = 95
+        "name": "nonzero-defaults",
+        "node_cpu_milli": 1000,
+        "node_mem": GI,
+        "pod_cpu_milli": None,
+        "pod_mem": None,
+        "want": 95,
+    },
+]
+
+# ---------------------------------------------------------------------------
+# NodeResourcesFit score = LeastAllocated
+# (noderesources/resource_allocation.go + least_allocated.go,
+#  leastResourceScorer): per resource (weight 1 each for cpu/memory):
+#    score_r = ((allocatable - requested) * 100) / allocatable   [int64 div]
+#    0 when requested > allocatable
+#  node score = sum(score_r * w_r) / sum(w_r)                    [int64 div]
+# ---------------------------------------------------------------------------
+
+LEAST_ALLOCATED_CASES = [
+    {
+        # cpu (4000-1000)*100/4000 = 75; mem (10000-2000)*100/10000 = 80
+        # (75 + 80) / 2 = 77  [integer division]
+        "name": "light-load",
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 1000,
+        "pod_mem": 2000,
+        "want": 77,
+    },
+    {
+        # cpu (1000*100)/4000 = 25; mem (5000*100)/10000 = 50 -> 75/2 = 37
+        "name": "heavy-load",
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "want": 37,
+    },
+    {
+        # cpu requested 5000 > allocatable 4000 -> 0; mem 50 -> 50/2 = 25
+        "name": "over-requested-cpu-scores-zero",
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 5000,
+        "pod_mem": 5000,
+        "want": 25,
+    },
+    {
+        # nonzero defaults on 1 CPU / 1 Gi:
+        # cpu (1000-100)*100/1000 = 90
+        # mem (1073741824-209715200)*100/1073741824 = 86402662400/1073741824
+        #     = 80  [floor of 80.468...]
+        # (90 + 80) / 2 = 85
+        "name": "nonzero-defaults",
+        "node_cpu_milli": 1000,
+        "node_mem": GI,
+        "pod_cpu_milli": None,
+        "pod_mem": None,
+        "want": 85,
+    },
+]
+
+# ---------------------------------------------------------------------------
+# TaintToleration score
+# (tainttoleration/taint_toleration.go): raw score = count of the node's
+# PreferNoSchedule taints the pod does NOT tolerate; NormalizeScore =
+# helper.DefaultNormalizeScore(100, reverse=true):
+#    max = max(raw); normalized_i = 100 - (100 * raw_i / max)  [int64 div]
+#    (all 100 when max == 0)
+# ---------------------------------------------------------------------------
+
+# Node i carries i PreferNoSchedule taints, pod tolerates none:
+# raw = [0, 1, 2]; max = 2
+# normalized = [100 - 0, 100 - 100*1/2, 100 - 100*2/2] = [100, 50, 0]
+TAINT_PREFER_COUNTS = [0, 1, 2]
+TAINT_EXPECT_RAW = [0, 1, 2]
+TAINT_EXPECT_NORMALIZED = [100, 50, 0]
+
+# ---------------------------------------------------------------------------
+# ImageLocality
+# (imagelocality/image_locality.go): for each container whose image the
+# node holds: scaledImageScore = int64(size * (numNodesWithImage /
+# totalNumNodes)); sumScores over containers; then calculatePriority:
+#    minThreshold = 23 MB, maxThreshold = 1000 MB * numContainers
+#    clamped = clamp(sumScores, minThreshold, maxThreshold)
+#    score = int64(100 * (clamped - minThreshold) / (maxThreshold - minThreshold))
+# ---------------------------------------------------------------------------
+
+IMAGE_LOCALITY_CASES = [
+    {
+        # 2 nodes; only node-a holds img-big (300 MB, numNodes=1):
+        # scaled = int(300MB * 1/2) = 150 MB
+        # node-a: 100 * (150-23)MB / (1000-23)MB = 12700/977 = 12.99 -> 12
+        # node-b: sum 0 -> clamps to minThreshold -> 0
+        "name": "single-container-half-spread",
+        "images": {"img-big": {"size": 300 * MB, "on": ["node-a"]}},
+        "pod_images": ["img-big"],
+        "want": {"node-a": 12, "node-b": 0},
+    },
+    {
+        # img-everywhere 500 MB on both nodes (numNodes=2, scaled 500 MB),
+        # img-rare 200 MB only on node-a (scaled 100 MB); 2 containers:
+        # node-a: sum = 600 MB; maxThreshold = 2000 MB
+        #   100 * (600-23) / (2000-23) = 57700/1977 = 29.18 -> 29
+        # node-b: sum = 500 MB -> 100 * 477/1977 = 24.12 -> 24
+        "name": "two-containers-mixed-spread",
+        "images": {
+            "img-everywhere": {"size": 500 * MB, "on": ["node-a", "node-b"]},
+            "img-rare": {"size": 200 * MB, "on": ["node-a"]},
+        },
+        "pod_images": ["img-everywhere", "img-rare"],
+        "want": {"node-a": 29, "node-b": 24},
+    },
+]
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread filter (podtopologyspread/filtering.go):
+# For each DoNotSchedule constraint: matchNum(domain) = count of existing
+# pods matching the labelSelector in that topology domain;
+# minMatch = min over all domains present among eligible nodes;
+# candidate node violates iff matchNum(node's domain) + 1 - minMatch > maxSkew.
+# Nodes missing the topology key always fail that constraint.
+#
+# The incoming pod is itself labeled foo=bar, so selfMatchNum = 1
+# (upstream filtering.go: skew = matchNum + selfMatchNum - minMatchNum).
+#
+# Topology: zone1 = {node-a, node-b}, zone2 = {node-x, node-y}; every node
+# also has its own hostname label.  Existing pods labeled foo=bar: 2 on
+# node-a, 0 elsewhere.
+#
+# zone-only constraint (maxSkew=1): domains zone1=2, zone2=0, min=0
+#   node-a/node-b: 2+1-0 = 3 > 1 -> violate; node-x/node-y: 0+1-0 = 1 -> ok
+# hostname-only constraint (maxSkew=1): domains a=2 b=0 x=0 y=0, min=0
+#   node-a: 2+1-0 = 3 > 1 -> violate; b/x/y: 0+1-0 = 1 -> ok
+#   (node-b passes here but fails the zone constraint — the two
+#   constraints are distinguishable.)
+# ---------------------------------------------------------------------------
+
+SPREAD_EXISTING = {"node-a": 2, "node-b": 0, "node-x": 0, "node-y": 0}
+SPREAD_ZONE_ONLY_EXPECT = {  # True = violates
+    "node-a": True,
+    "node-b": True,
+    "node-x": False,
+    "node-y": False,
+}
+SPREAD_HOSTNAME_ONLY_EXPECT = {
+    "node-a": True,
+    "node-b": False,
+    "node-x": False,
+    "node-y": False,
+}
+SPREAD_BOTH_EXPECT = {
+    "node-a": True,
+    "node-b": True,
+    "node-x": False,
+    "node-y": False,
+}
+
+# ScheduleAnyway scoring is ordinal here (the v1.30 scoring internals
+# carry normalizing weights; the ordering over domains is the contract):
+# fewer matching pods in the candidate's domain => strictly higher score.
+# hostname counts a=2, b=1, x=y=0  ->  score(x) == score(y) > score(b) > score(a)
+SPREAD_SCORE_EXISTING = {"node-a": 2, "node-b": 1, "node-x": 0, "node-y": 0}
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity (interpodaffinity/filtering.go + scoring.go):
+# required podAffinity: candidate node's topology domain must already hold
+#   a pod matching the term (or the incoming pod may match its own term's
+#   selector+namespace when the domain holds no pod at all — the
+#   first-pod-of-series escape).
+# required podAntiAffinity: candidate's domain must hold NO matching pod;
+#   symmetric: an existing pod's required anti-affinity term matching the
+#   incoming pod blocks that existing pod's domain.
+# preferred scoring: for each existing pod and each weighted term of the
+#   incoming pod that matches it, every node in the existing pod's domain
+#   gains the weight; NormalizeScore scales linearly so max -> 100, min -> 0:
+#     normalized_i = int(100 * (raw_i - min) / (max - min))   [float64]
+# ---------------------------------------------------------------------------
+
+# Topology again zone1={node-a,node-b}, zone2={node-x,node-y}.
+# Existing: app=db pod on node-a.
+# Incoming requires podAffinity to app=db over "zone":
+IPA_REQUIRED_AFFINITY_EXPECT = {
+    "node-a": True,  # zone1 holds the db pod
+    "node-b": True,
+    "node-x": False,
+    "node-y": False,
+}
+# Existing: app=web pod on node-x.  Incoming requires podAntiAffinity to
+# app=web over "zone":
+IPA_REQUIRED_ANTI_EXPECT = {
+    "node-a": True,
+    "node-b": True,
+    "node-x": False,
+    "node-y": False,
+}
+# Existing pod on node-b carries required anti-affinity to team=t1 over
+# "hostname"; incoming pod is labeled team=t1: only node-b is blocked.
+IPA_EXISTING_ANTI_EXPECT = {
+    "node-a": True,
+    "node-b": False,
+    "node-x": True,
+    "node-y": True,
+}
+# Preferred affinity weight 5 to app=db over "zone", db pod on node-a:
+# raw = [5, 5, 0, 0] -> min 0, max 5 -> normalized [100, 100, 0, 0]
+IPA_PREFERRED_WEIGHT = 5
+IPA_PREFERRED_EXPECT_NORMALIZED = {
+    "node-a": 100,
+    "node-b": 100,
+    "node-x": 0,
+    "node-y": 0,
+}

@@ -1,0 +1,292 @@
+"""Independently-derived upstream-v1.30 fixtures vs BOTH oracle and kernels.
+
+tests/fixtures/upstream_v130.py holds expected values hand-computed from
+the upstream formulas (arithmetic documented there).  Every assertion here
+runs twice conceptually: once against the pure-Python oracle and once
+against the compiled JAX kernels through the engine — so an oracle
+mis-derivation can no longer hide behind kernel-oracle agreement
+(round-1's InterPodAffinity shared-topology-key bug was exactly that).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ksim_tpu.engine import Engine
+from ksim_tpu.engine.profiles import default_plugins
+from ksim_tpu.plugins import oracle
+from ksim_tpu.state.featurizer import Featurizer
+from tests.fixtures import upstream_v130 as fx
+from tests.helpers import make_node, make_pod, pods_by_node
+
+ZONE_KEY = "topology.kubernetes.io/zone"
+HOST_KEY = "kubernetes.io/hostname"
+
+
+def _mem_str(n: int) -> str:
+    return str(n)  # raw bytes quantity
+
+
+def _score_case_cluster(case):
+    node = make_node(
+        "n0", cpu=f"{case['node_cpu_milli']}m", memory=_mem_str(case["node_mem"])
+    )
+    if case["pod_cpu_milli"] is None:
+        pod = make_pod("p0", cpu=None, memory=None)
+    else:
+        pod = make_pod(
+            "p0", cpu=f"{case['pod_cpu_milli']}m", memory=_mem_str(case["pod_mem"])
+        )
+    return [node], pod
+
+
+def _engine_result(nodes, bound_pods, queue):
+    feats = Featurizer().featurize(nodes, bound_pods, queue_pods=queue)
+    eng = Engine(feats, default_plugins(feats), record="full")
+    return feats, eng.evaluate_batch()
+
+
+@pytest.mark.parametrize("case", fx.BALANCED_ALLOCATION_CASES, ids=lambda c: c["name"])
+def test_balanced_allocation_fixture(case):
+    nodes, pod = _score_case_cluster(case)
+    # Oracle side.
+    infos = oracle.build_node_infos(nodes, [])
+    assert oracle.balanced_allocation_score(pod, infos[0]) == case["want"]
+    # Kernel side.
+    _feats, res = _engine_result(nodes, [], [pod])
+    si = res.plugin_names.index("NodeResourcesBalancedAllocation")
+    assert int(res.scores[0, si, 0]) == case["want"]
+
+
+@pytest.mark.parametrize("case", fx.LEAST_ALLOCATED_CASES, ids=lambda c: c["name"])
+def test_least_allocated_fixture(case):
+    nodes, pod = _score_case_cluster(case)
+    infos = oracle.build_node_infos(nodes, [])
+    assert oracle.least_allocated_score(pod, infos[0]) == case["want"]
+    _feats, res = _engine_result(nodes, [], [pod])
+    si = res.plugin_names.index("NodeResourcesFit")
+    assert int(res.scores[0, si, 0]) == case["want"]
+
+
+def test_taint_toleration_fixture():
+    nodes = [
+        make_node(
+            f"n{i}",
+            taints=[
+                {"key": f"k{j}", "value": "v", "effect": "PreferNoSchedule"}
+                for j in range(count)
+            ],
+        )
+        for i, count in enumerate(fx.TAINT_PREFER_COUNTS)
+    ]
+    pod = make_pod("p0")
+    infos = oracle.build_node_infos(nodes, [])
+    raw = [oracle.taint_toleration_score(pod, info) for info in infos]
+    assert raw == fx.TAINT_EXPECT_RAW
+    assert oracle.default_normalize_score(raw, reverse=True) == fx.TAINT_EXPECT_NORMALIZED
+
+    _feats, res = _engine_result(nodes, [], [pod])
+    si = res.plugin_names.index("TaintToleration")
+    weight = 3  # upstream default-profile weight (default_plugins.go)
+    got_raw = [int(res.scores[0, si, ni]) for ni in range(3)]
+    got_final = [int(res.final_scores[0, si, ni]) for ni in range(3)]
+    assert got_raw == fx.TAINT_EXPECT_RAW
+    assert got_final == [s * weight for s in fx.TAINT_EXPECT_NORMALIZED]
+
+
+@pytest.mark.parametrize("case", fx.IMAGE_LOCALITY_CASES, ids=lambda c: c["name"])
+def test_image_locality_fixture(case):
+    nodes = []
+    for name in ("node-a", "node-b"):
+        node = make_node(name)
+        node["status"]["images"] = [
+            {"names": [img], "sizeBytes": meta["size"]}
+            for img, meta in case["images"].items()
+            if name in meta["on"]
+        ]
+        nodes.append(node)
+    pod = make_pod("p0")
+    pod["spec"]["containers"] = [
+        {"name": f"c{i}", "image": img, "resources": {"requests": {"cpu": "100m"}}}
+        for i, img in enumerate(case["pod_images"])
+    ]
+
+    states = oracle.build_image_states(nodes)
+    for ni, node in enumerate(nodes):
+        want = case["want"][node["metadata"]["name"]]
+        assert oracle.image_locality_score(pod, node, states, len(nodes)) == want
+
+    _feats, res = _engine_result(nodes, [], [pod])
+    si = res.plugin_names.index("ImageLocality")
+    for ni, node in enumerate(nodes):
+        assert int(res.scores[0, si, ni]) == case["want"][node["metadata"]["name"]]
+
+
+# -- PodTopologySpread -------------------------------------------------------
+
+
+def _spread_cluster(existing_counts):
+    zones = {"node-a": "z1", "node-b": "z1", "node-x": "z2", "node-y": "z2"}
+    nodes = [
+        make_node(n, labels={ZONE_KEY: z, HOST_KEY: n}) for n, z in zones.items()
+    ]
+    bound = []
+    for node_name, count in existing_counts.items():
+        for i in range(count):
+            bound.append(
+                make_pod(f"e-{node_name}-{i}", labels={"foo": "bar"}, node_name=node_name)
+            )
+    return nodes, bound
+
+
+def _spread_con(key):
+    return {
+        "maxSkew": 1,
+        "topologyKey": key,
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"foo": "bar"}},
+    }
+
+
+@pytest.mark.parametrize(
+    "keys,expect",
+    [
+        ((ZONE_KEY,), fx.SPREAD_ZONE_ONLY_EXPECT),
+        ((HOST_KEY,), fx.SPREAD_HOSTNAME_ONLY_EXPECT),
+        ((ZONE_KEY, HOST_KEY), fx.SPREAD_BOTH_EXPECT),
+    ],
+    ids=["zone-only", "hostname-only", "both"],
+)
+def test_topology_spread_filter_fixture(keys, expect):
+    nodes, bound = _spread_cluster(fx.SPREAD_EXISTING)
+    pod = make_pod(
+        "incoming",
+        labels={"foo": "bar"},
+        topology_spread_constraints=[_spread_con(k) for k in keys],
+    )
+    infos = oracle.build_node_infos(nodes, bound)
+    rows = oracle.topology_spread_filter_all(pod, infos, pods_by_node(bound))
+    for info, reasons in zip(infos, rows):
+        assert bool(reasons) == expect[info["name"]], info["name"]
+
+    _feats, res = _engine_result(nodes, bound, [pod])
+    fi = res.filter_plugin_names.index("PodTopologySpread")
+    for ni, info in enumerate(infos):
+        got_violates = int(res.reason_bits[0, fi, ni]) != 0
+        assert got_violates == expect[info["name"]], info["name"]
+
+
+def test_topology_spread_score_ordering_fixture():
+    nodes, bound = _spread_cluster(fx.SPREAD_SCORE_EXISTING)
+    con = dict(_spread_con(HOST_KEY), whenUnsatisfiable="ScheduleAnyway")
+    pod = make_pod("incoming", labels={"foo": "bar"}, topology_spread_constraints=[con])
+
+    _feats, res = _engine_result(nodes, bound, [pod])
+    si = res.plugin_names.index("PodTopologySpread")
+    by_name = {
+        info["name"]: int(res.final_scores[0, si, ni])
+        for ni, info in enumerate(oracle.build_node_infos(nodes, bound))
+    }
+    # Fewer matching pods in the candidate's host domain => higher score.
+    assert by_name["node-x"] == by_name["node-y"]
+    assert by_name["node-x"] > by_name["node-b"] > by_name["node-a"]
+
+
+# -- InterPodAffinity --------------------------------------------------------
+
+
+def _ipa_cluster():
+    zones = {"node-a": "z1", "node-b": "z1", "node-x": "z2", "node-y": "z2"}
+    return [make_node(n, labels={ZONE_KEY: z, HOST_KEY: n}) for n, z in zones.items()]
+
+
+def _ipa_term(key, match_labels, weight=None):
+    term = {
+        "labelSelector": {"matchLabels": match_labels},
+        "topologyKey": key,
+    }
+    if weight is not None:
+        return {"weight": weight, "podAffinityTerm": term}
+    return term
+
+
+def _assert_ipa_filter(nodes, bound, pod, expect):
+    infos = oracle.build_node_infos(nodes, bound)
+    rows = oracle.inter_pod_affinity_filter_all(pod, infos, pods_by_node(bound))
+    for info, reasons in zip(infos, rows):
+        assert (not reasons) == expect[info["name"]], ("oracle", info["name"])
+
+    _feats, res = _engine_result(nodes, bound, [pod])
+    fi = res.filter_plugin_names.index("InterPodAffinity")
+    for ni, info in enumerate(infos):
+        passes = int(res.reason_bits[0, fi, ni]) == 0
+        assert passes == expect[info["name"]], ("kernel", info["name"])
+
+
+def test_interpod_required_affinity_fixture():
+    nodes = _ipa_cluster()
+    bound = [make_pod("db0", labels={"app": "db"}, node_name="node-a")]
+    pod = make_pod("incoming")
+    pod["spec"]["affinity"] = {
+        "podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                _ipa_term(ZONE_KEY, {"app": "db"})
+            ]
+        }
+    }
+    _assert_ipa_filter(nodes, bound, pod, fx.IPA_REQUIRED_AFFINITY_EXPECT)
+
+
+def test_interpod_required_anti_affinity_fixture():
+    nodes = _ipa_cluster()
+    bound = [make_pod("web0", labels={"app": "web"}, node_name="node-x")]
+    pod = make_pod("incoming")
+    pod["spec"]["affinity"] = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                _ipa_term(ZONE_KEY, {"app": "web"})
+            ]
+        }
+    }
+    _assert_ipa_filter(nodes, bound, pod, fx.IPA_REQUIRED_ANTI_EXPECT)
+
+
+def test_interpod_existing_anti_affinity_fixture():
+    nodes = _ipa_cluster()
+    guard = make_pod("guard", node_name="node-b")
+    guard["spec"]["affinity"] = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                _ipa_term(HOST_KEY, {"team": "t1"})
+            ]
+        }
+    }
+    pod = make_pod("incoming", labels={"team": "t1"})
+    _assert_ipa_filter(nodes, [guard], pod, fx.IPA_EXISTING_ANTI_EXPECT)
+
+
+def test_interpod_preferred_affinity_normalized_fixture():
+    nodes = _ipa_cluster()
+    bound = [make_pod("db0", labels={"app": "db"}, node_name="node-a")]
+    pod = make_pod("incoming")
+    pod["spec"]["affinity"] = {
+        "podAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                _ipa_term(ZONE_KEY, {"app": "db"}, weight=fx.IPA_PREFERRED_WEIGHT)
+            ]
+        }
+    }
+    infos = oracle.build_node_infos(nodes, bound)
+    raw, normalized = oracle.inter_pod_affinity_score_all(
+        pod, infos, pods_by_node(bound), [True] * len(infos)
+    )
+    for info, n in zip(infos, normalized):
+        assert n == fx.IPA_PREFERRED_EXPECT_NORMALIZED[info["name"]], ("oracle", info["name"])
+
+    _feats, res = _engine_result(nodes, bound, [pod])
+    si = res.plugin_names.index("InterPodAffinity")
+    plugin_weight = 2  # upstream default-profile weight
+    for ni, info in enumerate(infos):
+        want = fx.IPA_PREFERRED_EXPECT_NORMALIZED[info["name"]] * plugin_weight
+        assert int(res.final_scores[0, si, ni]) == want, ("kernel", info["name"])
