@@ -180,12 +180,20 @@ def render_pod_results(
     pi: int,
     *,
     postfilter: dict | None = None,
+    permit: tuple[dict, dict] | None = None,
+    bound: bool = True,
     ctx: "RenderCtx | None" = None,
 ) -> dict[str, str]:
     """The 13 result annotations for queue pod ``pi`` (all keys present,
     empty maps as "{}", mirroring GetStoredResult's unconditional adds).
     ``postfilter`` is the {node: {plugin: msg}} map recorded by the
-    PostFilter wrapper when preemption ran (wrappedplugin.go:550-577).
+    PostFilter wrapper when preemption ran (wrappedplugin.go:550-577);
+    ``permit`` is ({plugin: status}, {plugin: timeout_str}) recorded by
+    the Permit wrapper (wrappedplugin.go:582-611, store.go:549-560);
+    ``bound=False`` marks a cycle that selected a node but never reached
+    Bind (a Permit rejection): selected-node and reserve-result stay
+    recorded — upstream wrote them at Reserve — while prebind/bind maps
+    stay empty because those wrappers never ran.
     Pass a shared ``ctx`` when rendering many pods of one pass."""
     if res.reason_bits is None:
         raise ValueError("render_pod_results needs record='full' results")
@@ -276,8 +284,8 @@ def render_pod_results(
     # on a successful cycle upstream's wrappers record "success" for it
     # (wrappedplugin.go:616-645 Reserve, :670-697 PreBind).  Profiles can
     # disable it at a single point (ScoredPlugin.reserve/prebind_enabled).
-    def _point_map(flag: str) -> dict:
-        if selected < 0:
+    def _point_map(flag: str, ran: bool = True) -> dict:
+        if selected < 0 or not ran:
             return {}
         return {
             sp.plugin.name: SUCCESS_MESSAGE
@@ -286,7 +294,7 @@ def render_pod_results(
         }
 
     reserve_map = _point_map("reserve_enabled")
-    prebind_map = _point_map("prebind_enabled")
+    prebind_map = _point_map("prebind_enabled", ran=bound)
     out = {
         PRE_FILTER_RESULT_KEY: _marshal({}),
         PRE_FILTER_STATUS_KEY: _marshal(prefilter_status),
@@ -296,11 +304,11 @@ def render_pod_results(
         SCORE_RESULT_KEY: score_json,
         FINAL_SCORE_RESULT_KEY: final_json,
         RESERVE_RESULT_KEY: _marshal(reserve_map),
-        PERMIT_RESULT_KEY: _marshal({}),
-        PERMIT_TIMEOUT_RESULT_KEY: _marshal({}),
+        PERMIT_RESULT_KEY: _marshal(permit[0] if permit else {}),
+        PERMIT_TIMEOUT_RESULT_KEY: _marshal(permit[1] if permit else {}),
         PRE_BIND_RESULT_KEY: _marshal(prebind_map),
         BIND_RESULT_KEY: _marshal(
-            {"DefaultBinder": SUCCESS_MESSAGE} if selected >= 0 else {}
+            {"DefaultBinder": SUCCESS_MESSAGE} if selected >= 0 and bound else {}
         ),
     }
     if selected >= 0:

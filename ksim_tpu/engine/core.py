@@ -92,11 +92,14 @@ class ScoredPlugin:
     score_enabled: bool = True
     extender: PluginExtender | None = None
     # Host-side recording hints (not part of the traced computation): is
-    # the plugin active at the Reserve/PreBind points (profiles can
+    # the plugin active at the Reserve/Permit/PreBind points (profiles can
     # disable single extension points; the annotation renderer consults
-    # these for reserve-result/prebind-result).
+    # these for reserve-result/prebind-result, and the scheduler service
+    # consults permit_enabled before calling a plugin's host-side
+    # ``permit(pod, node_name)`` hook).
     reserve_enabled: bool = True
     prebind_enabled: bool = True
+    permit_enabled: bool = True
 
 
 @dataclass

@@ -62,11 +62,35 @@ class HTTPExtender:
         self.weight = int(cfg.get("weight") or 1)
         self.ignorable = bool(cfg.get("ignorable"))
         self.node_cache_capable = bool(cfg.get("nodeCacheCapable"))
+        # Resource names this extender manages (extender.go:99-112): with
+        # a non-empty set the extender only engages for pods requesting
+        # one of them; empty means every pod.
+        self.managed_resources = frozenset(
+            r.get("name") for r in cfg.get("managedResources") or [] if r.get("name")
+        )
         self.timeout = 30.0
 
     @property
     def name(self) -> str:
         return self.url_prefix  # extender.go Name()
+
+    def is_interested(self, pod: JSON) -> bool:
+        """Upstream HTTPExtender.IsInterested: true when managedResources
+        is empty, or any container (incl. init containers) requests or
+        limits a managed resource (k8s pkg/scheduler/extender.go
+        hasManagedResources)."""
+        if not self.managed_resources:
+            return True
+        spec = pod.get("spec") or {}
+        for c in (spec.get("containers") or []) + (spec.get("initContainers") or []):
+            resources = c.get("resources") or {}
+            for section in ("requests", "limits"):
+                if any(
+                    name in self.managed_resources
+                    for name in (resources.get(section) or {})
+                ):
+                    return True
+        return False
 
     def _send(self, verb: str, args: JSON) -> JSON:
         url = f"{self.url_prefix}/{verb}"

@@ -275,6 +275,7 @@ class CompiledProfile:
     score_disabled: frozenset[str] = frozenset()
     reserve_disabled: frozenset[str] = frozenset()
     prebind_disabled: frozenset[str] = frozenset()
+    permit_disabled: frozenset[str] = frozenset()
     # Plugins added only through a per-point set: name -> points enabled.
     point_only: dict[str, frozenset[str]] = field(default_factory=dict)
     # Featurizer extra encoders shipped by config-loaded plugins
@@ -299,11 +300,18 @@ class CompiledProfile:
             sp = builder(feats, self.plugin_args.get(name, {}))
             filter_on = sp.filter_enabled and name not in self.filter_disabled
             score_on = sp.score_enabled and name not in self.score_disabled
+            permit_on = (
+                hasattr(sp.plugin, "permit") and name not in self.permit_disabled
+            )
             if name in self.point_only:
                 points = self.point_only[name]
                 filter_on = filter_on and "filter" in points
                 score_on = score_on and "score" in points
-            if not filter_on and not score_on:
+                permit_on = permit_on and "permit" in points
+            # A permit-only plugin stays in the set with both kernel
+            # points off: the engine loops skip it, the service still
+            # runs its host-side permit hook.
+            if not filter_on and not score_on and not permit_on:
                 continue
             out.append(
                 ScoredPlugin(
@@ -313,6 +321,7 @@ class CompiledProfile:
                     score_enabled=score_on,
                     reserve_enabled=name not in self.reserve_disabled,
                     prebind_enabled=name not in self.prebind_disabled,
+                    permit_enabled=permit_on,
                 )
             )
         return tuple(out)
@@ -378,6 +387,7 @@ def compile_profile(
     score_off: set[str] = set()
     reserve_off: set[str] = set()
     prebind_off: set[str] = set()
+    permit_off: set[str] = set()
     point_only: dict[str, set[str]] = {}
     for point in ("preFilter", "filter", "postFilter", "preScore", "score",
                   "reserve", "permit", "preBind", "bind", "postBind"):
@@ -394,6 +404,8 @@ def compile_profile(
             reserve_off |= have if "*" in disabled_here else disabled_here
         elif point == "preBind":
             prebind_off |= have if "*" in disabled_here else disabled_here
+        elif point == "permit":
+            permit_off |= have if "*" in disabled_here else disabled_here
         for p in point_cfg.get("enabled") or []:
             name = p.get("name")
             if not name:
@@ -447,6 +459,7 @@ def compile_profile(
         score_disabled=frozenset(score_off),
         reserve_disabled=frozenset(reserve_off),
         prebind_disabled=frozenset(prebind_off),
+        permit_disabled=frozenset(permit_off),
         point_only={k: frozenset(v) for k, v in point_only.items()},
         extra_encoders=loaded_encoders,
     )

@@ -41,6 +41,13 @@ from ksim_tpu.scheduler.profile import (
     CompiledProfile,
     compile_configuration,
 )
+from ksim_tpu.scheduler.permit import (
+    REJECT,
+    SUCCESS,
+    WAIT,
+    PermitResult,
+    go_duration_str,
+)
 from ksim_tpu.state.cluster import ClusterStore, WatchEvent
 from ksim_tpu.state.featurizer import FeaturizedSnapshot, Featurizer
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
@@ -66,6 +73,22 @@ def queue_sort_key(pod: JSON, priority_of=None):
         prio = int(pod.get("spec", {}).get("priority") or 0)
     created = pod.get("metadata", {}).get("creationTimestamp") or ""
     return (-prio, created, namespace_of(pod), name_of(pod))
+
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _WaitingPod:
+    """A pod parked by Permit Wait (upstream framework waitingPod)."""
+
+    name: str
+    namespace: str
+    node_name: str
+    # plugin name -> monotonic deadline; emptied by allow() calls.
+    pending: dict[str, float]
+    # Pre-rendered result annotations (written at resolution).
+    anno: dict[str, str] = field(default_factory=dict)
 
 
 class SchedulerService:
@@ -138,6 +161,22 @@ class SchedulerService:
         # it schedulable flush the backoff (QueueingHint analogue).
         self._backoff: dict[str, tuple[int, int]] = {}  # key -> (attempts, retry_at)
         self._backoff_lock = threading.Lock()
+        # Pods parked by a Permit plugin's Wait status (the upstream
+        # framework's waitingPodsMap): key -> _WaitingPod.  While waiting,
+        # a pod is neither pending nor bound; featurization charges its
+        # requests to the selected node (the upstream assumed-pod cache).
+        self._waiting: dict[str, "_WaitingPod"] = {}
+        self._waiting_lock = threading.Lock()
+        self._pass_waits = 0
+        # Serializes scheduling passes against waiting-pod resolution:
+        # allow/reject bind on the CALLER's thread, and doing that while a
+        # pass holds a stale pod snapshot could schedule the pod twice.
+        # RLock: _expire_waiting runs both inside a pass and standalone.
+        self._pass_lock = threading.RLock()
+        # Signals the watch loop to run a pass for state changes whose
+        # events are rv-suppressed (a rejected waiter returning to the
+        # queue).
+        self._poke = threading.Event()
         self._pass_count = 0
         self.metrics = Metrics()
 
@@ -275,6 +314,14 @@ class SchedulerService:
             return False
         if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
             return False
+        # Waiting on Permit: parked, not re-queued (upstream keeps the
+        # pod assumed while its waitingPod entry exists).  Unlocked empty
+        # check first: this runs once per pod per queue build, and the
+        # map is almost always empty.
+        if self._waiting:
+            with self._waiting_lock:
+                if f"{namespace_of(pod)}/{name_of(pod)}" in self._waiting:
+                    return False
         # SchedulingGates (upstream PreEnqueue): gated pods never enter
         # the scheduling queue until every gate is removed.
         if pod.get("spec", {}).get("schedulingGates"):
@@ -338,6 +385,10 @@ class SchedulerService:
         return self._schedule_pending_inner()
 
     def _schedule_pending_inner(self) -> dict[str, str | None]:
+        with self._pass_lock:
+            return self._schedule_pending_locked()
+
+    def _schedule_pending_locked(self) -> dict[str, str | None]:
         nodes = self._store.list("nodes", copy_objs=False)
         namespaces = self._store.list("namespaces", copy_objs=False)
         volume_kw = dict(
@@ -354,10 +405,15 @@ class SchedulerService:
             return {}
         self._pass_count += 1
         placements: dict[str, str | None] = {}
+        self._expire_waiting()
+        # Permit-WAIT placements carry a node name (the assumed node) but
+        # nothing bound yet; _finalize_waiting counts the eventual bind.
+        self._pass_waits = 0
         for sched_name in self._scheduler_names:
             # Fresh pod snapshot per profile: earlier profiles' bindings
             # must charge their nodes before the next profile evaluates.
             pods = self._store.list("pods", copy_objs=False)
+            pods = self._assume_waiting(pods)
             queue = [
                 p
                 for p in pods
@@ -419,7 +475,8 @@ class SchedulerService:
         self.metrics.inc("scheduling_passes")
         self.metrics.inc("scheduling_attempts", len(placements))
         self.metrics.inc(
-            "pods_scheduled", sum(1 for v in placements.values() if v is not None)
+            "pods_scheduled",
+            sum(1 for v in placements.values() if v is not None) - self._pass_waits,
         )
         self.metrics.inc(
             "pods_unschedulable", sum(1 for v in placements.values() if v is None)
@@ -447,7 +504,7 @@ class SchedulerService:
 
         for pod in queue:
             nodes = self._store.list("nodes", copy_objs=False)
-            pods = self._store.list("pods", copy_objs=False)
+            pods = self._assume_waiting(self._store.list("pods", copy_objs=False))
             with self.metrics.timer("featurize"):
                 feats = featurizer.featurize(
                     nodes, pods, queue_pods=[pod], namespaces=namespaces, **volume_kw
@@ -465,6 +522,10 @@ class SchedulerService:
                 if not feasible:
                     break
                 if not ext.filter_verb:
+                    continue
+                # managedResources gate (extender.go:99-112): extenders
+                # managing specific resources only see pods requesting them.
+                if not ext.is_interested(pod):
                     continue
                 args = {"pod": pod}
                 if ext.node_cache_capable:
@@ -503,6 +564,8 @@ class SchedulerService:
                 for idx, ext in enumerate(self._extenders.extenders):
                     if not ext.prioritize_verb:
                         continue
+                    if not ext.is_interested(pod):
+                        continue
                     args = {"pod": pod}
                     if ext.node_cache_capable:
                         args["nodenames"] = list(feasible)
@@ -529,8 +592,31 @@ class SchedulerService:
                 nominated, victims, postfilter = self._attempt_preemption(
                     pod, feats, plugins, res, 0
                 )
-            anno = render_pod_results(feats, plugins, res, 0, postfilter=postfilter)
+            # Permit runs post-selection on this path too (upstream's
+            # cycle is identical with or without extenders).
+            permit_maps = None
+            permit_verdict = SUCCESS
+            wait_deadlines: dict[str, float] = {}
+            if selected is not None:
+                permit_verdict, permit_maps, wait_deadlines = self._run_permit(
+                    plugins, pod, selected
+                )
+            anno = render_pod_results(
+                feats,
+                plugins,
+                res,
+                0,
+                postfilter=postfilter,
+                permit=permit_maps,
+                bound=permit_verdict != REJECT,
+            )
             anno.update(self._extenders.store.get_stored_result(pod))
+            if selected is not None and permit_verdict == WAIT:
+                self._extenders.store.delete_data(pod)
+                self._park_waiting(pod, selected, wait_deadlines, anno, placements)
+                continue
+            if selected is not None and permit_verdict == REJECT:
+                selected = None
 
             def mutate(obj: JSON) -> None:
                 annos = obj.setdefault("metadata", {}).setdefault("annotations", {})
@@ -563,13 +649,36 @@ class SchedulerService:
                 nominated, victims, postfilter = self._attempt_preemption(
                     pod, feats, plugins, res, j
                 )
+            # Permit runs after selection (upstream RunPermitPlugins is
+            # post-Reserve, wrappedplugin.go:582-611).
+            permit_maps = None
+            permit_verdict = SUCCESS
+            wait_deadlines: dict[str, float] = {}
+            if node_name is not None:
+                permit_verdict, permit_maps, wait_deadlines = self._run_permit(
+                    plugins, pod, node_name
+                )
             anno = (
                 render_pod_results(
-                    feats, plugins, res, j, postfilter=postfilter, ctx=render_ctx
+                    feats,
+                    plugins,
+                    res,
+                    j,
+                    postfilter=postfilter,
+                    permit=permit_maps,
+                    bound=permit_verdict != REJECT,
+                    ctx=render_ctx,
                 )
                 if self._record == "full"
                 else {}
             )
+            if node_name is not None and permit_verdict == WAIT:
+                self._park_waiting(pod, node_name, wait_deadlines, anno, placements)
+                continue
+            if node_name is not None and permit_verdict == REJECT:
+                # Upstream: Unreserve + back to the queue as unschedulable
+                # (no PostFilter after a Permit rejection).
+                node_name = None
 
             def rebuild(obj: JSON) -> JSON:
                 # Shallow re-wrap (store.rewrap contract): share the
@@ -610,6 +719,232 @@ class SchedulerService:
                 except Exception:
                     logger.exception("failed to evict victim %s", name_of(v))
             placements[f"{namespace_of(pod)}/{name_of(pod)}"] = node_name
+
+    # -- Permit (upstream RunPermitPlugins + waitingPodsMap) ----------------
+
+    def _run_permit(
+        self, plugins, pod: JSON, node_name: str
+    ) -> tuple[str, tuple[dict, dict], dict[str, float]]:
+        """Run every permit-capable plugin for the selected (pod, node).
+
+        Returns (verdict, ({plugin: status_msg}, {plugin: timeout_str}),
+        {plugin: monotonic_deadline}).  Verdict: REJECT if any plugin
+        rejected/errored, else WAIT if any asked to wait, else SUCCESS —
+        upstream RunPermitPlugins merges statuses the same way."""
+        import time as _time
+
+        statuses: dict[str, str] = {}
+        timeouts: dict[str, str] = {}
+        deadlines: dict[str, float] = {}
+        verdict = SUCCESS
+        for sp in plugins:
+            hook = getattr(sp.plugin, "permit", None)
+            if hook is None or not getattr(sp, "permit_enabled", True):
+                continue
+            name = sp.plugin.name
+            try:
+                result = hook(pod, node_name)
+            except Exception as e:  # an erroring plugin rejects (upstream Error status)
+                logger.exception("permit plugin %s failed", name)
+                result = PermitResult.reject(f"permit plugin error: {e}")
+            if not isinstance(result, PermitResult):
+                result = PermitResult.reject(f"permit plugin {name} returned {result!r}")
+            # Recorded message: success/wait keywords, otherwise the
+            # status message (wrappedplugin.go:596-602).
+            if result.status == SUCCESS:
+                statuses[name] = SUCCESS
+                timeouts[name] = go_duration_str(0)
+            elif result.status == WAIT:
+                statuses[name] = WAIT
+                timeouts[name] = go_duration_str(result.timeout_seconds)
+                deadlines[name] = _time.monotonic() + result.timeout_seconds
+                if verdict == SUCCESS:
+                    verdict = WAIT
+            else:
+                statuses[name] = result.message or "rejected by permit plugin"
+                timeouts[name] = go_duration_str(0)
+                verdict = REJECT
+                # Upstream RunPermitPlugins returns on the first non-wait
+                # failure — later plugins never run or record.
+                break
+        return verdict, (statuses, timeouts), deadlines
+
+    def _park_waiting(
+        self,
+        pod: JSON,
+        node_name: str,
+        deadlines: dict[str, float],
+        anno: dict[str, str],
+        placements: dict,
+    ) -> None:
+        """Park a Permit-WAIT pod: no bind, no pod write yet; the waiting
+        entry keeps it out of the queue and charges its node in
+        featurization until allow/reject/timeout resolves it."""
+        key = f"{namespace_of(pod)}/{name_of(pod)}"
+        with self._waiting_lock:
+            self._waiting[key] = _WaitingPod(
+                name=name_of(pod),
+                namespace=namespace_of(pod),
+                node_name=node_name,
+                pending=deadlines,
+                anno=anno,
+            )
+        placements[key] = node_name
+        self._pass_waits += 1
+        self.metrics.inc("pods_waiting_on_permit")
+
+    def _assume_waiting(self, pods: list[JSON]) -> list[JSON]:
+        """Charge permit-waiting pods to their selected nodes for
+        featurization (the upstream assumed-pod cache: a waiting pod's
+        resources are visible to every later scheduling decision)."""
+        with self._waiting_lock:
+            if not self._waiting:
+                return pods
+            waiting = dict(self._waiting)
+        out = []
+        for p in pods:
+            wp = waiting.get(f"{namespace_of(p)}/{name_of(p)}")
+            if wp is None:
+                out.append(p)
+            else:
+                out.append(
+                    dict(p, spec=dict(p.get("spec") or {}, nodeName=wp.node_name))
+                )
+        return out
+
+    def get_waiting_pods(self) -> list[JSON]:
+        """Snapshot of permit-waiting pods (upstream Handle.IterateOverWaitingPods)."""
+        with self._waiting_lock:
+            return [
+                {
+                    "name": wp.name,
+                    "namespace": wp.namespace,
+                    "nodeName": wp.node_name,
+                    "pendingPlugins": sorted(wp.pending),
+                }
+                for wp in self._waiting.values()
+            ]
+
+    def allow_waiting_pod(
+        self, name: str, namespace: str = "default", plugin: str | None = None
+    ) -> bool:
+        """Allow a waiting pod for ``plugin`` (or all); binds when no
+        pending plugin remains (upstream WaitingPod.Allow).  Serialized
+        against scheduling passes (_pass_lock): binding mid-pass could
+        let the pass's stale snapshot schedule the pod a second time."""
+        key = f"{namespace}/{name}"
+        with self._pass_lock:
+            with self._waiting_lock:
+                wp = self._waiting.get(key)
+                if wp is None:
+                    return False
+                if plugin is None:
+                    wp.pending.clear()
+                else:
+                    wp.pending.pop(plugin, None)
+                if wp.pending:
+                    return True
+                del self._waiting[key]
+            self._finalize_waiting(wp, bind=True)
+        return True
+
+    def reject_waiting_pod(
+        self, name: str, namespace: str = "default", message: str = "rejected"
+    ) -> bool:
+        """Reject a waiting pod (upstream WaitingPod.Reject): unreserve —
+        the pod returns to the pending queue as unschedulable."""
+        key = f"{namespace}/{name}"
+        with self._pass_lock:
+            with self._waiting_lock:
+                wp = self._waiting.pop(key, None)
+            if wp is None:
+                return False
+            self._finalize_waiting(wp, bind=False, message=message)
+        # The rejection write is rv-suppressed; wake the watch loop so
+        # the now-pending pod gets a pass without an unrelated event.
+        self._poke.set()
+        return True
+
+    def _expire_waiting(self) -> int:
+        """Reject waiting pods whose any plugin timer fired (upstream: a
+        waiting pod is rejected when one pending plugin's timeout ends).
+        Returns the number of pods rejected."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._pass_lock:
+            expired: list[_WaitingPod] = []
+            with self._waiting_lock:
+                for key, wp in list(self._waiting.items()):
+                    if any(dl <= now for dl in wp.pending.values()):
+                        expired.append(wp)
+                        del self._waiting[key]
+            for wp in expired:
+                self._finalize_waiting(
+                    wp, bind=False, message="pod rejected: permit wait timed out"
+                )
+        return len(expired)
+
+    def _finalize_waiting(
+        self, wp: _WaitingPod, *, bind: bool, message: str = ""
+    ) -> None:
+        from ksim_tpu.engine.annotations import (
+            BIND_RESULT_KEY,
+            PRE_BIND_RESULT_KEY,
+            _marshal,
+        )
+        from ksim_tpu.errors import NotFoundError
+
+        if bind:
+            # The assumed node may have been deleted while the pod waited
+            # — upstream's Bind would fail and unreserve; do the same.
+            try:
+                self._store.get("nodes", wp.node_name)
+            except NotFoundError:
+                bind = False
+                message = f"node {wp.node_name} deleted while waiting on permit"
+
+        anno = dict(wp.anno)
+        if not bind and anno:
+            # Bind/PreBind never ran for a rejected waiter.
+            anno[BIND_RESULT_KEY] = _marshal({})
+            anno[PRE_BIND_RESULT_KEY] = _marshal({})
+
+        def rebuild(obj: JSON) -> JSON:
+            new = dict(obj)
+            md = dict(obj.get("metadata") or {})
+            annos = dict(md.get("annotations") or {})
+            if anno:
+                apply_results_to_pod(annos, anno)
+            md["annotations"] = annos
+            new["metadata"] = md
+            if bind:
+                new["spec"] = dict(obj.get("spec") or {}, nodeName=wp.node_name)
+                status = dict(obj.get("status") or {}, phase="Running")
+                status.pop("nominatedNodeName", None)
+                new["status"] = status
+            return new
+
+        try:
+            updated = self._store.rewrap("pods", wp.name, wp.namespace, rebuild)
+        except NotFoundError:
+            return  # deleted while waiting
+        # Suppress our own write either way: an unsuppressed rejection
+        # event would hit _relevant's backoff-clearing branch and erase
+        # the backoff recorded below (undamped retry hot loop); the
+        # retry pass comes from the explicit _poke instead.
+        with self._own_rvs_lock:
+            self._own_rvs.add(updated["metadata"]["resourceVersion"])
+        if bind:
+            self.metrics.inc("pods_scheduled")
+        else:
+            logger.info("permit: pod %s/%s rejected: %s", wp.namespace, wp.name, message)
+            key = f"{wp.namespace}/{wp.name}"
+            with self._backoff_lock:
+                attempts = self._backoff.get(key, (0, 0))[0] + 1
+                delay = min(2 ** (attempts - 1), self.MAX_BACKOFF_PASSES)
+                self._backoff[key] = (attempts, self._pass_count + delay)
+            self.metrics.inc("pods_permit_rejected")
 
     def _attempt_preemption(self, pod, feats, plugins, res, j):
         """DefaultPreemption for one unschedulable pod (PostFilter).
@@ -716,10 +1051,24 @@ class SchedulerService:
         self._flush_extender_results(ev)
         from ksim_tpu.state.cluster import DELETED
 
+        if ev.event_type != DELETED:
+            # A user-driven pod create/update (self-writes were filtered
+            # above) may have made THIS pod schedulable — e.g. editing its
+            # requests through the UI: drop its backoff so the triggered
+            # pass retries it now (upstream Pod-update QueueingHints move
+            # the pod out of the unschedulable pool immediately).
+            key = f"{namespace_of(ev.obj)}/{name_of(ev.obj)}"
+            with self._backoff_lock:
+                self._backoff.pop(key, None)
         if ev.event_type == DELETED:
             key = f"{namespace_of(ev.obj)}/{name_of(ev.obj)}"
             with self._backoff_lock:
                 self._backoff.pop(key, None)  # the pod is gone
+            # A deleted permit-waiter's entry must die with it — a stale
+            # entry would block a re-created same-name pod and write the
+            # old pod's annotations onto it at timer expiry.
+            with self._waiting_lock:
+                self._waiting.pop(key, None)
             self.flush_backoff()  # capacity freed: retry everything
         # A delete frees capacity; an add/update may need scheduling.
         return True
@@ -779,6 +1128,17 @@ class SchedulerService:
             while not self._stop.is_set():
                 ev = stream.next(timeout=0.1)
                 if ev is None:
+                    # Idle tick: permit-wait timers fire here, and poked
+                    # rejections (whose rv-suppressed MODIFIED events the
+                    # loop never sees) get their retry pass.
+                    poked = self._poke.is_set()
+                    if poked:
+                        self._poke.clear()
+                    if self._expire_waiting() or poked:
+                        try:
+                            self.schedule_pending()
+                        except Exception:  # pragma: no cover
+                            logger.exception("scheduling pass failed")
                     continue
                 if not self._relevant(ev):
                     continue

@@ -4,10 +4,14 @@ The reference ships a ~5k-LoC Nuxt2/Vuetify app (reference web/) that is
 a pure client of the REST + annotation contract; this single-file page
 covers that app's workflow against THIS server: live tables for all 7
 resource kinds fed by the streaming /api/v1/listwatchresources endpoint,
-per-plugin Filter/Score/FinalScore tables decoded from the 13 result
-annotations (the SchedulingResults.vue analogue), resource create (from
-prefilled templates, ResourceAddButton.vue) and delete through the
-/api/v1/resources CRUD, a scheduler-configuration editor
+a per-node pod board with an "unscheduled" bucket (the reference's
+pods-by-node store, web/store/pod.ts:12-16,43-51), per-plugin
+Filter/Score/FinalScore tables decoded from the 13 result annotations
+with a result-history attempt browser (SchedulingResults.vue), resource
+create from prefilled templates (ResourceAddButton.vue), view/edit of
+any live resource round-tripped through the /api/v1/resources CRUD (the
+YamlEditor.vue + server-side-apply workflow, web/api/v1/pod.ts:22-53 —
+JSON here, same contract), delete, a scheduler-configuration editor
 (SchedulerConfigurationEditButton.vue), snapshot export/import and reset
 (TopBar/), and a metrics panel.  Served at / by SimulatorServer."""
 
@@ -33,7 +37,18 @@ INDEX_HTML = """<!doctype html>
   .tab.active { background: #fff; font-weight: 600; }
   textarea { width: 100%; min-height: 10rem; font-family: monospace; }
   .panel { border: 1px solid #ccc; padding: .6rem; margin-top: .4rem; }
-  .del { color: #a00; cursor: pointer; }
+  .del, .edit { color: #a00; cursor: pointer; }
+  .edit { color: #06c; margin-right: .5rem; }
+  #board { display: flex; flex-wrap: wrap; gap: .6rem; margin-top: .4rem; }
+  .bucket { border: 1px solid #ccc; border-radius: 4px; padding: .4rem .6rem;
+            min-width: 11rem; vertical-align: top; background: #fafafa; }
+  .bucket h3 { margin: 0 0 .3rem; font-size: .9rem; }
+  .bucket.unsched { background: #fff4f4; }
+  .bpod { display: block; cursor: pointer; font-size: .85rem; padding: .05rem 0; }
+  .bpod:hover { text-decoration: underline; }
+  .attempt { cursor: pointer; padding: .1rem .5rem; border: 1px solid #ccc;
+             display: inline-block; margin-right: .25rem; background: #f3f3f3; }
+  .attempt.active { background: #fff; font-weight: 600; }
 </style>
 </head>
 <body>
@@ -45,6 +60,7 @@ INDEX_HTML = """<!doctype html>
   <button onclick="doReset()">Reset cluster</button>
   <button onclick="toggle('config', loadConfig)">Scheduler config</button>
   <button onclick="toggle('metrics', loadMetrics)">Metrics</button>
+  <button onclick="toggle('boardPanel', renderBoard)">Pod board</button>
   <span id="status" class="pill">connecting…</span>
 </div>
 
@@ -59,6 +75,11 @@ INDEX_HTML = """<!doctype html>
 
 <div id="metrics" class="panel" style="display:none"><pre id="metricsPre"></pre></div>
 
+<div id="boardPanel" class="panel" style="display:none">
+  <b>Pods by node</b> (unscheduled bucket first — web/store/pod.ts)
+  <div id="board"></div>
+</div>
+
 <div style="margin-top:1rem" id="tabs"></div>
 <div class="panel" id="tabpanel">
   <div>
@@ -70,6 +91,13 @@ INDEX_HTML = """<!doctype html>
     <textarea id="addText"></textarea><br/>
     <button onclick="doAdd()">Create</button>
     <span id="addMsg"></span>
+  </div>
+  <div id="editPanel" style="display:none">
+    <b>Edit <span id="editKey"></span></b> (live object; Save PUTs it back)<br/>
+    <textarea id="editText"></textarea><br/>
+    <button onclick="doSave()">Save</button>
+    <button onclick="hideEdit()">Cancel</button>
+    <span id="editMsg"></span>
   </div>
   <table id="resTable"><thead></thead><tbody></tbody></table>
 </div>
@@ -83,6 +111,8 @@ const KINDS = ["pods","nodes","persistentvolumes","persistentvolumeclaims",
                "storageclasses","priorityclasses","namespaces"];
 const store = Object.fromEntries(KINDS.map(k => [k, new Map()]));
 let activeKind = "pods";
+let selectedPod = null;
+let selectedAttempt = -1;  // -1 = latest (live annotations)
 
 // New-resource templates (the reference's web/components/lib/templates).
 const TEMPLATES = {
@@ -114,7 +144,12 @@ function renderTabs() {
   document.getElementById("tabs").innerHTML = KINDS.map(k =>
     `<span class="tab ${k===activeKind?"active":""}" onclick="setKind('${k}')">${k} (${store[k].size})</span>`).join("");
 }
-function setKind(k) { activeKind = k; document.getElementById("addPanel").style.display = "none"; render(); }
+function setKind(k) {
+  activeKind = k;
+  document.getElementById("addPanel").style.display = "none";
+  hideEdit();
+  render();
+}
 
 const COLS = {
   pods: ["node", "phase", "selected-node"],
@@ -154,7 +189,9 @@ function render() {
     const podAttr = kind === "pods" ? ` data-pod="${esc(key)}"` : "";
     const cls = kind === "pods" && !(o.spec||{}).nodeName ? ' class="pending"' : "";
     tb.insertAdjacentHTML("beforeend",
-      `<tr${podAttr}${cls}><td>${esc(key)}</td>${extra}<td><span class="del" data-key="${esc(key)}">delete</span></td></tr>`);
+      `<tr${podAttr}${cls}><td>${esc(key)}</td>${extra}` +
+      `<td><span class="edit" data-key="${esc(key)}">edit</span>` +
+      `<span class="del" data-key="${esc(key)}">delete</span></td></tr>`);
   }
   // Handlers read dataset values — never inline JS with interpolated
   // strings (entity escaping is undone before the JS engine parses an
@@ -162,35 +199,87 @@ function render() {
   // stored script injection).
   for (const el of document.querySelectorAll(".del"))
     el.onclick = (ev) => { ev.stopPropagation(); doDelete(el.dataset.key); };
+  for (const el of document.querySelectorAll(".edit"))
+    el.onclick = (ev) => { ev.stopPropagation(); showEdit(el.dataset.key); };
   for (const tr of document.querySelectorAll("tr[data-pod]"))
     tr.onclick = () => showResults(tr.dataset.pod);
+  if (document.getElementById("boardPanel").style.display !== "none") renderBoard();
 }
 
-function showResults(key) {
-  const p = store.pods.get(key); if (!p) return;
-  const annos = ((p.metadata||{}).annotations)||{};
-  const cats = ["filter-result","score-result","finalscore-result","postfilter-result",
-                "prefilter-result-status","prescore-result","reserve-result","bind-result",
-                "selected-node","result-history"];
-  let html = `<b>${esc(key)}</b>`;
-  for (const c of cats) {
-    const raw = annos[PREFIX+c]; if (raw === undefined) continue;
-    let body;
-    try {
-      const obj = JSON.parse(raw);
-      if (c.endsWith("-result") && obj && typeof obj === "object" && !Array.isArray(obj)) {
-        const nodesK = Object.keys(obj).sort();
-        const plugins = [...new Set(nodesK.flatMap(n=>Object.keys(obj[n]||{})))].sort();
-        if (plugins.length) {
-          body = `<table><tr><th>node</th>${plugins.map(p=>`<th>${esc(p)}</th>`).join("")}</tr>` +
-            nodesK.map(n=>`<tr><td>${esc(n)}</td>${plugins.map(pl=>`<td>${esc((obj[n]||{})[pl]??"")}</td>`).join("")}</tr>`).join("") +
-            `</table>`;
-        } else { body = `<pre>${esc(JSON.stringify(obj,null,1))}</pre>`; }
-      } else { body = `<pre>${esc(JSON.stringify(obj,null,1))}</pre>`; }
-    } catch (e) { body = `<pre>${esc(raw)}</pre>`; }
-    html += `<h2>${esc(c)}</h2>${body}`;
+// -- pods-by-node board (web/store/pod.ts:12-16,43-51) ----------------------
+
+function renderBoard() {
+  const buckets = new Map([["unscheduled", []]]);
+  for (const name of [...store.nodes.keys()].sort()) buckets.set(name, []);
+  for (const [key, p] of [...store.pods.entries()].sort()) {
+    const node = (p.spec||{}).nodeName || "unscheduled";
+    if (!buckets.has(node)) buckets.set(node, []);
+    buckets.get(node).push(key);
   }
-  document.getElementById("results").innerHTML = html;
+  let html = "";
+  for (const [node, podKeys] of buckets) {
+    const cls = node === "unscheduled" ? "bucket unsched" : "bucket";
+    html += `<div class="${cls}"><h3>${esc(node)} (${podKeys.length})</h3>` +
+      podKeys.map(k=>`<span class="bpod" data-pod="${esc(k)}">${esc(k)}</span>`).join("") +
+      `</div>`;
+  }
+  const board = document.getElementById("board");
+  board.innerHTML = html;
+  for (const el of board.querySelectorAll(".bpod"))
+    el.onclick = () => showResults(el.dataset.pod);
+}
+
+// -- scheduling results + history browser (SchedulingResults.vue) -----------
+
+const RESULT_CATS = ["filter-result","score-result","finalscore-result","postfilter-result",
+  "prefilter-result-status","prescore-result","reserve-result","permit-result",
+  "permit-result-timeout","bind-result","selected-node"];
+
+function categoryHTML(c, raw) {
+  if (raw === undefined) return "";
+  let body;
+  try {
+    const obj = JSON.parse(raw);
+    if (c.endsWith("-result") && obj && typeof obj === "object" && !Array.isArray(obj)) {
+      const nodesK = Object.keys(obj).sort();
+      const plugins = [...new Set(nodesK.flatMap(n=>
+        (obj[n] && typeof obj[n] === "object") ? Object.keys(obj[n]) : []))].sort();
+      if (plugins.length && nodesK.every(n=>obj[n] && typeof obj[n] === "object")) {
+        body = `<table><tr><th>node</th>${plugins.map(p=>`<th>${esc(p)}</th>`).join("")}</tr>` +
+          nodesK.map(n=>`<tr><td>${esc(n)}</td>${plugins.map(pl=>`<td>${esc((obj[n]||{})[pl]??"")}</td>`).join("")}</tr>`).join("") +
+          `</table>`;
+      } else { body = `<pre>${esc(JSON.stringify(obj,null,1))}</pre>`; }
+    } else { body = `<pre>${esc(JSON.stringify(obj,null,1))}</pre>`; }
+  } catch (e) { body = `<pre>${esc(raw)}</pre>`; }
+  return `<h2>${esc(c)}</h2>${body}`;
+}
+
+function showResults(key, attempt = -1) {
+  selectedPod = key; selectedAttempt = attempt;
+  const p = store.pods.get(key);
+  if (!p) { document.getElementById("results").innerHTML = "none selected"; return; }
+  const annos = ((p.metadata||{}).annotations)||{};
+  let history = [];
+  try { history = JSON.parse(annos[PREFIX+"result-history"] || "[]"); } catch (e) {}
+  let html = `<b>${esc(key)}</b>`;
+  // Attempt selector: the result-history annotation holds every past
+  // attempt's full result set (storereflector.go:148-167).
+  if (history.length > 1 || (history.length === 1 && attempt >= 0)) {
+    html += `<div style="margin:.3rem 0">history: ` + history.map((_, i) =>
+      `<span class="attempt ${i===attempt?"active":""}" data-attempt="${i}">#${i+1}</span>`
+    ).join("") +
+    `<span class="attempt ${attempt<0?"active":""}" data-attempt="-1">latest</span></div>`;
+  }
+  const source = attempt >= 0 && history[attempt]
+    ? history[attempt]
+    : annos;
+  for (const c of RESULT_CATS) html += categoryHTML(c, source[PREFIX+c]);
+  if (attempt < 0 && history.length)
+    html += `<h2>attempts recorded</h2><pre>${esc(String(history.length))}</pre>`;
+  const el = document.getElementById("results");
+  el.innerHTML = html;
+  for (const a of el.querySelectorAll(".attempt"))
+    a.onclick = () => showResults(key, parseInt(a.dataset.attempt, 10));
 }
 
 async function watch() {
@@ -210,6 +299,7 @@ async function watch() {
       const map = store[ev.Kind]; if (!map) continue;
       const key = keyOf(ev.Obj);
       if (ev.EventType === "DELETED") map.delete(key); else map.set(key, ev.Obj);
+      if (ev.Kind === "pods" && key === selectedPod) showResults(key, selectedAttempt);
     }
     render();
   }
@@ -238,6 +328,41 @@ async function doAdd() {
       body: JSON.stringify(body)});
     msg.textContent = r.ok ? "created" : `error ${r.status}: ${await r.text()}`;
     if (r.ok) document.getElementById("addPanel").style.display = "none";
+  } catch (e) { msg.textContent = String(e); }
+}
+
+// -- view/edit any live resource (YamlEditor.vue workflow over JSON) --------
+
+let editTarget = null;  // {kind, key}
+
+async function showEdit(key) {
+  const kind = activeKind;
+  const msg = document.getElementById("editMsg");
+  try {
+    const r = await fetch(resourcePath(kind, key));
+    if (!r.ok) { msg.textContent = `load failed: ${r.status}`; return; }
+    const obj = await r.json();
+    editTarget = {kind, key};
+    document.getElementById("editKey").textContent = `${kind}/${key}`;
+    document.getElementById("editText").value = JSON.stringify(obj, null, 1);
+    document.getElementById("editPanel").style.display = "block";
+    msg.textContent = "";
+  } catch (e) { msg.textContent = String(e); }
+}
+function hideEdit() {
+  editTarget = null;
+  document.getElementById("editPanel").style.display = "none";
+}
+async function doSave() {
+  const msg = document.getElementById("editMsg");
+  if (!editTarget) return;
+  try {
+    const body = JSON.parse(document.getElementById("editText").value);
+    const r = await fetch(resourcePath(editTarget.kind, editTarget.key), {
+      method: "PUT", headers: {"Content-Type": "application/json"},
+      body: JSON.stringify(body)});
+    msg.textContent = r.ok ? "saved" : `rejected ${r.status}: ${await r.text()}`;
+    if (r.ok) hideEdit();
   } catch (e) { msg.textContent = String(e); }
 }
 

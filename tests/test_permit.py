@@ -1,0 +1,362 @@
+"""Permit extension point + extender managedResources gating.
+
+Reference semantics under test: per-plugin permit status/timeout
+annotations (wrappedplugin.go:582-611, store.go:549-560), waiting-pod
+allow/reject/timeout (upstream framework waitingPodsMap), and extenders
+engaging only for pods that request a managed resource
+(extender.go:99-112).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from ksim_tpu.engine.annotations import (
+    BIND_RESULT_KEY,
+    PERMIT_RESULT_KEY,
+    PERMIT_TIMEOUT_RESULT_KEY,
+    RESERVE_RESULT_KEY,
+    SELECTED_NODE_KEY,
+)
+from ksim_tpu.engine.core import ScoredPlugin
+from ksim_tpu.scheduler import SchedulerService
+from ksim_tpu.scheduler.permit import PermitResult, go_duration_str
+from ksim_tpu.state.cluster import ClusterStore
+from tests.helpers import make_node, make_pod
+
+
+def test_go_duration_str():
+    # Byte-parity with Go time.Duration.String().
+    assert go_duration_str(0) == "0s"
+    assert go_duration_str(10) == "10s"
+    assert go_duration_str(90) == "1m30s"
+    assert go_duration_str(3600) == "1h0m0s"
+    assert go_duration_str(1.5) == "1.5s"
+    assert go_duration_str(0.5) == "500ms"
+    assert go_duration_str(0.0005) == "500µs"
+    assert go_duration_str(30) == "30s"
+
+
+class _PermitPlugin:
+    """Out-of-tree plugin implementing only the Permit point."""
+
+    name = "GatePlugin"
+
+    def __init__(self, result: PermitResult) -> None:
+        self.result = result
+        self.calls: list[tuple[str, str]] = []
+
+    def permit(self, pod, node_name):
+        self.calls.append((pod["metadata"]["name"], node_name))
+        return self.result
+
+
+def _service_with_permit(store, plugin):
+    def build(feats, args):
+        return ScoredPlugin(plugin, filter_enabled=False, score_enabled=False)
+
+    return SchedulerService(
+        store,
+        config={
+            "profiles": [
+                {"plugins": {"permit": {"enabled": [{"name": plugin.name}]}}}
+            ]
+        },
+        registry={plugin.name: build},
+    )
+
+
+def _store(*pods):
+    store = ClusterStore()
+    store.create("nodes", make_node("n1"))
+    for p in pods:
+        store.create("pods", p)
+    return store
+
+
+def test_permit_allow_binds_and_records():
+    plugin = _PermitPlugin(PermitResult.allow())
+    store = _store(make_pod("p1"))
+    svc = _service_with_permit(store, plugin)
+    placements = svc.schedule_pending()
+    assert placements["default/p1"] == "n1"
+    assert plugin.calls == [("p1", "n1")]
+    pod = store.get("pods", "p1", "default")
+    assert pod["spec"]["nodeName"] == "n1"
+    annos = pod["metadata"]["annotations"]
+    assert json.loads(annos[PERMIT_RESULT_KEY]) == {"GatePlugin": "success"}
+    assert json.loads(annos[PERMIT_TIMEOUT_RESULT_KEY]) == {"GatePlugin": "0s"}
+
+
+def test_permit_reject_blocks_bind_keeps_reserve_records():
+    plugin = _PermitPlugin(PermitResult.reject("quota exhausted"))
+    store = _store(make_pod("p1"))
+    svc = _service_with_permit(store, plugin)
+    placements = svc.schedule_pending()
+    assert placements["default/p1"] is None
+    pod = store.get("pods", "p1", "default")
+    assert "nodeName" not in pod["spec"]
+    annos = pod["metadata"]["annotations"]
+    assert json.loads(annos[PERMIT_RESULT_KEY]) == {"GatePlugin": "quota exhausted"}
+    # Reserve ran (selected-node recorded, upstream AddSelectedNode at
+    # Reserve) but Bind never did.
+    assert annos[SELECTED_NODE_KEY] == "n1"
+    assert json.loads(annos[BIND_RESULT_KEY]) == {}
+    assert RESERVE_RESULT_KEY in annos
+
+
+def test_permit_wait_parks_then_allow_binds():
+    plugin = _PermitPlugin(PermitResult.wait(30))
+    store = _store(make_pod("p1"))
+    svc = _service_with_permit(store, plugin)
+    placements = svc.schedule_pending()
+    assert placements["default/p1"] == "n1"
+    # Parked: not bound, not pending, visible via the waiting API.
+    assert "nodeName" not in store.get("pods", "p1", "default")["spec"]
+    waiting = svc.get_waiting_pods()
+    assert waiting == [
+        {
+            "name": "p1",
+            "namespace": "default",
+            "nodeName": "n1",
+            "pendingPlugins": ["GatePlugin"],
+        }
+    ]
+    assert svc.pending_count() == 0
+    # A second pass must not re-schedule the waiter.
+    assert svc.schedule_pending() == {}
+    # Allow -> binds with the recorded wait status/timeout.
+    assert svc.allow_waiting_pod("p1")
+    pod = store.get("pods", "p1", "default")
+    assert pod["spec"]["nodeName"] == "n1"
+    annos = pod["metadata"]["annotations"]
+    assert json.loads(annos[PERMIT_RESULT_KEY]) == {"GatePlugin": "wait"}
+    assert json.loads(annos[PERMIT_TIMEOUT_RESULT_KEY]) == {"GatePlugin": "30s"}
+    assert json.loads(annos[BIND_RESULT_KEY]) == {"DefaultBinder": "success"}
+    assert svc.get_waiting_pods() == []
+
+
+def test_permit_waiting_pod_charges_node_capacity():
+    # n1 fits ONE of these pods; while the first waits on permit, the
+    # second must not land on n1 (assumed-pod accounting).
+    plugin = _PermitPlugin(PermitResult.wait(30))
+    store = ClusterStore()
+    store.create("nodes", make_node("n1", cpu="1", memory="1Gi"))
+    store.create("pods", make_pod("p1", cpu="800m"))
+    svc = _service_with_permit(store, plugin)
+    svc.schedule_pending()
+    assert svc.get_waiting_pods()[0]["name"] == "p1"
+    store.create("pods", make_pod("p2", cpu="800m"))
+    placements = svc.schedule_pending()
+    assert placements["default/p2"] is None  # n1 is full with the waiter
+
+
+def test_permit_wait_timeout_rejects():
+    plugin = _PermitPlugin(PermitResult.wait(0.2))
+    store = _store(make_pod("p1"))
+    svc = _service_with_permit(store, plugin)
+    svc.schedule_pending()
+    assert len(svc.get_waiting_pods()) == 1
+    time.sleep(0.25)
+    assert svc._expire_waiting() == 1
+    pod = store.get("pods", "p1", "default")
+    assert "nodeName" not in pod["spec"]
+    annos = pod["metadata"]["annotations"]
+    assert json.loads(annos[PERMIT_RESULT_KEY]) == {"GatePlugin": "wait"}
+    assert json.loads(annos[BIND_RESULT_KEY]) == {}
+    # Back in the queue (after backoff) — not parked anymore.
+    assert svc.get_waiting_pods() == []
+
+
+def test_reject_waiting_pod_api():
+    plugin = _PermitPlugin(PermitResult.wait(30))
+    store = _store(make_pod("p1"))
+    svc = _service_with_permit(store, plugin)
+    svc.schedule_pending()
+    assert svc.reject_waiting_pod("p1", message="operator said no")
+    assert svc.get_waiting_pods() == []
+    assert "nodeName" not in store.get("pods", "p1", "default")["spec"]
+    # Unknown pod -> False.
+    assert not svc.reject_waiting_pod("nope")
+
+
+# -- extender managedResources gating ---------------------------------------
+
+
+class _CountingExtender(BaseHTTPRequestHandler):
+    calls: list[str] = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        type(self).calls.append(body["pod"]["metadata"]["name"])
+        names = body.get("nodenames") or []
+        if self.path.endswith("/filter"):
+            out = {"nodenames": names}
+        else:
+            out = [{"host": n, "score": 1} for n in names]
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+@pytest.fixture()
+def counting_extender():
+    _CountingExtender.calls = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _CountingExtender)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_extender_managed_resources_gate(counting_extender):
+    store = ClusterStore()
+    store.create("nodes", make_node("n1", extra_alloc={"example.com/gpu": "4"}))
+    store.create("pods", make_pod("plain"))
+    gpu_pod = make_pod("gpu-pod", extra_requests={"example.com/gpu": "1"})
+    store.create("pods", gpu_pod)
+    svc = SchedulerService(
+        store,
+        config={
+            "extenders": [
+                {
+                    "urlPrefix": counting_extender,
+                    "filterVerb": "filter",
+                    "prioritizeVerb": "prioritize",
+                    "nodeCacheCapable": True,
+                    "managedResources": [{"name": "example.com/gpu"}],
+                }
+            ]
+        },
+    )
+    placements = svc.schedule_pending()
+    assert placements["default/plain"] == "n1"
+    assert placements["default/gpu-pod"] == "n1"
+    # Only the gpu pod engaged the extender (filter + prioritize).
+    assert set(_CountingExtender.calls) == {"gpu-pod"}
+
+
+def test_permit_runs_on_extender_path(counting_extender):
+    """Permit must gate binding on the per-pod extender path too."""
+    plugin = _PermitPlugin(PermitResult.wait(30))
+
+    def build(feats, args):
+        return ScoredPlugin(plugin, filter_enabled=False, score_enabled=False)
+
+    store = _store(make_pod("p1"))
+    svc = SchedulerService(
+        store,
+        config={
+            "profiles": [
+                {"plugins": {"permit": {"enabled": [{"name": plugin.name}]}}}
+            ],
+            "extenders": [
+                {
+                    "urlPrefix": counting_extender,
+                    "filterVerb": "filter",
+                    "nodeCacheCapable": True,
+                }
+            ],
+        },
+        registry={plugin.name: build},
+    )
+    svc.schedule_pending()
+    assert plugin.calls == [("p1", "n1")]
+    assert "nodeName" not in store.get("pods", "p1", "default")["spec"]
+    assert svc.get_waiting_pods()[0]["name"] == "p1"
+    assert svc.allow_waiting_pod("p1")
+    assert store.get("pods", "p1", "default")["spec"]["nodeName"] == "n1"
+
+
+def test_deleting_waiting_pod_clears_entry():
+    """A deleted waiter's entry dies with it: a re-created same-name pod
+    schedules fresh instead of inheriting the stale wait."""
+    plugin = _PermitPlugin(PermitResult.wait(900))
+    store = _store(make_pod("p1"))
+    svc = _service_with_permit(store, plugin)
+    svc.start()
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and not svc.get_waiting_pods():
+            time.sleep(0.05)
+        assert svc.get_waiting_pods()
+        store.delete("pods", "p1", "default")
+        deadline = time.time() + 10
+        while time.time() < deadline and svc.get_waiting_pods():
+            time.sleep(0.05)
+        assert svc.get_waiting_pods() == []
+        # Re-created pod is pending again (parks anew on the next pass).
+        store.create("pods", make_pod("p1"))
+        deadline = time.time() + 60
+        while time.time() < deadline and not svc.get_waiting_pods():
+            time.sleep(0.05)
+        assert svc.get_waiting_pods()[0]["name"] == "p1"
+    finally:
+        svc.stop()
+
+
+def test_permit_first_reject_stops_later_plugins():
+    """Upstream RunPermitPlugins returns on the first failure; later
+    plugins neither run nor record."""
+    rejecter = _PermitPlugin(PermitResult.reject("no"))
+    rejecter.name = "A-Reject"
+    after = _PermitPlugin(PermitResult.allow())
+    after.name = "B-After"
+
+    def build_r(feats, args):
+        return ScoredPlugin(rejecter, filter_enabled=False, score_enabled=False)
+
+    def build_a(feats, args):
+        return ScoredPlugin(after, filter_enabled=False, score_enabled=False)
+
+    store = _store(make_pod("p1"))
+    svc = SchedulerService(
+        store,
+        config={
+            "profiles": [
+                {
+                    "plugins": {
+                        "permit": {
+                            "enabled": [{"name": "A-Reject"}, {"name": "B-After"}]
+                        }
+                    }
+                }
+            ]
+        },
+        registry={"A-Reject": build_r, "B-After": build_a},
+    )
+    svc.schedule_pending()
+    assert after.calls == []
+    annos = store.get("pods", "p1", "default")["metadata"]["annotations"]
+    assert json.loads(annos[PERMIT_RESULT_KEY]) == {"A-Reject": "no"}
+
+
+def test_extender_without_managed_resources_sees_all(counting_extender):
+    store = ClusterStore()
+    store.create("nodes", make_node("n1"))
+    store.create("pods", make_pod("plain"))
+    svc = SchedulerService(
+        store,
+        config={
+            "extenders": [
+                {
+                    "urlPrefix": counting_extender,
+                    "filterVerb": "filter",
+                    "nodeCacheCapable": True,
+                }
+            ]
+        },
+    )
+    assert svc.schedule_pending()["default/plain"] == "n1"
+    assert _CountingExtender.calls == ["plain"]

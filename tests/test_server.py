@@ -232,3 +232,63 @@ def test_resource_crud_routes(server):
     status, _ = _req(server, "POST", "/api/v1/resources/nodes", make_node("c2"))
     assert status == 409
     _req(server, "DELETE", "/api/v1/resources/nodes/c2")
+
+
+def test_ui_edit_workflow_reschedules_pod(server):
+    """The UI's view/edit workflow (YamlEditor.vue analogue): GET a live
+    unschedulable pod through the CRUD, shrink its requests, PUT it back
+    — the watch-driven scheduler must retry it promptly (backoff cleared
+    by the user's update, upstream Pod-update QueueingHints) and bind."""
+    di = server.di
+    di.store.create("nodes", make_node("edit-n1", cpu="2", memory="4Gi"))
+    di.store.create(
+        "pods", make_pod("edit-huge", cpu="32", memory="256Mi")
+    )
+    di.scheduler_service.start()
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            status, pod = _req(server, "GET", "/api/v1/resources/pods/default/edit-huge")
+            if status == 200 and pod["metadata"].get("annotations"):
+                break
+            time.sleep(0.1)
+        assert pod["spec"].get("nodeName") is None  # unschedulable as-is
+        # Edit: make it fit (and tag it, proving arbitrary field edits).
+        pod["spec"]["containers"][0]["resources"]["requests"]["cpu"] = "500m"
+        pod["metadata"].setdefault("labels", {})["edited"] = "yes"
+        status, _ = _req(server, "PUT", "/api/v1/resources/pods/default/edit-huge", pod)
+        assert status == 200
+        deadline = time.time() + 60
+        bound = None
+        while time.time() < deadline and not bound:
+            _, pod = _req(server, "GET", "/api/v1/resources/pods/default/edit-huge")
+            bound = pod["spec"].get("nodeName")
+            time.sleep(0.1)
+        assert bound == "edit-n1"
+        assert pod["metadata"]["labels"]["edited"] == "yes"
+        # Both attempts live in result-history — the data the UI's
+        # attempt browser renders (storereflector.go:148-167).
+        from ksim_tpu.engine.annotations import RESULT_HISTORY_KEY
+
+        history = json.loads(pod["metadata"]["annotations"][RESULT_HISTORY_KEY])
+        assert len(history) >= 2
+        # The failed attempt has no selected-node; the final one does.
+        sel = "kube-scheduler-simulator.sigs.k8s.io/selected-node"
+        assert sel not in history[0]
+        assert history[-1][sel] == "edit-n1"
+    finally:
+        di.scheduler_service.stop()
+
+
+def test_ui_page_has_board_editor_and_history_panels(server):
+    """The built-in page ships the three debuggability surfaces the
+    reference UI has: pods-by-node board with an unscheduled bucket
+    (web/store/pod.ts:12-16), live-resource editor (YamlEditor.vue), and
+    the result-history attempt browser (SchedulingResults.vue)."""
+    c = _conn(server)
+    c.request("GET", "/")
+    body = c.getresponse().read().decode()
+    c.close()
+    assert 'id="board"' in body and "unscheduled" in body
+    assert 'id="editPanel"' in body and "doSave" in body
+    assert "data-attempt" in body and "result-history" in body
