@@ -93,6 +93,7 @@ class ScenarioRunner:
             )
         )
         self._requeue = requeue_on_node_delete
+        self._drained_nodes: set[str] = set()
 
     # -- one operation ------------------------------------------------------
 
@@ -127,20 +128,26 @@ class ScenarioRunner:
             self.store.patch(op.kind, op.name, op.namespace, apply_merge)
         elif op.op == "delete":
             if op.kind == "nodes" and self._requeue:
-                self._requeue_pods_of(op.name)
+                # Deferred: run() re-queues all drained nodes' pods in ONE
+                # pod walk after the step's ops (walking the whole pod
+                # list per node delete dominated churn host time).
+                self._drained_nodes.add(op.name)
             self.store.delete(op.kind, op.name, op.namespace)
         elif op.op == "done":
             pass  # handled in run(): terminates after this step
         else:
             raise ValueError(f"unknown op {op.op!r}")
 
-    def _requeue_pods_of(self, node_name: str) -> None:
-        for pod in self.store.list("pods", copy_objs=False):
-            if pod.get("spec", {}).get("nodeName") == node_name:
-                def clear(obj: JSON) -> None:
-                    obj["spec"].pop("nodeName", None)
-                    obj.get("status", {}).pop("phase", None)
+    def _requeue_pods_of(self, node_names: set[str]) -> None:
+        if not node_names:
+            return
 
+        def clear(obj: JSON) -> None:
+            obj["spec"].pop("nodeName", None)
+            obj.get("status", {}).pop("phase", None)
+
+        for pod in self.store.list("pods", copy_objs=False):
+            if pod.get("spec", {}).get("nodeName") in node_names:
                 self.store.patch("pods", name_of(pod), namespace_of(pod), clear)
 
     # -- replay -------------------------------------------------------------
@@ -157,9 +164,11 @@ class ScenarioRunner:
         for step in sorted(by_step):
             batch = by_step[step]
             done = False
+            self._drained_nodes: set[str] = set()
             for op in batch:
                 self._apply(op)
                 done = done or op.op == "done"
+            self._requeue_pods_of(self._drained_nodes)
             result.events_applied += len(batch)
             # The runner drives the store directly (no watch loop), so it
             # raises the capacity-freed/topology-changed signal itself:

@@ -108,8 +108,14 @@ class SchedulerService:
         pod_bucket_min: int | None = None,
         config_path: str | None = None,
         allow_plugin_imports: bool | None = None,
+        shard_mesh=None,
     ) -> None:
         self._store = store
+        # Optional jax.sharding.Mesh: every engine this service builds is
+        # laid out over it (node axis over "tp", engine/sharding.py).  The
+        # sequential scan wants replicated pod rows — pass a dp=1 mesh
+        # (make_mesh(n, dp=1)) for the scheduling path.
+        self._shard_mesh = shard_mesh
         # builderImport in runtime-applied configs (HTTP / snapshot load)
         # executes arbitrary imports; off unless the operator opts in.
         if allow_plugin_imports is None:
@@ -459,6 +465,8 @@ class SchedulerService:
             plugins = tuple(factory(feats))
             with self.metrics.timer("engine"):
                 eng = Engine(feats, plugins, record=self._record)
+                if self._shard_mesh is not None:
+                    eng.shard(self._shard_mesh)
                 res, _ = eng.schedule(pull_state=False)
             with self.metrics.timer("bind"):
                 self._bind_results(queue, feats, plugins, res, placements)
@@ -611,12 +619,12 @@ class SchedulerService:
                 bound=permit_verdict != REJECT,
             )
             anno.update(self._extenders.store.get_stored_result(pod))
-            if selected is not None and permit_verdict == WAIT:
+            selected, parked = self._settle_permit(
+                pod, selected, permit_verdict, wait_deadlines, anno, placements
+            )
+            if parked:
                 self._extenders.store.delete_data(pod)
-                self._park_waiting(pod, selected, wait_deadlines, anno, placements)
                 continue
-            if selected is not None and permit_verdict == REJECT:
-                selected = None
 
             def mutate(obj: JSON) -> None:
                 annos = obj.setdefault("metadata", {}).setdefault("annotations", {})
@@ -672,13 +680,11 @@ class SchedulerService:
                 if self._record == "full"
                 else {}
             )
-            if node_name is not None and permit_verdict == WAIT:
-                self._park_waiting(pod, node_name, wait_deadlines, anno, placements)
+            node_name, parked = self._settle_permit(
+                pod, node_name, permit_verdict, wait_deadlines, anno, placements
+            )
+            if parked:
                 continue
-            if node_name is not None and permit_verdict == REJECT:
-                # Upstream: Unreserve + back to the queue as unschedulable
-                # (no PostFilter after a Permit rejection).
-                node_name = None
 
             def rebuild(obj: JSON) -> JSON:
                 # Shallow re-wrap (store.rewrap contract): share the
@@ -755,9 +761,15 @@ class SchedulerService:
                 statuses[name] = SUCCESS
                 timeouts[name] = go_duration_str(0)
             elif result.status == WAIT:
+                # Clamp at the RUN site like upstream RunPermitPlugins
+                # (maxTimeout 15 min) — plugins constructing PermitResult
+                # directly must not park pods beyond it.
+                from ksim_tpu.scheduler.permit import MAX_WAIT_SECONDS
+
+                timeout_s = min(result.timeout_seconds, MAX_WAIT_SECONDS)
                 statuses[name] = WAIT
-                timeouts[name] = go_duration_str(result.timeout_seconds)
-                deadlines[name] = _time.monotonic() + result.timeout_seconds
+                timeouts[name] = go_duration_str(timeout_s)
+                deadlines[name] = _time.monotonic() + timeout_s
                 if verdict == SUCCESS:
                     verdict = WAIT
             else:
@@ -768,6 +780,25 @@ class SchedulerService:
                 # failure — later plugins never run or record.
                 break
         return verdict, (statuses, timeouts), deadlines
+
+    def _settle_permit(
+        self,
+        pod: JSON,
+        node_name: str | None,
+        verdict: str,
+        deadlines: dict[str, float],
+        anno: dict[str, str],
+        placements: dict,
+    ) -> tuple[str | None, bool]:
+        """Resolve a permit verdict for a selected pod: WAIT parks it
+        (returns (None, True) — caller skips the bind), REJECT clears the
+        selection (upstream Unreserve, no PostFilter), SUCCESS binds."""
+        if node_name is not None and verdict == WAIT:
+            self._park_waiting(pod, node_name, deadlines, anno, placements)
+            return None, True
+        if node_name is not None and verdict == REJECT:
+            return None, False
+        return node_name, False
 
     def _park_waiting(
         self,
@@ -1051,19 +1082,15 @@ class SchedulerService:
         self._flush_extender_results(ev)
         from ksim_tpu.state.cluster import DELETED
 
-        if ev.event_type != DELETED:
-            # A user-driven pod create/update (self-writes were filtered
-            # above) may have made THIS pod schedulable — e.g. editing its
-            # requests through the UI: drop its backoff so the triggered
-            # pass retries it now (upstream Pod-update QueueingHints move
-            # the pod out of the unschedulable pool immediately).
-            key = f"{namespace_of(ev.obj)}/{name_of(ev.obj)}"
-            with self._backoff_lock:
-                self._backoff.pop(key, None)
+        # Drop the pod's backoff either way: a user-driven create/update
+        # (self-writes were filtered above) may have made THIS pod
+        # schedulable — e.g. editing its requests through the UI — and a
+        # deleted pod's entry is garbage (upstream Pod-event QueueingHints
+        # move the pod out of the unschedulable pool immediately).
+        key = f"{namespace_of(ev.obj)}/{name_of(ev.obj)}"
+        with self._backoff_lock:
+            self._backoff.pop(key, None)
         if ev.event_type == DELETED:
-            key = f"{namespace_of(ev.obj)}/{name_of(ev.obj)}"
-            with self._backoff_lock:
-                self._backoff.pop(key, None)  # the pod is gone
             # A deleted permit-waiter's entry must die with it — a stale
             # entry would block a re-created same-name pod and write the
             # old pod's annotations onto it at timer expiry.
@@ -1125,21 +1152,32 @@ class SchedulerService:
         stream = self._store.watch(self.WATCH_KINDS)
         try:
             self.schedule_pending()
+            idle_ticks = 0
             while not self._stop.is_set():
                 ev = stream.next(timeout=0.1)
                 if ev is None:
-                    # Idle tick: permit-wait timers fire here, and poked
+                    # Idle tick: permit-wait timers fire here, poked
                     # rejections (whose rv-suppressed MODIFIED events the
-                    # loop never sees) get their retry pass.
+                    # loop never sees) get their retry pass, and — because
+                    # backoff is measured in PASSES — an idle cluster
+                    # still advances backed-off pending pods with a
+                    # periodic pass (~1s cadence; an empty eligible queue
+                    # makes the pass nearly free), the analogue of
+                    # upstream's wall-clock backoff queue draining on
+                    # timers rather than on cluster events.
                     poked = self._poke.is_set()
                     if poked:
                         self._poke.clear()
-                    if self._expire_waiting() or poked:
+                    idle_ticks += 1
+                    periodic = idle_ticks >= 10 and self.pending_count() > 0
+                    if self._expire_waiting() or poked or periodic:
+                        idle_ticks = 0
                         try:
                             self.schedule_pending()
                         except Exception:  # pragma: no cover
                             logger.exception("scheduling pass failed")
                     continue
+                idle_ticks = 0
                 if not self._relevant(ev):
                     continue
                 # Drain whatever queued behind this event before one pass.

@@ -118,6 +118,7 @@ def sync_family(
     Returns the family's arrays (the live master — callers must treat
     them as read-only and copy before handing them to the engine).
     """
+    diff = state.get("__diff__")
     fam = state.get(name)
     if fam is not None and fam["token"] != token:
         fam = None
@@ -140,6 +141,7 @@ def sync_family(
             "by_slot": by_slot,
             "nones": nones,
             "arrays": arrays,
+            "gen": diff["gen"] if diff else None,
         }
         return arrays
 
@@ -169,8 +171,24 @@ def sync_family(
             apply(arrays, rec, +1)
             by_slot.setdefault(rec[0], set()).add(pid)
 
-    # 1. Departures.
-    for pid in [pid for pid in records if pid not in bound_map]:
+    # 1+3. Departures and arrivals.  When the caller published a shared
+    # per-pass diff ("__diff__" in the state dict, written once by the
+    # featurizer) and this family was synced on the immediately preceding
+    # pass, consume the diff directly — O(changed) instead of two
+    # O(bound) scans per family per pass (the dict-walk cost dominated
+    # saturated churn-replay host time).  Any gap in the family's sync
+    # history (fresh family, skipped pass) falls back to the full scans.
+    if (
+        diff is not None
+        and fam.get("gen") is not None
+        and fam["gen"] == diff["gen"] - 1
+    ):
+        departures = [pid for pid in diff["removed"] if pid in records]
+        arrivals = [(pid, bound_map[pid]) for pid in diff["added"]]
+    else:
+        departures = [pid for pid in records if pid not in bound_map]
+        arrivals = None
+    for pid in departures:
         _drop(pid)
     # 2. Slot repairs: pods whose node changed (or vanished/moved), plus
     #    previously node-less pods whenever any slot changed (their node
@@ -186,7 +204,14 @@ def sync_family(
                 _drop(pid)
                 _add(pid, p)
     # 3. Arrivals.
-    for pid, p in bound_map.items():
-        if pid not in records:
-            _add(pid, p)
+    if arrivals is not None:
+        for pid, p in arrivals:
+            if pid not in records:
+                _add(pid, p)
+    else:
+        for pid, p in bound_map.items():
+            if pid not in records:
+                _add(pid, p)
+    if diff is not None:
+        fam["gen"] = diff["gen"]
     return arrays

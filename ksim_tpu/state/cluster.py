@@ -12,6 +12,7 @@ simulator/reset/reset.go:33-85 snapshots the etcd prefix the same way).
 
 from __future__ import annotations
 
+import bisect
 import collections
 import copy
 import itertools
@@ -81,6 +82,12 @@ class ClusterStore:
         self._history: "collections.deque[tuple[int, WatchEvent]]" = (
             collections.deque(maxlen=self.HISTORY_DEPTH)
         )
+        # Name-sorted (name, key) order per kind, maintained INCREMENTALLY
+        # (bisect insert/remove on membership changes; updates keep their
+        # key).  The scheduler lists every kind every pass and churn
+        # replay mutates membership every step — re-sorting thousands of
+        # unchanged objects per list() dominated churn-replay host time.
+        self._sorted_keys: dict[str, list[tuple[str, str]]] = {k: [] for k in KINDS}
 
     # -- CRUD ---------------------------------------------------------------
 
@@ -97,6 +104,7 @@ class ClusterStore:
             md["resourceVersion"] = str(next(self._rv))
             md.setdefault("uid", f"uid-{kind}-{md['resourceVersion']}")
             self._objects[kind][key] = obj
+            bisect.insort(self._sorted_keys[kind], (name_of(obj), key))
             # The stored object is frozen (writes replace, never mutate), so
             # the event and history can share it without a copy.
             self._notify(WatchEvent(kind, ADDED, obj))
@@ -119,10 +127,10 @@ class ClusterStore:
         mutate and must not hold them across store writes."""
         self._check_kind(kind)
         with self._lock:
-            objs = self._objects[kind].values()
+            table = self._objects[kind]
+            out = [table[k] for _, k in self._sorted_keys[kind]]
             if namespace and kind in NAMESPACED_KINDS:
-                objs = [o for o in objs if namespace_of(o) == namespace]
-            out = sorted(objs, key=name_of)
+                out = [o for o in out if namespace_of(o) == namespace]
             return copy.deepcopy(out) if copy_objs else out
 
     def update(self, kind: str, obj: JSON, *, expect_rv: str | None = None) -> JSON:
@@ -200,6 +208,11 @@ class ClusterStore:
             obj = self._objects[kind].pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{kind} {key!r} not found")
+            entry = (name_of(obj), key)
+            idx = bisect.bisect_left(self._sorted_keys[kind], entry)
+            sk = self._sorted_keys[kind]
+            if idx < len(sk) and sk[idx] == entry:
+                del sk[idx]
             # A delete is a new store event: stamp a fresh resourceVersion
             # (like the apiserver) so watch-resume replay — which filters
             # history on rv > lastResourceVersion — never drops it.  The
@@ -305,6 +318,7 @@ class ClusterStore:
                     obj = dict(obj, metadata=dict(obj["metadata"], resourceVersion=str(next(self._rv))))
                     self._notify(WatchEvent(kind, DELETED, obj))
                 self._objects[kind].clear()
+                self._sorted_keys[kind] = []
             for kind, objs in dump.items():
                 self._check_kind(kind)
                 for key, obj in objs.items():
@@ -313,6 +327,7 @@ class ClusterStore:
                         next(self._rv)
                     )
                     self._objects[kind][key] = restored
+                    bisect.insort(self._sorted_keys[kind], (name_of(restored), key))
                     self._notify(WatchEvent(kind, ADDED, restored))
 
     def _check_kind(self, kind: str) -> None:

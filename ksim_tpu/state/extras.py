@@ -97,6 +97,10 @@ class NodePortTensors:
     pod_adds: np.ndarray  # i32 [P, V]
 
 
+# Trivial no-host-ports tensors per (n_padded, p_padded).
+_NO_PORTS: dict = {}
+
+
 def encode_node_ports(
     nodes: Sequence[JSON],
     pods: Sequence[JSON],
@@ -112,6 +116,20 @@ def encode_node_ports(
     from ksim_tpu.state.featurizer import vocab_pad
 
     v = vocab_pad(len(vocab))
+    if not vocab:
+        # No queue pod wants a host port: every tensor is zero whatever
+        # the bound pods hold — skip the bound walk (churn steady state).
+        hit = _NO_PORTS.get((n_padded, p_padded))
+        if hit is None:
+            hit = NodePortTensors(
+                conflict_counts=np.zeros((n_padded, v), dtype=np.int32),
+                pod_wants=np.zeros((p_padded, v), dtype=bool),
+                pod_adds=np.zeros((p_padded, v), dtype=np.int32),
+            )
+            if len(_NO_PORTS) > 64:
+                _NO_PORTS.clear()
+            _NO_PORTS[(n_padded, p_padded)] = hit
+        return hit
     entries = list(vocab)
 
     conflict_counts = np.zeros((n_padded, v), dtype=np.int32)

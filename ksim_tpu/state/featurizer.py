@@ -167,6 +167,10 @@ class Featurizer:
 
         self._slots = NodeSlots()
         self._agg: dict[str, Any] = {}
+        # Shared per-pass bound-set diff (see boundagg.sync_family): one
+        # O(bound) comparison per pass instead of one per family.
+        self._prev_bound: dict[int, JSON] = {}
+        self._bound_gen = 0
 
     def featurize(
         self,
@@ -206,6 +210,17 @@ class Featurizer:
         # caller's order.
         nodes, changed_slots = self._slots.sync(nodes)
         bound_map = {id(p): p for p in bound_pods}
+        # Publish the shared arrival/departure diff for every family this
+        # pass syncs (holding the previous map's pod refs keeps ids from
+        # being recycled while they can still appear in a diff).
+        prev = self._prev_bound
+        self._bound_gen += 1
+        self._agg["__diff__"] = {
+            "gen": self._bound_gen,
+            "added": [pid for pid in bound_map if pid not in prev],
+            "removed": [pid for pid in prev if pid not in bound_map],
+        }
+        self._prev_bound = bound_map
 
         node_alloc = [node_allocatable(n) for n in nodes]
         pod_reqs = [pod_requests(p) for p in sched_pods]

@@ -185,6 +185,42 @@ def test_reject_waiting_pod_api():
     assert not svc.reject_waiting_pod("nope")
 
 
+def test_rejected_waiter_is_retried_and_binds():
+    """A rejected waiter must not stall in an idle cluster: the watch
+    loop's poked/periodic passes retry it past its backoff (upstream's
+    wall-clock backoff queue drains on timers, not only cluster events)."""
+
+    class FlipGate:
+        name = "FlipGate"
+
+        def __init__(self):
+            self.calls = 0
+
+        def permit(self, pod, node_name):
+            self.calls += 1
+            return PermitResult.wait(300) if self.calls == 1 else PermitResult.allow()
+
+    plugin = FlipGate()
+    store = _store(make_pod("p1"))
+    svc = _service_with_permit(store, plugin)
+    svc.start()
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and not svc.get_waiting_pods():
+            time.sleep(0.05)
+        assert svc.get_waiting_pods(), "pod never parked"
+        assert svc.reject_waiting_pod("p1", message="operator")
+        # No further cluster events: the retry must come from the loop.
+        deadline = time.time() + 60
+        bound = None
+        while time.time() < deadline and not bound:
+            bound = store.get("pods", "p1", "default")["spec"].get("nodeName")
+            time.sleep(0.1)
+        assert bound == "n1", "rejected waiter was never retried"
+    finally:
+        svc.stop()
+
+
 # -- extender managedResources gating ---------------------------------------
 
 
