@@ -37,6 +37,7 @@ kernel would surface through ``CompiledProfile.skipped``.
 from __future__ import annotations
 
 import logging
+import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
@@ -182,13 +183,31 @@ def load_plugin_import(spec: str) -> tuple[Builder, dict]:
     The attribute may be a Builder ``(feats, args) -> ScoredPlugin``, or
     a dict/object exposing ``builder`` and optionally ``extra_encoders``
     (aux key -> featurizer extra encoder) for plugins that ship their own
-    tensors."""
+    tensors.
+
+    A non-empty ``KSIM_ALLOWED_PLUGIN_MODULES`` (comma-separated module
+    prefixes) narrows the trust gate from all-or-nothing to an operator
+    allowlist: only modules equal to or under a listed prefix may load
+    (the closest Python analogue to the reference confining wasm guests
+    to the configured guestURL sandbox, wasm.go:14-58)."""
     import importlib
 
     mod, sep, attr = spec.partition(":")
     if not sep or not mod or not attr:
         raise ValueError(
             f"plugin import {spec!r} must look like 'pkg.module:attr'"
+        )
+    allowlist = [
+        p.strip()
+        for p in os.environ.get("KSIM_ALLOWED_PLUGIN_MODULES", "").split(",")
+        if p.strip()
+    ]
+    if allowlist and not any(
+        mod == p or mod.startswith(p + ".") for p in allowlist
+    ):
+        raise ValueError(
+            f"plugin import {spec!r}: module {mod!r} is not in "
+            "KSIM_ALLOWED_PLUGIN_MODULES"
         )
     try:
         target = getattr(importlib.import_module(mod), attr)

@@ -162,3 +162,27 @@ def test_out_of_tree_registry():
     feats = Featurizer().featurize([make_node("n")], [], queue_pods=[make_pod("p")])
     plugins = prof.plugins(feats)
     assert any(sp.plugin.name == "ConstantScore" for sp in plugins)
+
+
+def test_builder_import_module_allowlist(monkeypatch):
+    """KSIM_ALLOWED_PLUGIN_MODULES narrows builderImport from
+    all-or-nothing to an operator allowlist of module prefixes."""
+    from ksim_tpu.scheduler.profile import load_plugin_import
+
+    monkeypatch.setenv("KSIM_ALLOWED_PLUGIN_MODULES", "ksim_tpu.plugins, mycorp")
+    # Allowed prefix loads (the sample plugin ships a builder).
+    builder, _enc = load_plugin_import(
+        "ksim_tpu.plugins.samples.nodenumber:NODE_NUMBER_PLUGIN"
+    )
+    assert callable(builder)
+    # Outside the allowlist: refused even though importable.
+    with pytest.raises(ValueError, match="KSIM_ALLOWED_PLUGIN_MODULES"):
+        load_plugin_import("json:loads")
+    # Prefix match is per-component: "mycorpx" is not under "mycorp".
+    with pytest.raises(ValueError, match="KSIM_ALLOWED_PLUGIN_MODULES"):
+        load_plugin_import("mycorpx.evil:b")
+    # Empty allowlist = no narrowing (the all-or-nothing gate upstream of
+    # this function still applies).
+    monkeypatch.delenv("KSIM_ALLOWED_PLUGIN_MODULES")
+    builder, _enc = load_plugin_import("json:loads")
+    assert callable(builder)
