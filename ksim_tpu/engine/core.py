@@ -184,6 +184,60 @@ def _aux_host(aux: dict) -> tuple[dict, dict]:
 # are bucketed upstream, so churn replay sees only a handful).
 _UNPACK_CACHE: dict[tuple, Any] = {}
 
+# One jitted byte-pack program per output signature (the device->host
+# mirror of _pack_tree_to_device).
+_OUTPACK_CACHE: dict[tuple, Any] = {}
+
+
+def _pull_tree_to_host(tree):
+    """Transfer a pytree of device arrays to host numpy with ONE
+    device->host transfer: a jitted program bitcasts every leaf to bytes
+    and concatenates them into a single uint8 buffer; the host splits and
+    re-views.  The record="full" product path pulls 5 result tensors per
+    pod chunk — on the remote-tunnel runtime each pull is a blocking
+    round-trip, so collapsing them is the mirror of the input packing."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if len(leaves) < 2 or not all(
+        hasattr(a, "dtype") and np.dtype(a.dtype) != object for a in leaves
+    ):
+        # Mirror _pack_tree_to_device's non-array fallback.
+        return jax.tree_util.tree_unflatten(
+            treedef, [np.asarray(a) for a in leaves]
+        )
+    sig = tuple((np.dtype(a.dtype).str, a.shape) for a in leaves)
+    fn = _OUTPACK_CACHE.get(sig)
+    if fn is None:
+
+        def pack(*xs):
+            chunks = []
+            for x in xs:
+                if x.dtype == jnp.bool_:
+                    x = x.astype(jnp.uint8)
+                if x.dtype != jnp.uint8:
+                    # Every chunk must be uint8: concatenate would PROMOTE
+                    # a stray int8 chunk and silently double the buffer.
+                    x = jax.lax.bitcast_convert_type(x, jnp.uint8)
+                chunks.append(x.reshape(-1))
+            return jnp.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+
+        fn = jax.jit(pack)
+        _OUTPACK_CACHE[sig] = fn
+    buf = np.asarray(fn(*leaves))
+    out = []
+    off = 0
+    for dtype_str, shape in sig:
+        dt = np.dtype(dtype_str)
+        n = int(np.prod(shape, dtype=np.int64))
+        nbytes = n * dt.itemsize
+        seg = buf[off : off + nbytes]
+        if dt == np.bool_:
+            arr = seg.astype(np.bool_)
+        else:
+            arr = seg.view(dt)
+        out.append(arr.reshape(shape))
+        off += nbytes
+    return jax.tree_util.tree_unflatten(treedef, out)
+
 
 def _pack_tree_to_device(tree):
     """Move a pytree of host arrays to device with ONE byte-buffer
@@ -594,7 +648,7 @@ class Engine:
         ([P, plugins, N] in record="full") never exceed one chunk's worth
         of device memory; chunks stream to host and concatenate."""
         outs = [
-            jax.tree_util.tree_map(np.asarray, out)
+            _pull_tree_to_host(out)
             for _s, out in self.evaluate_batch_chunks(chunk=chunk)
         ]
         merged = jax.tree_util.tree_map(
@@ -632,13 +686,11 @@ class Engine:
                 lambda x: x[s : s + chunk], self._pods
             )
             state, carries, out = self._prog._schedule_fn(state, pods_c, self._aux, carries)
-            outs.append(jax.tree_util.tree_map(np.asarray, out))
+            outs.append(_pull_tree_to_host(out))
         merged = jax.tree_util.tree_map(
             lambda *xs: np.concatenate(xs, axis=0), *outs
         )
-        final_state = (
-            jax.tree_util.tree_map(np.asarray, state) if pull_state else None
-        )
+        final_state = _pull_tree_to_host(state) if pull_state else None
         return self._to_result(merged), final_state
 
     # -- decode -------------------------------------------------------------

@@ -378,19 +378,31 @@ class Featurizer:
         ptol = np.zeros(PP, dtype=bool)
         phas = np.zeros(PP, dtype=bool)
         base_set = set(BASE_RESOURCES)
-        for j, p in enumerate(sched_pods):
-            preq[j] = lower(pod_reqs[j])
-            pnz[j] = lower(pod_nz_reqs[j])
-            pvalid[j] = True
-            ptol[j] = tolerations_tolerate_taint(
-                pod_tolerations(p), UNSCHEDULABLE_TAINT
-            )
+
+        def pod_base(p: JSON, j: int):
+            """One memo entry bundling the pod's base-row pieces — a
+            saturated churn pass re-featurizes ~1k unchanged pods, and
+            one lookup per pod beats four."""
+            key = ("podbase", objcache.ref_id(p), units_token)
+            hit = objcache.get(key)
+            if hit is not objcache.MISS:
+                return hit
+            reqs = pod_reqs[j]
             # Upstream fitsRequest early-exit predicate: base requests all
             # zero AND no scalar-resource key present (a zero-valued
             # extended-resource key still defeats the early return).
-            phas[j] = any(pod_reqs[j].get(r, 0) for r in BASE_RESOURCES) or any(
-                k not in base_set and k != PODS for k in pod_reqs[j]
+            bundle = (
+                lower(reqs),
+                lower(pod_nz_reqs[j]),
+                tolerations_tolerate_taint(pod_tolerations(p), UNSCHEDULABLE_TAINT),
+                any(reqs.get(r, 0) for r in BASE_RESOURCES)
+                or any(k not in base_set and k != PODS for k in reqs),
             )
+            return objcache.put(key, bundle)
+
+        for j, p in enumerate(sched_pods):
+            preq[j], pnz[j], ptol[j], phas[j] = pod_base(p, j)
+            pvalid[j] = True
 
         from ksim_tpu.state.encoding import (
             encode_affinity,

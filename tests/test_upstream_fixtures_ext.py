@@ -1,0 +1,212 @@
+"""More independently-derived upstream-v1.30 fixtures: NodeAffinity
+scoring and the volume family filters.
+
+Like tests/test_upstream_fixtures.py, every expected value below is
+hand-computed from the upstream algorithm definitions (cited per test) —
+never from the repo's oracle — and asserted against BOTH the oracle and
+the compiled kernels through the engine.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from ksim_tpu.engine import Engine
+from ksim_tpu.engine.profiles import default_plugins
+from ksim_tpu.plugins import oracle
+from ksim_tpu.state.featurizer import Featurizer
+from tests.helpers import make_node, make_pod
+
+ZONE_KEY = "topology.kubernetes.io/zone"
+
+
+def _engine_result(nodes, bound, queue, **volume_kw):
+    feats = Featurizer().featurize(nodes, bound, queue_pods=queue, **volume_kw)
+    eng = Engine(feats, default_plugins(feats), record="full")
+    return feats, eng.evaluate_batch()
+
+
+def test_node_affinity_preferred_scoring_fixture():
+    """node_affinity.go Score = sum of matched preferred-term weights;
+    NormalizeScore = DefaultNormalizeScore(100, reverse=false):
+      raw = [80+20, 80, 0] = [100, 80, 0]; max = 100
+      normalized = [100*100/100, 100*80/100, 0] = [100, 80, 0]
+    (weights sum BEFORE normalization; the 0-weight term never counts).
+    """
+    nodes = [
+        make_node("both", labels={"disk": "ssd", "gpu": "yes"}),
+        make_node("ssd-only", labels={"disk": "ssd"}),
+        make_node("neither", labels={"disk": "hdd"}),
+    ]
+    pod = make_pod("p0")
+    pod["spec"]["affinity"] = {
+        "nodeAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "weight": 80,
+                    "preference": {
+                        "matchExpressions": [
+                            {"key": "disk", "operator": "In", "values": ["ssd"]}
+                        ]
+                    },
+                },
+                {
+                    "weight": 20,
+                    "preference": {
+                        "matchExpressions": [
+                            {"key": "gpu", "operator": "Exists"}
+                        ]
+                    },
+                },
+            ]
+        }
+    }
+    infos = oracle.build_node_infos(nodes, [])
+    raw = [oracle.node_affinity_score(pod, info) for info in infos]
+    assert raw == [100, 80, 0]
+    assert oracle.default_normalize_score(raw, reverse=False) == [100, 80, 0]
+
+    _feats, res = _engine_result(nodes, [], [pod])
+    si = res.plugin_names.index("NodeAffinity")
+    weight = 2  # upstream default-profile weight
+    assert [int(res.scores[0, si, ni]) for ni in range(3)] == [100, 80, 0]
+    assert [int(res.final_scores[0, si, ni]) for ni in range(3)] == [
+        weight * s for s in (100, 80, 0)
+    ]
+
+
+def _pvc(name, volume_name="", storage_class="", access_modes=("ReadWriteOnce",)):
+    spec = {"accessModes": list(access_modes)}
+    if volume_name:
+        spec["volumeName"] = volume_name
+    if storage_class:
+        spec["storageClassName"] = storage_class
+    return {
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+        "status": {"phase": "Bound" if volume_name else "Pending"},
+    }
+
+
+def _pv(name, *, zone=None, node_affinity_host=None, phase="Available"):
+    pv = {
+        "metadata": {"name": name, "labels": {}},
+        "spec": {"capacity": {"storage": "1Gi"}, "accessModes": ["ReadWriteOnce"]},
+        "status": {"phase": phase},
+    }
+    if zone:
+        pv["metadata"]["labels"][ZONE_KEY] = zone
+    if node_affinity_host:
+        pv["spec"]["nodeAffinity"] = {
+            "required": {
+                "nodeSelectorTerms": [
+                    {
+                        "matchExpressions": [
+                            {
+                                "key": "kubernetes.io/hostname",
+                                "operator": "In",
+                                "values": [node_affinity_host],
+                            }
+                        ]
+                    }
+                ]
+            }
+        }
+    return pv
+
+
+def _pod_with_pvc(name, claim):
+    pod = make_pod(name)
+    pod["spec"]["volumes"] = [
+        {"name": "data", "persistentVolumeClaim": {"claimName": claim}}
+    ]
+    return pod
+
+
+def test_volume_zone_filter_fixture():
+    """volume_zone.go: a pod using a PVC bound to a PV labeled with a
+    zone may only land on nodes whose zone label matches (exact upstream
+    semantics: the node must carry the PV's zone value)."""
+    nodes = [
+        make_node("in-zone", labels={ZONE_KEY: "z1", "kubernetes.io/hostname": "in-zone"}),
+        make_node("out-zone", labels={ZONE_KEY: "z2", "kubernetes.io/hostname": "out-zone"}),
+    ]
+    pvs = [_pv("pv-z1", zone="z1", phase="Bound")]
+    pvs[0]["spec"]["claimRef"] = {"name": "claim-a", "namespace": "default"}
+    pvcs = [_pvc("claim-a", volume_name="pv-z1")]
+    pod = _pod_with_pvc("p0", "claim-a")
+
+    for node, want_pass in ((nodes[0], True), (nodes[1], False)):
+        reasons = oracle.volume_zone_filter(pod, node, pvcs, pvs)
+        assert (not reasons) == want_pass, node["metadata"]["name"]
+
+    _feats, res = _engine_result(
+        nodes, [], [pod], pvs=pvs, pvcs=pvcs, storage_classes=[]
+    )
+    fi = res.filter_plugin_names.index("VolumeZone")
+    assert int(res.reason_bits[0, fi, 0]) == 0
+    assert int(res.reason_bits[0, fi, 1]) != 0
+
+
+def test_volume_binding_node_affinity_fixture():
+    """volume_binding.go: a bound PV's nodeAffinity restricts the pod to
+    admitted nodes ("node(s) had volume node affinity conflict")."""
+    nodes = [
+        make_node("node-a", labels={"kubernetes.io/hostname": "node-a"}),
+        make_node("node-b", labels={"kubernetes.io/hostname": "node-b"}),
+    ]
+    pvs = [_pv("pv-a", node_affinity_host="node-a", phase="Bound")]
+    pvs[0]["spec"]["claimRef"] = {"name": "claim-a", "namespace": "default"}
+    pvcs = [_pvc("claim-a", volume_name="pv-a")]
+    pod = _pod_with_pvc("p0", "claim-a")
+
+    for node, want_pass in ((nodes[0], True), (nodes[1], False)):
+        reasons = oracle.volume_binding_filter(pod, node, pvcs, pvs, [])
+        assert (not reasons) == want_pass, node["metadata"]["name"]
+
+    _feats, res = _engine_result(
+        nodes, [], [pod], pvs=pvs, pvcs=pvcs, storage_classes=[]
+    )
+    fi = res.filter_plugin_names.index("VolumeBinding")
+    assert int(res.reason_bits[0, fi, 0]) == 0
+    assert int(res.reason_bits[0, fi, 1]) != 0
+
+
+def test_volume_binding_unbound_claims_fixture():
+    """volume_binding.go unbound-PVC semantics:
+    - an unbound PVC whose StorageClass is Immediate -> unschedulable
+      everywhere ("pod has unbound immediate PersistentVolumeClaims");
+    - WaitForFirstConsumer with a dynamically-provisionable class -> every
+      node passes (provisioning satisfies it);
+    - a missing PVC -> unschedulable everywhere."""
+    nodes = [make_node("n0"), make_node("n1")]
+    scs = [
+        {
+            "metadata": {"name": "immediate-sc"},
+            "provisioner": "ebs.csi.aws.com",
+            "volumeBindingMode": "Immediate",
+        },
+        {
+            "metadata": {"name": "wffc-sc"},
+            "provisioner": "ebs.csi.aws.com",
+            "volumeBindingMode": "WaitForFirstConsumer",
+        },
+    ]
+    cases = [
+        (_pvc("imm-claim", storage_class="immediate-sc"), "imm-claim", False),
+        (_pvc("wffc-claim", storage_class="wffc-sc"), "wffc-claim", True),
+        (None, "ghost-claim", False),
+    ]
+    for pvc, claim, want_pass in cases:
+        pvcs = [pvc] if pvc else []
+        pod = _pod_with_pvc("p0", claim)
+        for node in nodes:
+            reasons = oracle.volume_binding_filter(pod, node, pvcs, [], scs)
+            assert (not reasons) == want_pass, (claim, node["metadata"]["name"])
+        _feats, res = _engine_result(
+            nodes, [], [pod], pvs=[], pvcs=pvcs, storage_classes=scs
+        )
+        fi = res.filter_plugin_names.index("VolumeBinding")
+        for ni in range(2):
+            passes = int(res.reason_bits[0, fi, ni]) == 0
+            assert passes == want_pass, (claim, ni)
