@@ -19,6 +19,12 @@ simulator/server/server.go:44-54, handlers under server/handler/):
                                              -> extender webhook proxy
                                                 (server.go:88-93)
 
+Beyond the reference surface: /api/v1/resources/* CRUD (the role the
+KWOK apiserver plays for the reference UI), GET /api/v1/metrics, and the
+Permit waiting-pod view/ops (GET /api/v1/waitingpods, POST
+/api/v1/waitingpods/<ns>/<name>/{allow,reject} — the framework handle's
+WaitingPod surface for external permit controllers).
+
 CORS headers come from ``cors_allowed_origins`` (the reference reads them
 from config, server.go:28-32)."""
 
@@ -113,6 +119,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, self.server.di.snapshot_service.snap())
         elif url.path == "/api/v1/metrics":
             self._json(200, self.server.di.scheduler_service.metrics.snapshot())
+        elif url.path == "/api/v1/waitingpods":
+            # Permit-parked pods (the framework handle's waiting-pod view).
+            self._json(200, {"items": self.server.di.scheduler_service.get_waiting_pods()})
         elif url.path == "/api/v1/listwatchresources":
             self._list_watch(parse_qs(url.query))
         elif url.path.startswith("/api/v1/resources/"):
@@ -134,10 +143,41 @@ class _Handler(BaseHTTPRequestHandler):
             self._no_content(200)
         elif url.path.startswith("/api/v1/extender/"):
             self._extender(url.path)
+        elif url.path.startswith("/api/v1/waitingpods/"):
+            self._waiting_pod_op(url.path)
         elif url.path.startswith("/api/v1/resources/"):
             self._resource("POST", url.path)
         else:
             self._json(404, {"message": "Not Found"})
+
+    def _waiting_pod_op(self, path: str) -> None:
+        """POST /api/v1/waitingpods/<ns>/<name>/{allow,reject} — the
+        framework handle's WaitingPod.Allow/Reject over REST (an external
+        permit controller's surface; in-process plugins use the service
+        API directly)."""
+        # Drain the request body FIRST, on every branch: the server keeps
+        # HTTP/1.1 connections alive, and unread body bytes would parse as
+        # the next request line on a pooled connection.
+        try:
+            body = self._body() or {}
+        except Exception:
+            body = {}
+        parts = [p for p in path.split("/") if p]  # api v1 waitingpods ns name verb
+        if len(parts) != 6 or parts[5] not in ("allow", "reject"):
+            self._json(404, {"message": "Not Found"})
+            return
+        _api, _v1, _wp, ns, name, verb = parts
+        svc = self.server.di.scheduler_service
+        if verb == "allow":
+            ok = svc.allow_waiting_pod(name, ns)
+        else:
+            ok = svc.reject_waiting_pod(
+                name, ns, message=body.get("message") or "rejected"
+            )
+        if not ok:
+            self._json(404, {"message": f"no waiting pod {ns}/{name}"})
+            return
+        self._json(200, {"status": "ok"})
 
     def do_PUT(self) -> None:
         url = urlparse(self.path)

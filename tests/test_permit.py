@@ -396,3 +396,63 @@ def test_extender_without_managed_resources_sees_all(counting_extender):
     )
     assert svc.schedule_pending()["default/plain"] == "n1"
     assert _CountingExtender.calls == ["plain"]
+
+
+def test_waiting_pods_http_surface():
+    """GET /api/v1/waitingpods + POST .../allow|reject — the REST form of
+    the framework handle for external permit controllers."""
+    import http.client
+
+    from ksim_tpu.server import DIContainer, SimulatorServer
+
+    plugin = _PermitPlugin(PermitResult.wait(300))
+
+    def build(feats, args):
+        return ScoredPlugin(plugin, filter_enabled=False, score_enabled=False)
+
+    di = DIContainer(
+        scheduler_config={
+            "profiles": [
+                {"plugins": {"permit": {"enabled": [{"name": plugin.name}]}}}
+            ]
+        },
+        registry={plugin.name: build},
+    )
+    di.store.create("nodes", make_node("n1"))
+    di.store.create("pods", make_pod("p1"))
+    di.store.create("pods", make_pod("p2"))
+    srv = SimulatorServer(di, port=0).start()
+
+    def req(method, path, body=None):
+        c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        c.request(method, path, json.dumps(body) if body is not None else None,
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        data = r.read()
+        c.close()
+        return r.status, json.loads(data) if data else None
+
+    try:
+        di.scheduler_service.schedule_pending()
+        status, out = req("GET", "/api/v1/waitingpods")
+        assert status == 200
+        assert sorted(w["name"] for w in out["items"]) == ["p1", "p2"]
+        # Allow one over REST -> binds.
+        status, _ = req("POST", "/api/v1/waitingpods/default/p1/allow")
+        assert status == 200
+        assert di.store.get("pods", "p1", "default")["spec"]["nodeName"]
+        # Reject the other -> back to pending, annotations recorded.
+        status, _ = req(
+            "POST", "/api/v1/waitingpods/default/p2/reject",
+            {"message": "external controller said no"},
+        )
+        assert status == 200
+        assert "nodeName" not in di.store.get("pods", "p2", "default")["spec"]
+        # Gone now.
+        status, _ = req("POST", "/api/v1/waitingpods/default/p2/allow")
+        assert status == 404
+        status, out = req("GET", "/api/v1/waitingpods")
+        assert out["items"] == []
+    finally:
+        srv.shutdown_server()
+        di.shutdown()
