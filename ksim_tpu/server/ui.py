@@ -208,11 +208,36 @@ function render() {
 
 // -- pods-by-node board (web/store/pod.ts:12-16,43-51) ----------------------
 
+// Permit-parked pods (assumed on their node but not bound yet): cached
+// map refreshed with a single-in-flight fetch; renderBoard itself stays
+// synchronous so overlapping watch chunks can't interleave stale
+// responses over newer board states.
+let waitingMap = new Map();
+let waitingFetch = null;
+function refreshWaiting() {
+  if (waitingFetch) return;
+  waitingFetch = fetch("/api/v1/waitingpods")
+    .then(r => r.json())
+    .then(out => {
+      waitingMap = new Map(
+        (out.items || []).map(w => [keyOf({metadata: w}), w.nodeName]));
+      waitingFetch = null;
+      renderBoardNow();
+    })
+    .catch(() => { waitingFetch = null; });
+}
+
 function renderBoard() {
+  refreshWaiting();
+  renderBoardNow();
+}
+
+function renderBoardNow() {
+  const waiting = waitingMap;
   const buckets = new Map([["unscheduled", []]]);
   for (const name of [...store.nodes.keys()].sort()) buckets.set(name, []);
   for (const [key, p] of [...store.pods.entries()].sort()) {
-    const node = (p.spec||{}).nodeName || "unscheduled";
+    const node = (p.spec||{}).nodeName || waiting.get(key) || "unscheduled";
     if (!buckets.has(node)) buckets.set(node, []);
     buckets.get(node).push(key);
   }
@@ -220,7 +245,10 @@ function renderBoard() {
   for (const [node, podKeys] of buckets) {
     const cls = node === "unscheduled" ? "bucket unsched" : "bucket";
     html += `<div class="${cls}"><h3>${esc(node)} (${podKeys.length})</h3>` +
-      podKeys.map(k=>`<span class="bpod" data-pod="${esc(k)}">${esc(k)}</span>`).join("") +
+      podKeys.map(k=>{
+        const tag = waiting.has(k) ? " ⏳" : "";
+        return `<span class="bpod" data-pod="${esc(k)}">${esc(k)}${tag}</span>`;
+      }).join("") +
       `</div>`;
   }
   const board = document.getElementById("board");
