@@ -205,13 +205,13 @@ def test_rejected_waiter_is_retried_and_binds():
     svc = _service_with_permit(store, plugin)
     svc.start()
     try:
-        deadline = time.time() + 60
+        deadline = time.time() + 120
         while time.time() < deadline and not svc.get_waiting_pods():
             time.sleep(0.05)
         assert svc.get_waiting_pods(), "pod never parked"
         assert svc.reject_waiting_pod("p1", message="operator")
         # No further cluster events: the retry must come from the loop.
-        deadline = time.time() + 60
+        deadline = time.time() + 120
         bound = None
         while time.time() < deadline and not bound:
             bound = store.get("pods", "p1", "default")["spec"].get("nodeName")
@@ -323,18 +323,18 @@ def test_deleting_waiting_pod_clears_entry():
     svc = _service_with_permit(store, plugin)
     svc.start()
     try:
-        deadline = time.time() + 60
+        deadline = time.time() + 120
         while time.time() < deadline and not svc.get_waiting_pods():
             time.sleep(0.05)
         assert svc.get_waiting_pods()
         store.delete("pods", "p1", "default")
-        deadline = time.time() + 10
+        deadline = time.time() + 30
         while time.time() < deadline and svc.get_waiting_pods():
             time.sleep(0.05)
         assert svc.get_waiting_pods() == []
         # Re-created pod is pending again (parks anew on the next pass).
         store.create("pods", make_pod("p1"))
-        deadline = time.time() + 60
+        deadline = time.time() + 120
         while time.time() < deadline and not svc.get_waiting_pods():
             time.sleep(0.05)
         assert svc.get_waiting_pods()[0]["name"] == "p1"
