@@ -319,11 +319,17 @@ class _Orchestrator:
             return
         rungs = self.payload["rungs"]
         headline = 0
+        headline_platform = None
         for key, r in rungs.items():
             if key != "churn" and isinstance(r, dict) and "sched_pairs_per_sec" in r:
                 headline = r["sched_pairs_per_sec"]
+                headline_platform = r.get("platform")
         self.payload["value"] = headline
         self.payload["vs_baseline"] = round(headline / 50_000, 2)
+        if headline_platform:
+            # Attribute the record to the backend that actually produced
+            # the headline rung (a mid-run fallback may mix platforms).
+            self.payload["platform"] = headline_platform
         # The leading newline terminates any partially-written line if a
         # signal interrupted an in-flight print; the flag flips only AFTER
         # the line is out, so a signal handler re-entering emit() mid-print
@@ -481,6 +487,11 @@ def main() -> None:
         nonlocal env, fallback
         if fallback:
             return False
+        if orch.remaining() < 75:
+            # Not enough budget for a meaningful probe (backend init can
+            # take up to PROBE_TIMEOUT): a clamped 5s probe would declare
+            # a healthy chip dead on a budget-exhaustion timeout.
+            return False
         reprobe = orch.run_child("probe", [], env, 60)
         if "error" not in reprobe:
             return False
@@ -490,7 +501,6 @@ def main() -> None:
         env = _sanitized_env()
         fallback = True
         payload["fallback_cpu"] = True
-        payload["platform"] = "cpu"  # the headline's producer from here on
         return True
 
     def run_rung_stage(n_pods: int, n_nodes: int) -> None:
@@ -530,16 +540,26 @@ def main() -> None:
         if orch.remaining() < 60:
             payload["rungs"]["churn"] = {"error": "skipped: budget exhausted"}
             return
-        payload["rungs"]["churn"] = orch.run_child(
-            "churn",
-            [
-                "--seed", str(args.seed),
-                "--churn-events", str(churn_events),
-                "--churn-nodes", str(churn_nodes),
-            ],
-            env,
-            CHURN_TIMEOUT,
-        )
+
+        def launch(events: int, nodes: int) -> dict:
+            return orch.run_child(
+                "churn",
+                [
+                    "--seed", str(args.seed),
+                    "--churn-events", str(events),
+                    "--churn-nodes", str(nodes),
+                ],
+                env,
+                CHURN_TIMEOUT,
+            )
+
+        result = launch(churn_events, churn_nodes)
+        if "error" in result and check_mid_run_fallback():
+            # Chip died during churn: one CPU retry at the reduced size
+            # so the config-5 record exists.
+            retry = launch(min(churn_events, 2_000), min(churn_nodes, 500))
+            result = retry if "error" not in retry else result
+        payload["rungs"]["churn"] = result
         orch.flush_partial()
 
     # Stage order is a record-priority decision: the smallest rung first

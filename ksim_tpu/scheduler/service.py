@@ -1151,7 +1151,13 @@ class SchedulerService:
     def _run(self) -> None:
         stream = self._store.watch(self.WATCH_KINDS)
         try:
-            self.schedule_pending()
+            try:
+                self.schedule_pending()
+            except Exception:  # pragma: no cover - keep the loop alive
+                # An initial-pass failure (fault injection found an
+                # unprotected call here) must not kill the loop: the
+                # periodic idle pass retries pending pods.
+                logger.exception("initial scheduling pass failed")
             idle_ticks = 0
             while not self._stop.is_set():
                 ev = stream.next(timeout=0.1)
