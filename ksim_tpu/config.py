@@ -84,7 +84,19 @@ def load_config(path: str | None = None) -> SimulatorConfig:
     snap_path = os.environ.get("EXTERNAL_SNAPSHOT_PATH") or raw.get(
         "externalSnapshotPath", ""
     )
+    # Explicit sources first (env alias, then yaml).  The reference's
+    # KUBECONFIG (docs/environment-variables.md) is honored as a FALLBACK
+    # only when an import feature is ON and no source is configured: the
+    # ubiquitous kubectl variable must neither leak into unrelated runs
+    # nor conflict with an explicitly configured snapshot path.  kubectl
+    # allows an os.pathsep-separated list; the first existing entry wins
+    # (full kubeconfig merging is out of scope).
     kube_config = os.environ.get("KUBE_CONFIG") or raw.get("kubeConfig", "")
+    if (ext_import or sync) and not kube_config and not snap_path:
+        ambient = os.environ.get("KUBECONFIG") or ""
+        entries = [p for p in ambient.split(os.pathsep) if p]
+        existing = [p for p in entries if os.path.exists(p)]
+        kube_config = (existing or entries[:1] or [""])[0]
     if ext_import and sync:
         # Reference: mutually exclusive (config.go:88-90).
         raise InvalidConfigError(

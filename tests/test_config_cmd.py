@@ -26,6 +26,7 @@ def clean_env(monkeypatch):
         "RESOURCE_SYNC_ENABLED",
         "EXTERNAL_SNAPSHOT_PATH",
         "KUBE_CONFIG",
+        "KUBECONFIG",
     ):
         monkeypatch.delenv(k, raising=False)
     return monkeypatch
@@ -68,6 +69,18 @@ def test_import_modes_mutually_exclusive(tmp_path, clean_env):
         "port: 1212\nresourceSyncEnabled: true\nkubeConfig: /tmp/kc.yaml\n"
     )
     assert load_config(str(cfg_file)).kube_config == "/tmp/kc.yaml"
+    # The reference's KUBECONFIG env var works as a fallback source...
+    clean_env.setenv("KUBECONFIG", "/tmp/ambient-kc.yaml")
+    cfg_file.write_text("port: 1212\nresourceSyncEnabled: true\n")
+    assert load_config(str(cfg_file)).kube_config == "/tmp/ambient-kc.yaml"
+    # ...but never conflicts with an explicitly configured snapshot path.
+    cfg_file.write_text(
+        "port: 1212\nexternalImportEnabled: true\n"
+        "externalSnapshotPath: /tmp/x.json\n"
+    )
+    cfg = load_config(str(cfg_file))
+    assert cfg.external_snapshot_path == "/tmp/x.json" and not cfg.kube_config
+    clean_env.delenv("KUBECONFIG")
     # ...but not alongside a snapshot file.
     cfg_file.write_text(
         "port: 1212\nexternalImportEnabled: true\nkubeConfig: /tmp/kc.yaml\n"

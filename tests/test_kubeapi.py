@@ -377,8 +377,16 @@ def test_syncer_survives_apiserver_outage():
         time.sleep(1.5)  # a few reconnect attempts against a dead port
 
         # Server returns on the SAME port with new state added meanwhile.
+        # (Short retry: another process could grab the freed port.)
         state.apply("nodes", ADDED, make_node("n1"))
-        srv2 = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        srv2 = None
+        for _ in range(50):
+            try:
+                srv2 = ThreadingHTTPServer(("127.0.0.1", port), handler)
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert srv2 is not None, "could not rebind the outage port"
         srv2.daemon_threads = True
         threading.Thread(target=srv2.serve_forever, daemon=True).start()
         try:
