@@ -210,3 +210,100 @@ def test_volume_binding_unbound_claims_fixture():
         for ni in range(2):
             passes = int(res.reason_bits[0, fi, ni]) == 0
             assert passes == want_pass, (claim, ni)
+
+
+def test_node_ports_conflict_fixture():
+    """nodeports/node_ports.go Fits: a (hostIP, protocol, hostPort)
+    triple conflicts with an existing pod's triple iff the ports and
+    protocols match and either side binds 0.0.0.0 (or the IPs match)."""
+    nodes = [make_node("node-a"), make_node("node-b")]
+    holder = make_pod("holder", node_name="node-a")
+    holder["spec"]["containers"][0]["ports"] = [
+        {"hostPort": 8080, "protocol": "TCP"}  # hostIP defaults 0.0.0.0
+    ]
+    cases = [
+        # Same port+protocol vs a 0.0.0.0 binder -> conflict even with a
+        # specific hostIP.
+        ({"hostPort": 8080, "protocol": "TCP", "hostIP": "10.0.0.1"}, False),
+        # Different port -> fits.
+        ({"hostPort": 8081, "protocol": "TCP"}, True),
+        # Different protocol -> fits.
+        ({"hostPort": 8080, "protocol": "UDP"}, True),
+    ]
+    for port, fits_a in cases:
+        pod = make_pod("incoming")
+        pod["spec"]["containers"][0]["ports"] = [dict(port)]
+        want = [] if fits_a else ["node(s) didn't have free ports for the requested pod ports"]
+        got = oracle.node_ports_filter(pod, [holder])
+        assert (not got) == (not want), (port, got)
+        _feats, res = _engine_result(nodes, [holder], [pod])
+        fi = res.filter_plugin_names.index("NodePorts")
+        assert (int(res.reason_bits[0, fi, 0]) == 0) == fits_a, port
+        assert int(res.reason_bits[0, fi, 1]) == 0, port  # node-b always free
+
+
+def test_node_unschedulable_and_toleration_fixture():
+    """node_unschedulable.go: spec.unschedulable fails the filter unless
+    the pod tolerates node.kubernetes.io/unschedulable:NoSchedule."""
+    nodes = [make_node("open"), make_node("cordoned", unschedulable=True)]
+    plain = make_pod("plain")
+    tolerant = make_pod(
+        "tolerant",
+        tolerations=[
+            {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"}
+        ],
+    )
+    infos = oracle.build_node_infos(nodes, [])
+    assert oracle.node_unschedulable_filter(plain, infos[1])  # blocked
+    assert not oracle.node_unschedulable_filter(plain, infos[0])
+    assert not oracle.node_unschedulable_filter(tolerant, infos[1])  # tolerated
+
+    _feats, res = _engine_result(nodes, [], [plain, tolerant])
+    fi = res.filter_plugin_names.index("NodeUnschedulable")
+    assert int(res.reason_bits[0, fi, 0]) == 0
+    assert int(res.reason_bits[0, fi, 1]) != 0  # plain blocked on cordoned
+    assert int(res.reason_bits[1, fi, 1]) == 0  # tolerant passes
+
+
+def test_taint_toleration_filter_fixture():
+    """taint_toleration.go Filter: the first untolerated NoSchedule/
+    NoExecute taint rejects; PreferNoSchedule never filters."""
+    nodes = [
+        make_node("clean"),
+        make_node("tainted", taints=[{"key": "k", "value": "v", "effect": "NoSchedule"}]),
+        make_node("soft", taints=[{"key": "k", "value": "v", "effect": "PreferNoSchedule"}]),
+    ]
+    plain = make_pod("plain")
+    tolerant = make_pod(
+        "tolerant",
+        tolerations=[{"key": "k", "operator": "Equal", "value": "v", "effect": "NoSchedule"}],
+    )
+    _feats, res = _engine_result(nodes, [], [plain, tolerant])
+    fi = res.filter_plugin_names.index("TaintToleration")
+    # plain: clean ok, NoSchedule blocked, PreferNoSchedule ok (score-only).
+    assert int(res.reason_bits[0, fi, 0]) == 0
+    assert int(res.reason_bits[0, fi, 1]) != 0
+    assert int(res.reason_bits[0, fi, 2]) == 0
+    # tolerant passes everywhere.
+    for ni in range(3):
+        assert int(res.reason_bits[1, fi, ni]) == 0
+
+
+def test_node_name_filter_fixture():
+    """nodename/node_name.go: spec.nodeName pins the pod to that node."""
+    nodes = [make_node("wanted"), make_node("other")]
+    pod = make_pod("pinned")
+    pod["spec"]["nodeName"] = ""  # unset: all pass
+    pinned = make_pod("really-pinned")
+    pinned["spec"]["nodeName"] = "wanted"
+    # The queue path: featurize treats queue pods as unscheduled, so the
+    # pinned pod arrives via queue_pods with its nodeName intent intact.
+    feats = Featurizer().featurize(nodes, [], queue_pods=[pinned])
+    eng = Engine(feats, default_plugins(feats), record="full")
+    res = eng.evaluate_batch()
+    fi = res.filter_plugin_names.index("NodeName")
+    assert int(res.reason_bits[0, fi, 0]) == 0  # wanted passes
+    assert int(res.reason_bits[0, fi, 1]) != 0  # other blocked
+    infos = oracle.build_node_infos(nodes, [])
+    assert not oracle.node_name_filter(pinned, infos[0])
+    assert oracle.node_name_filter(pinned, infos[1])
