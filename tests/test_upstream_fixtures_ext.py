@@ -290,20 +290,21 @@ def test_taint_toleration_filter_fixture():
 
 
 def test_node_name_filter_fixture():
-    """nodename/node_name.go: spec.nodeName pins the pod to that node."""
+    """nodename/node_name.go: spec.nodeName pins the pod to that node;
+    an unset/empty nodeName passes everywhere."""
     nodes = [make_node("wanted"), make_node("other")]
-    pod = make_pod("pinned")
-    pod["spec"]["nodeName"] = ""  # unset: all pass
+    unset = make_pod("unset")
+    unset["spec"]["nodeName"] = ""
     pinned = make_pod("really-pinned")
     pinned["spec"]["nodeName"] = "wanted"
-    # The queue path: featurize treats queue pods as unscheduled, so the
-    # pinned pod arrives via queue_pods with its nodeName intent intact.
-    feats = Featurizer().featurize(nodes, [], queue_pods=[pinned])
-    eng = Engine(feats, default_plugins(feats), record="full")
-    res = eng.evaluate_batch()
+    _feats, res = _engine_result(nodes, [], [unset, pinned])
     fi = res.filter_plugin_names.index("NodeName")
-    assert int(res.reason_bits[0, fi, 0]) == 0  # wanted passes
-    assert int(res.reason_bits[0, fi, 1]) != 0  # other blocked
+    for ni in range(2):  # unset passes everywhere
+        assert int(res.reason_bits[0, fi, ni]) == 0
+    assert int(res.reason_bits[1, fi, 0]) == 0  # wanted passes
+    assert int(res.reason_bits[1, fi, 1]) != 0  # other blocked
     infos = oracle.build_node_infos(nodes, [])
+    assert not oracle.node_name_filter(unset, infos[0])
+    assert not oracle.node_name_filter(unset, infos[1])
     assert not oracle.node_name_filter(pinned, infos[0])
     assert oracle.node_name_filter(pinned, infos[1])
