@@ -59,6 +59,9 @@ LADDER = [(1_000, 200), (5_000, 1_000), (10_000, 5_000)]
 # seconds (warm cache) — only the 10000x5000 record="full" rung exceeds
 # its cap on CPU.
 CPU_LADDER = [(1_000, 200), (5_000, 1_000)]
+# Churn size CPU can replay well inside the stage cap (events, nodes) —
+# used by both the planned-fallback clamp and the mid-run retry.
+CPU_CHURN_CAP = (10_000, 1_000)
 
 # Per-stage subprocess timeouts (seconds).  Cold XLA compiles of the
 # large-shape scan programs cost 5-60 s each; the persistent compile cache
@@ -537,10 +540,10 @@ def main() -> None:
         churn_nodes = args.churn_nodes
         if fallback:
             # CPU can't chew the full 50k inside the budget, but the
-            # optimized host path replays 10k events in well under the
-            # stage cap — a real dynamic-state record, not a token one.
-            churn_events = min(churn_events, 10_000)
-            churn_nodes = min(churn_nodes, 1_000)
+            # optimized host path replays CPU_CHURN_CAP events in well
+            # under the stage cap — a real dynamic-state record.
+            churn_events = min(churn_events, CPU_CHURN_CAP[0])
+            churn_nodes = min(churn_nodes, CPU_CHURN_CAP[1])
         if orch.remaining() < 60:
             payload["rungs"]["churn"] = {"error": "skipped: budget exhausted"}
             return
@@ -562,7 +565,10 @@ def main() -> None:
             # Chip died during churn: one CPU retry at the same reduced
             # size the planned-fallback path uses, so the config-5 record
             # exists.
-            retry = launch(min(churn_events, 10_000), min(churn_nodes, 1_000))
+            retry = launch(
+                min(churn_events, CPU_CHURN_CAP[0]),
+                min(churn_nodes, CPU_CHURN_CAP[1]),
+            )
             result = retry if "error" not in retry else result
         payload["rungs"]["churn"] = result
         orch.flush_partial()

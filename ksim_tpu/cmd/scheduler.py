@@ -64,7 +64,7 @@ def run_scheduler(argv: list[str] | None = None) -> int:
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
         signal.signal(signal.SIGINT, lambda *_: stop.set())
         stop.wait()
-        service.stop()
+        service.stop(timeout=None)  # process exit: join the loop for real
     else:
         placements = service.schedule_pending()
         scheduled = sum(1 for v in placements.values() if v)

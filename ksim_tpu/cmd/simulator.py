@@ -110,7 +110,7 @@ def start_simulator(argv: list[str] | None = None) -> int:
             syncer.stop()
         if kube_source is not None:
             kube_source.close()
-        di.shutdown()
+        di.shutdown(timeout=None)  # process exit: join the loop for real
     return 0
 
 

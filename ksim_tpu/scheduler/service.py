@@ -1048,11 +1048,22 @@ class SchedulerService:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, timeout: "float | None" = 5.0) -> None:
+        """Stop the watch loop.  ``timeout=None`` joins indefinitely.  A
+        thread that outlives a finite timeout (likely parked in an XLA
+        compile; it notices _stop on return) is KEPT on self._thread so a
+        later stop() can join it for real — exiting the process with it
+        alive risks heap corruption during runtime teardown."""
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=5)
-            self._thread = None
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                logger.warning(
+                    "scheduler loop still busy after %.0fs; call "
+                    "stop(timeout=None) before process exit", timeout or 0
+                )
+            else:
+                self._thread = None
 
     # Kinds whose changes can make a pending pod schedulable.
     WATCH_KINDS = (

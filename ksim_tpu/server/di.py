@@ -45,5 +45,8 @@ class DIContainer:
         svc = self.scheduler_service.extender_service
         return svc if svc else None
 
-    def shutdown(self) -> None:
-        self.scheduler_service.stop()
+    def shutdown(self, timeout: "float | None" = 5.0) -> None:
+        """Stop services.  Callers about to EXIT the process should pass a
+        generous (or None) timeout: an abandoned loop thread alive during
+        runtime teardown can corrupt the heap (SchedulerService.stop)."""
+        self.scheduler_service.stop(timeout=timeout)

@@ -1,0 +1,172 @@
+"""Concurrency stress: hammer the store + scheduler + watch + reset from
+many threads and assert invariants.
+
+The reference's race story is mutexes + conflict retries with no race
+tests at all (SURVEY.md §5: `go test ./...` without -race).  This tier
+drives every shared structure concurrently — CRUD writers, watch
+consumers, the scheduling loop, resets — and asserts nothing corrupts:
+no unexpected exceptions, watch streams see a consistent event order,
+and the store's sorted index stays exact under interleaved membership
+churn.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+from ksim_tpu.errors import SimulatorError
+from ksim_tpu.scheduler import SchedulerService
+from ksim_tpu.state.cluster import DELETED, ClusterStore
+from tests.helpers import make_node, make_pod
+
+
+def _run_threads(workers, duration=4.0):
+    """Run worker(stop_event) callables concurrently; collect errors."""
+    stop = threading.Event()
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+
+    def wrap(fn):
+        def run():
+            try:
+                fn(stop)
+            except BaseException as e:  # noqa: BLE001 - collected for assert
+                with lock:
+                    errors.append(e)
+
+        return run
+
+    threads = [threading.Thread(target=wrap(w), daemon=True) for w in workers]
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    return errors
+
+
+def test_store_crud_watch_reset_hammer():
+    """Interleaved create/update/delete/list/watch/restore across threads:
+    no exceptions beyond expected conflicts, and the sorted list order
+    stays exactly name-sorted afterwards."""
+    store = ClusterStore()
+    for i in range(20):
+        store.create("nodes", make_node(f"seed-{i:02d}"))
+    boot = store.dump()
+
+    def writer(stop):
+        rng = random.Random(threading.get_ident())
+        n = 0
+        while not stop.is_set():
+            name = f"w{threading.get_ident() % 997}-{n % 50}"
+            n += 1
+            try:
+                store.create("pods", make_pod(name))
+            except SimulatorError:
+                try:
+                    store.delete("pods", name, "default")
+                except SimulatorError:
+                    pass
+            if rng.random() < 0.3:
+                try:
+                    store.patch(
+                        "pods", name, "default",
+                        lambda o: o["metadata"].setdefault("labels", {}).update(x="y"),
+                    )
+                except SimulatorError:
+                    pass
+
+    def lister(stop):
+        while not stop.is_set():
+            pods = store.list("pods", copy_objs=False)
+            names = [p["metadata"]["name"] for p in pods]
+            assert names == sorted(names), "sorted index corrupted"
+            store.list("nodes")
+
+    def watcher(stop):
+        stream = store.watch(("pods",))
+        try:
+            while not stop.is_set():
+                ev = stream.next(timeout=0.05)
+                if ev is not None:
+                    assert ev.kind == "pods"
+                    json.dumps(ev.to_json())  # serializable under churn
+        finally:
+            stream.close()
+
+    def resetter(stop):
+        while not stop.is_set():
+            time.sleep(0.7)
+            store.restore(boot)
+
+    errors = _run_threads([writer, writer, lister, watcher, resetter])
+    assert not errors, errors
+    # Final invariant: index matches table exactly, in name order.
+    for kind in ("pods", "nodes"):
+        objs = store.list(kind, copy_objs=False)
+        assert len(objs) == len(store._objects[kind])
+        names = [o["metadata"]["name"] for o in objs]
+        assert names == sorted(names)
+
+
+def test_scheduler_under_concurrent_churn():
+    """The watch-driven scheduler stays consistent while other threads
+    churn pods/nodes: every bound pod points at an existing node or a
+    node that was deleted after binding; the loop survives to the end."""
+    store = ClusterStore()
+    for i in range(6):
+        store.create("nodes", make_node(f"n{i}", cpu="8", memory="16Gi"))
+    svc = SchedulerService(store, record="selection", preemption=False)
+    svc.start()
+    deleted_nodes: set[str] = set()
+    lock = threading.Lock()
+
+    def pod_churner(stop):
+        rng = random.Random(1)
+        n = 0
+        while not stop.is_set():
+            try:
+                store.create("pods", make_pod(f"c{n}", cpu="100m"))
+            except SimulatorError:
+                pass
+            n += 1
+            if rng.random() < 0.4 and n > 3:
+                try:
+                    store.delete("pods", f"c{rng.randrange(n)}", "default")
+                except SimulatorError:
+                    pass
+            time.sleep(0.01)
+
+    def node_churner(stop):
+        i = 6
+        while not stop.is_set():
+            time.sleep(0.5)
+            try:
+                with lock:
+                    deleted_nodes.add(f"n{i - 6}")
+                store.delete("nodes", f"n{i - 6}")
+            except SimulatorError:
+                pass
+            store.create("nodes", make_node(f"n{i}", cpu="8", memory="16Gi"))
+            i += 1
+
+    try:
+        errors = _run_threads([pod_churner, node_churner], duration=5.0)
+        assert not errors, errors
+        # Let the loop quiesce, then check the binding invariant.
+        time.sleep(2.0)
+        node_names = {n["metadata"]["name"] for n in store.list("nodes")}
+        with lock:
+            ok_targets = node_names | deleted_nodes
+        for p in store.list("pods"):
+            nn = p["spec"].get("nodeName")
+            assert nn is None or nn in ok_targets, f"pod bound to unknown node {nn}"
+    finally:
+        # A loop thread still mid-XLA-compile at interpreter exit can
+        # corrupt the heap during runtime teardown (observed once, cold
+        # cache): join it for real before pytest exits.
+        svc.stop(timeout=None)
