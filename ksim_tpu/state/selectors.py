@@ -40,7 +40,12 @@ def _match_label_expression(expr: JSON, labels: dict[str, str]) -> bool:
     if op == "In":
         return key in labels and labels[key] in values
     if op == "NotIn":
-        return key in labels and labels[key] not in values
+        # Upstream labels.Requirement.Matches: a NotIn requirement is
+        # SATISFIED when the key is absent (selector.go: `if !ls.Has(key)
+        # { return true }` for NotIn/NotEquals) — discovered by the
+        # independent NodeAffinity operator fixture; presence was wrongly
+        # required here before round 3.
+        return key not in labels or labels[key] not in values
     if op == "Exists":
         return key in labels
     if op == "DoesNotExist":

@@ -308,3 +308,54 @@ def test_node_name_filter_fixture():
     assert not oracle.node_name_filter(unset, infos[1])
     assert not oracle.node_name_filter(pinned, infos[0])
     assert oracle.node_name_filter(pinned, infos[1])
+
+
+def test_node_affinity_required_operators_fixture():
+    """nodeaffinity.go required terms with the full operator set:
+    Gt/Lt compare integer label values, NotIn rejects listed values (and
+    passes when the key is absent), matchFields matches metadata.name
+    (upstream supports only that field)."""
+    nodes = [
+        make_node("big", labels={"cpu-gen": "9"}),
+        make_node("small", labels={"cpu-gen": "3"}),
+        make_node("unlabeled"),
+    ]
+
+    def pod_with_term(term):
+        pod = make_pod("p")
+        pod["spec"]["affinity"] = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [term]
+                }
+            }
+        }
+        return pod
+
+    cases = [
+        # Gt 5: only big (9 > 5); unlabeled has no value -> fail.
+        ({"matchExpressions": [{"key": "cpu-gen", "operator": "Gt", "values": ["5"]}]},
+         [True, False, False]),
+        # Lt 5: only small.
+        ({"matchExpressions": [{"key": "cpu-gen", "operator": "Lt", "values": ["5"]}]},
+         [False, True, False]),
+        # NotIn ["9"]: small passes, big fails, ABSENT key passes
+        # (upstream NotIn matches when the label is missing).
+        ({"matchExpressions": [{"key": "cpu-gen", "operator": "NotIn", "values": ["9"]}]},
+         [False, True, True]),
+        # DoesNotExist: only unlabeled.
+        ({"matchExpressions": [{"key": "cpu-gen", "operator": "DoesNotExist"}]},
+         [False, False, True]),
+        # matchFields on metadata.name.
+        ({"matchFields": [{"key": "metadata.name", "operator": "In", "values": ["small"]}]},
+         [False, True, False]),
+    ]
+    infos = oracle.build_node_infos(nodes, [])
+    for term, want in cases:
+        pod = pod_with_term(term)
+        got_oracle = [not oracle.node_affinity_filter(pod, info) for info in infos]
+        assert got_oracle == want, (term, got_oracle)
+        _feats, res = _engine_result(nodes, [], [pod])
+        fi = res.filter_plugin_names.index("NodeAffinity")
+        got_kernel = [int(res.reason_bits[0, fi, ni]) == 0 for ni in range(3)]
+        assert got_kernel == want, (term, got_kernel)
