@@ -359,3 +359,89 @@ def test_node_affinity_required_operators_fixture():
         fi = res.filter_plugin_names.index("NodeAffinity")
         got_kernel = [int(res.reason_bits[0, fi, ni]) == 0 for ni in range(3)]
         assert got_kernel == want, (term, got_kernel)
+
+
+def test_topology_spread_min_domains_fixture():
+    """filtering.go minDomains (stable since v1.27): when the number of
+    eligible domains is BELOW minDomains, the global minimum match count
+    is treated as 0.  Layout: z1 and z2 each hold ONE matching pod; the
+    incoming pod matches its own selector (selfMatchNum = 1).
+
+    - without minDomains: min = 1 -> skew = 1+1-1 = 1 <= maxSkew -> both
+      zones schedulable;
+    - minDomains=3 (> 2 domains): min treated as 0 -> skew = 1+1-0 = 2 >
+      maxSkew -> BOTH zones violate."""
+    nodes = [
+        make_node("za-node", labels={ZONE_KEY: "za", "kubernetes.io/hostname": "za-node"}),
+        make_node("zb-node", labels={ZONE_KEY: "zb", "kubernetes.io/hostname": "zb-node"}),
+    ]
+    bound = [
+        make_pod("e-a", labels={"app": "spread"}, node_name="za-node"),
+        make_pod("e-b", labels={"app": "spread"}, node_name="zb-node"),
+    ]
+
+    def incoming(min_domains):
+        con = {
+            "maxSkew": 1,
+            "topologyKey": ZONE_KEY,
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "spread"}},
+        }
+        if min_domains is not None:
+            con["minDomains"] = min_domains
+        return make_pod(
+            "incoming", labels={"app": "spread"}, topology_spread_constraints=[con]
+        )
+
+    from tests.helpers import pods_by_node
+
+    infos = oracle.build_node_infos(nodes, bound)
+    for min_domains, want_pass in ((None, True), (3, False)):
+        pod = incoming(min_domains)
+        rows = oracle.topology_spread_filter_all(pod, infos, pods_by_node(bound))
+        assert all((not r) == want_pass for r in rows), (min_domains, rows)
+        _feats, res = _engine_result(nodes, bound, [pod])
+        fi = res.filter_plugin_names.index("PodTopologySpread")
+        for ni in range(2):
+            assert (int(res.reason_bits[0, fi, ni]) == 0) == want_pass, (
+                min_domains, ni,
+            )
+
+
+def test_image_locality_duplicate_container_images_fixture():
+    """image_locality.go sumImageScores iterates CONTAINERS, so two
+    containers sharing one image count its scaled score twice:
+      1 node total -> scaled = size * 1/1 = 300 MB; sum = 600 MB
+      maxThreshold = 1000 MB * 2 containers = 2000 MB
+      score = int(100 * (600-23) / (2000-23)) = int(29.18) = 29."""
+    node = make_node("n0")
+    node["status"]["images"] = [{"names": ["img-shared"], "sizeBytes": 300 * 1024 * 1024}]
+    pod = make_pod("p0")
+    pod["spec"]["containers"] = [
+        {"name": "c1", "image": "img-shared", "resources": {"requests": {"cpu": "100m"}}},
+        {"name": "c2", "image": "img-shared", "resources": {"requests": {"cpu": "100m"}}},
+    ]
+    states = oracle.build_image_states([node])
+    assert oracle.image_locality_score(pod, node, states, 1) == 29
+    _feats, res = _engine_result([node], [], [pod])
+    si = res.plugin_names.index("ImageLocality")
+    assert int(res.scores[0, si, 0]) == 29
+
+
+def test_fit_too_many_pods_fixture():
+    """fit.go fitsRequest checks pod COUNT capacity first: a node whose
+    `pods` allocatable is exhausted reports exactly "Too many pods" even
+    when cpu/memory fit."""
+    nodes = [make_node("full", pods=1), make_node("free", pods=10)]
+    bound = [make_pod("occupier", node_name="full")]
+    pod = make_pod("incoming", cpu="100m", memory="64Mi")
+    infos = oracle.build_node_infos(nodes, bound)
+    assert oracle.fit_filter(pod, infos[0]) == ["Too many pods"]
+    assert oracle.fit_filter(pod, infos[1]) == []
+    _feats, res = _engine_result(nodes, bound, [pod])
+    fi = res.filter_plugin_names.index("NodeResourcesFit")
+    from ksim_tpu.plugins.noderesources import NodeResourcesFit
+
+    fit = NodeResourcesFit(_feats.resources)
+    assert fit.decode_reasons(int(res.reason_bits[0, fi, 0])) == ["Too many pods"]
+    assert int(res.reason_bits[0, fi, 1]) == 0
