@@ -445,3 +445,95 @@ def test_fit_too_many_pods_fixture():
     fit = NodeResourcesFit(_feats.resources)
     assert fit.decode_reasons(int(res.reason_bits[0, fi, 0])) == ["Too many pods"]
     assert int(res.reason_bits[0, fi, 1]) == 0
+
+
+from tests.test_upstream_fixtures import _ipa_term
+
+
+def _zone_cluster():
+    zones = {"node-a": "z1", "node-b": "z1", "node-x": "z2", "node-y": "z2"}
+    return [
+        make_node(n, labels={ZONE_KEY: z, "kubernetes.io/hostname": n})
+        for n, z in zones.items()
+    ]
+
+
+def _ipa_norm(nodes, bound, pod):
+    from tests.helpers import pods_by_node
+
+    infos = oracle.build_node_infos(nodes, bound)
+    raw, norm = oracle.inter_pod_affinity_score_all(
+        pod, infos, pods_by_node(bound), [True] * len(infos)
+    )
+    _feats, res = _engine_result(nodes, bound, [pod])
+    si = res.plugin_names.index("InterPodAffinity")
+    plugin_weight = 2  # upstream default-profile weight
+    kernel_norm = [int(res.final_scores[0, si, ni]) // plugin_weight for ni in range(len(infos))]
+    return [i["name"] for i in infos], raw, norm, kernel_norm
+
+
+def test_interpod_preferred_anti_affinity_subtracts_fixture():
+    """scoring.go: the incoming pod's preferred ANTI-affinity terms
+    SUBTRACT their weight for every matching existing pod in the domain:
+      raw = [-10, -10, 0, 0]; min -10, max 0
+      normalized = 100 * (raw - min) / (max - min) = [0, 0, 100, 100]."""
+    nodes = _zone_cluster()
+    bound = [make_pod("db0", labels={"app": "db"}, node_name="node-a")]
+    pod = make_pod("incoming")
+    pod["spec"]["affinity"] = {
+        "podAntiAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                _ipa_term(ZONE_KEY, {"app": "db"}, weight=10)
+            ]
+        }
+    }
+    names, raw, norm, kernel_norm = _ipa_norm(nodes, bound, pod)
+    want_raw = {"node-a": -10, "node-b": -10, "node-x": 0, "node-y": 0}
+    want_norm = {"node-a": 0, "node-b": 0, "node-x": 100, "node-y": 100}
+    assert dict(zip(names, raw)) == want_raw
+    assert dict(zip(names, norm)) == want_norm
+    assert dict(zip(names, kernel_norm)) == want_norm
+
+
+def test_interpod_existing_preferred_affinity_symmetric_fixture():
+    """scoring.go symmetry: an EXISTING pod's preferred affinity term
+    matching the incoming pod adds its weight to the existing pod's
+    domain, even when the incoming pod declares no affinity at all:
+      raw = [7, 7, 0, 0] -> normalized [100, 100, 0, 0]."""
+    nodes = _zone_cluster()
+    holder = make_pod("holder", node_name="node-a")
+    holder["spec"]["affinity"] = {
+        "podAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                _ipa_term(ZONE_KEY, {"team": "blue"}, weight=7)
+            ]
+        }
+    }
+    pod = make_pod("incoming", labels={"team": "blue"})
+    names, raw, norm, kernel_norm = _ipa_norm(nodes, [holder], pod)
+    want_norm = {"node-a": 100, "node-b": 100, "node-x": 0, "node-y": 0}
+    assert dict(zip(names, raw)) == {"node-a": 7, "node-b": 7, "node-x": 0, "node-y": 0}
+    assert dict(zip(names, norm)) == want_norm
+    assert dict(zip(names, kernel_norm)) == want_norm
+
+
+def test_interpod_existing_preferred_anti_symmetric_fixture():
+    """scoring.go symmetry, anti direction: an EXISTING pod's preferred
+    anti-affinity term matching the incoming pod subtracts on the
+    existing pod's domain (hostname here, so only node-a):
+      raw = [-4, 0, 0, 0] -> normalized [0, 100, 100, 100]."""
+    nodes = _zone_cluster()
+    holder = make_pod("holder", node_name="node-a")
+    holder["spec"]["affinity"] = {
+        "podAntiAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                _ipa_term("kubernetes.io/hostname", {"team": "red"}, weight=4)
+            ]
+        }
+    }
+    pod = make_pod("incoming", labels={"team": "red"})
+    names, raw, norm, kernel_norm = _ipa_norm(nodes, [holder], pod)
+    want_norm = {"node-a": 0, "node-b": 100, "node-x": 100, "node-y": 100}
+    assert dict(zip(names, raw)) == {"node-a": -4, "node-b": 0, "node-x": 0, "node-y": 0}
+    assert dict(zip(names, norm)) == want_norm
+    assert dict(zip(names, kernel_norm)) == want_norm
