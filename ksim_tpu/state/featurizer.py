@@ -171,6 +171,11 @@ class Featurizer:
         # O(bound) comparison per pass instead of one per family.
         self._prev_bound: dict[int, JSON] = {}
         self._bound_gen = 0
+        # Bound pods carrying volumes, maintained from the diff — the
+        # volumes fast path needs "is ANY bound pod using volumes", and
+        # re-scanning 15k+ bound pods per pass was the single largest
+        # steady-state featurize cost.
+        self._bound_vol_count = 0
 
     def featurize(
         self,
@@ -215,11 +220,19 @@ class Featurizer:
         # being recycled while they can still appear in a diff).
         prev = self._prev_bound
         self._bound_gen += 1
+        added = [pid for pid in bound_map if pid not in prev]
+        removed = [pid for pid in prev if pid not in bound_map]
         self._agg["__diff__"] = {
             "gen": self._bound_gen,
-            "added": [pid for pid in bound_map if pid not in prev],
-            "removed": [pid for pid in prev if pid not in bound_map],
+            "added": added,
+            "removed": removed,
         }
+        from ksim_tpu.state.volumes import _pod_has_volumes
+
+        for pid in added:
+            self._bound_vol_count += _pod_has_volumes(bound_map[pid])
+        for pid in removed:
+            self._bound_vol_count -= _pod_has_volumes(prev[pid])
         self._prev_bound = bound_map
 
         node_alloc = [node_allocatable(n) for n in nodes]
@@ -435,7 +448,8 @@ class Featurizer:
             "nodeports": encode_node_ports(nodes, sched_pods, bound_pods, NP, PP),
             "imagelocality": encode_image_locality(nodes, sched_pods, NP, PP),
             "volumes": encode_volumes(
-                nodes, sched_pods, bound_pods, pvs, pvcs, storage_classes, NP, PP
+                nodes, sched_pods, bound_pods, pvs, pvcs, storage_classes, NP, PP,
+                bound_volume_free=self._bound_vol_count == 0,
             ),
         }
         for key, encoder in self._extra_encoders.items():

@@ -267,17 +267,24 @@ def encode_volumes(
     storage_classes: Sequence[JSON],
     n_padded: int,
     p_padded: int,
+    *,
+    bound_volume_free: "bool | None" = None,
 ) -> VolumeTensors:
     # Fast path — the common churn case: no volume API objects, no pod
-    # declares volumes, no node exposes attach pools.  All checks are
-    # memoized per object, so a steady-state pass costs dict lookups
-    # instead of re-walking every bound pod and node.
+    # declares volumes, no node exposes attach pools.  The bound-pod scan
+    # is the expensive precondition at churn scale; a persistent
+    # Featurizer passes ``bound_volume_free`` from its incrementally
+    # maintained count instead.
     if (
         not pvs
         and not pvcs
         and not storage_classes
         and not any(_pod_has_volumes(p) for p in pods)
-        and not any(_pod_has_volumes(p) for p in bound_pods)
+        and (
+            bound_volume_free
+            if bound_volume_free is not None
+            else not any(_pod_has_volumes(p) for p in bound_pods)
+        )
         and not any(_node_has_attach_pools(n) for n in nodes)
     ):
         return _trivial_volume_tensors(n_padded, p_padded)

@@ -254,3 +254,43 @@ def test_service_end_to_end_with_pvc_pods():
     anno = store.get("pods", "p")["metadata"]["annotations"]
     fr = json.loads(anno[FILTER_RESULT_KEY])
     assert fr["nb"]["VolumeBinding"] == ERR_NODE_CONFLICT
+
+
+def test_bound_volume_count_tracks_across_passes():
+    """The persistent featurizer's incremental bound-volumes count must
+    engage/disengage the trivial fast path correctly as volume-using
+    pods come and go — and never produce different tensors than a fresh
+    featurizer."""
+    import numpy as np
+
+    from ksim_tpu.state.featurizer import Featurizer
+    from tests.helpers import make_node, make_pod
+
+    node = make_node("n0")
+    voluser = make_pod("voluser", node_name="n0")
+    voluser["spec"]["volumes"] = [
+        {"name": "d", "gcePersistentDisk": {"pdName": "disk-1"}}
+    ]
+    plain_bound = make_pod("plain", node_name="n0")
+    queue = [make_pod("q0")]
+
+    f = Featurizer()
+    # Pass 1: a bound volume user -> full encode (disk counts non-zero).
+    feats1 = f.featurize([node], [voluser, plain_bound], queue_pods=queue)
+    assert feats1.aux["volumes"].disk_any_init.sum() > 0
+    # Pass 2: the volume user is gone -> trivial path, zero tensors.
+    feats2 = f.featurize([node], [plain_bound], queue_pods=queue)
+    assert feats2.aux["volumes"].disk_any_init.sum() == 0
+    # Fresh featurizer agrees with the persistent one, field by field.
+    fresh = Featurizer().featurize([node], [plain_bound], queue_pods=queue)
+    for name in ("disk_any_init", "attached_init", "pod_vol", "pod_fail"):
+        np.testing.assert_array_equal(
+            getattr(feats2.aux["volumes"], name),
+            getattr(fresh.aux["volumes"], name),
+        )
+    # Pass 3: a QUEUE pod with volumes still forces the full encode even
+    # though no bound pod uses any.
+    volq = make_pod("volq")
+    volq["spec"]["volumes"] = [{"name": "d", "gcePersistentDisk": {"pdName": "disk-2"}}]
+    feats3 = f.featurize([node], [plain_bound], queue_pods=[volq])
+    assert feats3.aux["volumes"].pod_vol.sum() > 0
