@@ -537,3 +537,23 @@ def test_interpod_existing_preferred_anti_symmetric_fixture():
     assert dict(zip(names, raw)) == {"node-a": -4, "node-b": 0, "node-x": 0, "node-y": 0}
     assert dict(zip(names, norm)) == want_norm
     assert dict(zip(names, kernel_norm)) == want_norm
+
+
+def test_bare_exists_toleration_tolerates_everything_fixture():
+    """v1.Toleration.ToleratesTaint: operator Exists with an EMPTY key
+    tolerates every taint (and an empty effect matches all effects)."""
+    nodes = [
+        make_node("hostile", taints=[
+            {"key": "a", "value": "1", "effect": "NoSchedule"},
+            {"key": "b", "value": "2", "effect": "NoExecute"},
+        ]),
+    ]
+    pod = make_pod("tolerates-all", tolerations=[{"operator": "Exists"}])
+    blocked = make_pod("blocked")
+    infos = oracle.build_node_infos(nodes, [])
+    assert not oracle.taint_toleration_filter(pod, infos[0])
+    assert oracle.taint_toleration_filter(blocked, infos[0])
+    _feats, res = _engine_result(nodes, [], [pod, blocked])
+    fi = res.filter_plugin_names.index("TaintToleration")
+    assert int(res.reason_bits[0, fi, 0]) == 0
+    assert int(res.reason_bits[1, fi, 0]) != 0
