@@ -1,0 +1,104 @@
+"""Independent preemption-ordering fixtures.
+
+Upstream pickOneNodeForPreemption ranks candidate nodes (PDB criteria
+degenerate without PodDisruptionBudgets) by: (1) lowest highest-victim
+priority, (2) smallest victim-priority sum, (3) fewest victims,
+(4) latest earliest-start-time among the HIGHEST-priority victims,
+(5) first in order.  Each case below is hand-constructed so exactly one
+criterion decides, with every earlier criterion tied — derived from the
+upstream algorithm definition, not from this repo's implementation.
+"""
+
+from __future__ import annotations
+
+from ksim_tpu.scheduler.preemption import find_preemption
+from tests.helpers import make_node, make_pod
+
+
+def _bound(name, node, cpu, prio, start=None):
+    p = make_pod(name, cpu=cpu, memory="64Mi", node_name=node, priority=prio)
+    if start:
+        p.setdefault("status", {})["startTime"] = start
+    return p
+
+
+def _preemptor(cpu):
+    return make_pod("preemptor", cpu=cpu, memory="64Mi", priority=100)
+
+
+def test_lowest_highest_victim_priority_wins():
+    """Criterion 1: the node whose most important victim is LEAST
+    important wins."""
+    nodes = [make_node("a", cpu="1", memory="8Gi"), make_node("b", cpu="1", memory="8Gi")]
+    pods = [
+        _bound("va", "a", "1", prio=1),
+        _bound("vb", "b", "1", prio=9),
+    ]
+    d = find_preemption(_preemptor("1"), nodes, pods)
+    assert d.nominated_node == "a"
+    assert [v["metadata"]["name"] for v in d.victims] == ["va"]
+
+
+def test_smallest_priority_sum_breaks_highest_tie():
+    """Criterion 2: equal highest victim priority (2 == 2); sums 3 < 4."""
+    nodes = [make_node("a", cpu="2", memory="8Gi"), make_node("b", cpu="2", memory="8Gi")]
+    pods = [
+        _bound("a1", "a", "1", prio=2), _bound("a2", "a", "1", prio=1),
+        _bound("b1", "b", "1", prio=2), _bound("b2", "b", "1", prio=2),
+    ]
+    d = find_preemption(_preemptor("2"), nodes, pods)
+    assert d.nominated_node == "a"
+    assert sorted(v["metadata"]["name"] for v in d.victims) == ["a1", "a2"]
+
+
+def test_fewest_victims_breaks_sum_tie():
+    """Criterion 3: highest 3 == 3, sums 4 == 4; counts 2 < 3."""
+    nodes = [make_node("a", cpu="3", memory="8Gi"), make_node("b", cpu="3", memory="8Gi")]
+    pods = [
+        _bound("a1", "a", "1500m", prio=3), _bound("a2", "a", "1500m", prio=1),
+        _bound("b1", "b", "1", prio=3), _bound("b2", "b", "1", prio=1),
+        _bound("b3", "b", "1", prio=0),
+    ]
+    d = find_preemption(_preemptor("3"), nodes, pods)
+    assert d.nominated_node == "a"
+    assert len(d.victims) == 2
+
+
+def test_latest_high_priority_start_breaks_count_tie():
+    """Criterion 4: identical priorities and counts; the node whose
+    highest-priority victim started LATEST wins (it did less work)."""
+    nodes = [make_node("a", cpu="1", memory="8Gi"), make_node("b", cpu="1", memory="8Gi")]
+    pods = [
+        _bound("va", "a", "1", prio=5, start="2026-01-01T00:00:00Z"),
+        _bound("vb", "b", "1", prio=5, start="2026-06-01T00:00:00Z"),
+    ]
+    d = find_preemption(_preemptor("1"), nodes, pods)
+    assert d.nominated_node == "b"
+
+
+def test_equal_or_higher_priority_pods_are_never_victims():
+    """Only pods with priority strictly below the preemptor's are
+    evictable; a node fully occupied by peers is not a candidate."""
+    nodes = [make_node("a", cpu="1", memory="8Gi"), make_node("b", cpu="1", memory="8Gi")]
+    pods = [
+        _bound("peer", "a", "1", prio=100),   # == preemptor: untouchable
+        _bound("low", "b", "1", prio=1),
+    ]
+    d = find_preemption(_preemptor("1"), nodes, pods)
+    assert d.nominated_node == "b"
+    assert [v["metadata"]["name"] for v in d.victims] == ["low"]
+
+
+def test_reprieve_keeps_unneeded_victims():
+    """Victim selection is minimal: once capacity fits, remaining
+    lowest-priority pods are reprieved (upstream reprievePod loop)."""
+    nodes = [make_node("a", cpu="3", memory="8Gi")]
+    pods = [
+        _bound("big", "a", "2", prio=1),
+        _bound("small", "a", "1", prio=2),
+    ]
+    # Preemptor needs 2 cpu: evicting "big" alone suffices; "small"
+    # (higher priority) is reprieved.
+    d = find_preemption(_preemptor("2"), nodes, pods)
+    assert d.nominated_node == "a"
+    assert [v["metadata"]["name"] for v in d.victims] == ["big"]
