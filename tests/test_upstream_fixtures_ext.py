@@ -557,3 +557,61 @@ def test_bare_exists_toleration_tolerates_everything_fixture():
     fi = res.filter_plugin_names.index("TaintToleration")
     assert int(res.reason_bits[0, fi, 0]) == 0
     assert int(res.reason_bits[1, fi, 0]) != 0
+
+
+def test_node_volume_limits_fixture():
+    """nodevolumelimits (CSI): a node advertising
+    attachable-volumes-csi-<driver> admits at most that many attachments
+    of the driver's volumes; a bound pod's attachment counts against the
+    limit, and a pod reusing an ALREADY-ATTACHED volume does not add one."""
+    node_full = make_node(
+        "limit-1", extra_alloc={"attachable-volumes-csi-ebs.csi.aws.com": "1"}
+    )
+    node_free = make_node(
+        "limit-2", extra_alloc={"attachable-volumes-csi-ebs.csi.aws.com": "2"}
+    )
+    nodes = [node_full, node_free]
+
+    def csi_pv(name):
+        return {
+            "metadata": {"name": name},
+            "spec": {
+                "capacity": {"storage": "1Gi"},
+                "accessModes": ["ReadWriteMany"],
+                "csi": {"driver": "ebs.csi.aws.com", "volumeHandle": name},
+                "claimRef": {"name": f"{name}-claim", "namespace": "default"},
+            },
+            "status": {"phase": "Bound"},
+        }
+
+    pvs = [csi_pv("pv-1"), csi_pv("pv-2")]
+    pvcs = [
+        _pvc("pv-1-claim", volume_name="pv-1", access_modes=("ReadWriteMany",)),
+        _pvc("pv-2-claim", volume_name="pv-2", access_modes=("ReadWriteMany",)),
+    ]
+    holder = _pod_with_pvc("holder", "pv-1-claim")
+    holder["spec"]["nodeName"] = "limit-1"
+
+    # A NEW volume on the full node exceeds the limit of 1.
+    newvol = _pod_with_pvc("newvol", "pv-2-claim")
+    reasons_full = oracle.node_volume_limits_filter(
+        newvol, node_full, [holder], pvcs, pvs, []
+    )
+    reasons_free = oracle.node_volume_limits_filter(
+        newvol, node_free, [], pvcs, pvs, []
+    )
+    assert reasons_full == ["node(s) exceed max volume count"]
+    assert reasons_free == []
+    # Re-using the ALREADY-ATTACHED pv-1 adds no attachment: fits.
+    sharer = _pod_with_pvc("sharer", "pv-1-claim")
+    assert oracle.node_volume_limits_filter(
+        sharer, node_full, [holder], pvcs, pvs, []
+    ) == []
+
+    _feats, res = _engine_result(
+        nodes, [holder], [newvol, sharer], pvs=pvs, pvcs=pvcs, storage_classes=[]
+    )
+    fi = res.filter_plugin_names.index("NodeVolumeLimits")
+    assert int(res.reason_bits[0, fi, 0]) != 0  # newvol blocked on limit-1
+    assert int(res.reason_bits[0, fi, 1]) == 0  # fits limit-2
+    assert int(res.reason_bits[1, fi, 0]) == 0  # sharer fits limit-1
