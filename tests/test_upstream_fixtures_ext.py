@@ -615,3 +615,37 @@ def test_node_volume_limits_fixture():
     assert int(res.reason_bits[0, fi, 0]) != 0  # newvol blocked on limit-1
     assert int(res.reason_bits[0, fi, 1]) == 0  # fits limit-2
     assert int(res.reason_bits[1, fi, 0]) == 0  # sharer fits limit-1
+
+
+def test_node_selector_ands_with_required_affinity_fixture():
+    """nodeaffinity.go GetRequiredNodeAffinity: plain spec.nodeSelector
+    and affinity.required are BOTH required (AND); the required terms
+    themselves OR together."""
+    nodes = [
+        make_node("both", labels={"pool": "p1", "disk": "ssd"}),
+        make_node("selector-only", labels={"pool": "p1", "disk": "hdd"}),
+        make_node("affinity-only", labels={"pool": "p2", "disk": "ssd"}),
+    ]
+    pod = make_pod("strict", node_selector={"pool": "p1"})
+    pod["spec"]["affinity"] = {
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {"matchExpressions": [
+                        {"key": "disk", "operator": "In", "values": ["ssd"]}]},
+                    {"matchExpressions": [
+                        {"key": "disk", "operator": "In", "values": ["nvme"]}]},
+                ]
+            }
+        }
+    }
+    want = {"both": True, "selector-only": False, "affinity-only": False}
+    infos = oracle.build_node_infos(nodes, [])
+    for info in infos:
+        got = not oracle.node_affinity_filter(pod, info)
+        assert got == want[info["name"]], info["name"]
+    _feats, res = _engine_result(nodes, [], [pod])
+    fi = res.filter_plugin_names.index("NodeAffinity")
+    for ni, info in enumerate(infos):
+        got = int(res.reason_bits[0, fi, ni]) == 0
+        assert got == want[info["name"]], info["name"]
