@@ -292,3 +292,37 @@ def test_ui_page_has_board_editor_and_history_panels(server):
     assert 'id="board"' in body and "unscheduled" in body
     assert 'id="editPanel"' in body and "doSave" in body
     assert "data-attempt" in body and "result-history" in body
+
+
+def test_external_scheduler_over_http(server):
+    """The reference's integrate-your-scheduler workflow: an EXTERNAL
+    scheduler watches for its pods and binds them through the resource
+    API, while the built-in scheduler ignores pods addressed elsewhere
+    (upstream schedulers only touch pods naming one of their profiles)."""
+    di = server.di
+    di.store.create("nodes", make_node("ext-n1"))
+    foreign = make_pod("ext-p1")
+    foreign["spec"]["schedulerName"] = "my-external-scheduler"
+    di.store.create("pods", foreign)
+    di.scheduler_service.start()
+    try:
+        # The built-in scheduler must leave it alone.
+        time.sleep(1.5)
+        _, pod = _req(server, "GET", "/api/v1/resources/pods/default/ext-p1")
+        assert "nodeName" not in pod["spec"]
+        assert di.scheduler_service.pending_count() == 0  # not its pod
+
+        # External scheduler: read, decide, bind via PUT.
+        pod["spec"]["nodeName"] = "ext-n1"
+        pod["status"] = {"phase": "Running"}
+        status, bound = _req(
+            server, "PUT", "/api/v1/resources/pods/default/ext-p1", pod
+        )
+        assert status == 200 and bound["spec"]["nodeName"] == "ext-n1"
+
+        # The binding is visible on the watch stream and in exports.
+        _, export = _req(server, "GET", "/api/v1/export")
+        got = {p["metadata"]["name"]: p["spec"].get("nodeName") for p in export["pods"]}
+        assert got["ext-p1"] == "ext-n1"
+    finally:
+        di.scheduler_service.stop(timeout=None)
