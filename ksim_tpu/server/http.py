@@ -42,15 +42,7 @@ logger = logging.getLogger(__name__)
 
 # Query-parameter names per kind (reference handler/watcher.go:26-34 —
 # note the singular "namespace" prefix).
-LRV_PARAMS = {
-    "pods": "podsLastResourceVersion",
-    "nodes": "nodesLastResourceVersion",
-    "persistentvolumes": "pvsLastResourceVersion",
-    "persistentvolumeclaims": "pvcsLastResourceVersion",
-    "storageclasses": "scsLastResourceVersion",
-    "priorityclasses": "pcsLastResourceVersion",
-    "namespaces": "namespaceLastResourceVersion",
-}
+from ksim_tpu.server.params import LRV_PARAMS
 
 EXTENDER_VERBS = ("filter", "prioritize", "preempt", "bind")
 

@@ -310,28 +310,56 @@ function showResults(key, attempt = -1) {
     a.onclick = () => showResults(key, parseInt(a.dataset.attempt, 10));
 }
 
+// Per-kind last seen resourceVersion for reconnect-with-resume (the
+// reference's RetryWatcher behavior on the client side).  The param map
+// is interpolated from the server's single source of truth
+// (ksim_tpu/server/params.py).
+const LRV_PARAM = __LRV_PARAMS_JSON__;
+const lastRV = {};
+
 async function watch() {
-  const resp = await fetch("/api/v1/listwatchresources");
-  document.getElementById("status").textContent = "live";
-  const reader = resp.body.getReader();
-  const dec = new TextDecoder(); let buf = "";
   for (;;) {
-    const {value, done} = await reader.read();
-    if (done) break;
-    buf += dec.decode(value, {stream: true});
-    let i;
-    while ((i = buf.indexOf("\\n")) >= 0) {
-      const line = buf.slice(0, i); buf = buf.slice(i+1);
-      if (!line.trim()) continue;
-      const ev = JSON.parse(line);
-      const map = store[ev.Kind]; if (!map) continue;
-      const key = keyOf(ev.Obj);
-      if (ev.EventType === "DELETED") map.delete(key); else map.set(key, ev.Obj);
-      if (ev.Kind === "pods" && key === selectedPod) showResults(key, selectedAttempt);
+    let resumed = false;
+    try {
+      const params = Object.entries(lastRV)
+        .map(([k, rv]) => `${LRV_PARAM[k]}=${rv}`).join("&");
+      const resp = await fetch("/api/v1/listwatchresources" + (params ? `?${params}` : ""));
+      if (resp.status === 410) {
+        // Compacted resume point: drop caches and relist from scratch.
+        for (const k of KINDS) { store[k].clear(); delete lastRV[k]; }
+        render();
+        continue;
+      }
+      document.getElementById("status").textContent = "live";
+      resumed = true;
+      const reader = resp.body.getReader();
+      const dec = new TextDecoder(); let buf = "";
+      for (;;) {
+        const {value, done} = await reader.read();
+        if (done) break;
+        buf += dec.decode(value, {stream: true});
+        let i;
+        while ((i = buf.indexOf("\\n")) >= 0) {
+          const line = buf.slice(0, i); buf = buf.slice(i+1);
+          if (!line.trim()) continue;
+          const ev = JSON.parse(line);
+          const map = store[ev.Kind]; if (!map) continue;
+          const key = keyOf(ev.Obj);
+          if (ev.EventType === "DELETED") map.delete(key); else map.set(key, ev.Obj);
+          const rv = parseInt(((ev.Obj||{}).metadata||{}).resourceVersion, 10);
+          if (!isNaN(rv)) lastRV[ev.Kind] = rv;
+          if (ev.Kind === "pods" && key === selectedPod) showResults(key, selectedAttempt);
+        }
+        render();
+      }
+    } catch (e) { console.error("watch stream error", e); }
+    document.getElementById("status").textContent = "reconnecting…";
+    if (!resumed) {
+      // Repeated failures without ever connecting: full refresh next try.
+      for (const k of KINDS) { store[k].clear(); delete lastRV[k]; }
     }
-    render();
+    await new Promise(r => setTimeout(r, 1500));
   }
-  document.getElementById("status").textContent = "disconnected";
 }
 
 function resourcePath(kind, key) {
@@ -443,3 +471,9 @@ watch();
 </body>
 </html>
 """
+
+import json as _json
+
+from ksim_tpu.server.params import LRV_PARAMS as _LRV_PARAMS
+
+INDEX_HTML = INDEX_HTML.replace("__LRV_PARAMS_JSON__", _json.dumps(_LRV_PARAMS))

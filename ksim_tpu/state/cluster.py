@@ -257,16 +257,38 @@ class ClusterStore:
             self._check_kind(k)
         q: queue.SimpleQueue = queue.SimpleQueue()
         with self._lock:
-            # Empty history means no event was ever emitted (the deque only
-            # evicts when full), so there is nothing to replay.
-            if since and self._history:
-                covered_from = self._history[0][0]
+            if since and not self._history:
+                # A resume point against a store that never emitted an
+                # event can only come from a PREVIOUS store life (server
+                # restart): it cannot be verified, so answer Gone and let
+                # the client drop its cache and relist — silently
+                # accepting it would leave the client showing pre-restart
+                # objects forever.
                 for kind, last in since.items():
                     self._check_kind(kind)
-                    if kind in kinds and last + 1 < covered_from:
+                    if kind in kinds and last > 0:
+                        raise ExpiredError(
+                            f"{kind} resourceVersion {last} predates this "
+                            "store (no event history)"
+                        )
+            if since and self._history:
+                covered_from = self._history[0][0]
+                covered_to = self._history[-1][0]
+                for kind, last in since.items():
+                    self._check_kind(kind)
+                    if kind not in kinds:
+                        continue
+                    if last + 1 < covered_from:
                         raise ExpiredError(
                             f"{kind} resourceVersion {last} is too old "
                             f"(history starts at {covered_from})"
+                        )
+                    if last > covered_to:
+                        # From a previous store life whose rv counter ran
+                        # ahead of this one — unverifiable, same as above.
+                        raise ExpiredError(
+                            f"{kind} resourceVersion {last} is ahead of "
+                            f"this store (history ends at {covered_to})"
                         )
             for kind in list_first:
                 self._check_kind(kind)

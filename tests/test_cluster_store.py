@@ -147,3 +147,29 @@ def test_restore_emits_fresh_resource_versions():
     stream.close()
     # DELETED then ADDED, both with fresh monotonically-increasing rvs.
     assert len(rvs) == 2 and rvs[0] < rvs[1] and rvs[0] > 1
+
+
+def test_watch_resume_rejects_foreign_resume_points():
+    """Resume points from a PREVIOUS store life answer Gone: a fresh
+    store has no history to verify against, and a store whose history
+    ends below the requested version never issued it.  Silently accepting
+    either would leave the client's cache stale forever."""
+    import pytest
+
+    from ksim_tpu.errors import ExpiredError
+
+    fresh = ClusterStore()
+    with pytest.raises(ExpiredError):
+        fresh.watch(("pods",), since={"pods": 5})
+
+    store = ClusterStore()
+    store.create("pods", make_pod("p1"))
+    store.create("pods", make_pod("p2"))
+    with pytest.raises(ExpiredError):
+        store.watch(("pods",), since={"pods": 1000})  # ahead of history
+    # A genuine resume point still replays the later event.
+    first_rv = int(store.get("pods", "p1", "default")["metadata"]["resourceVersion"])
+    stream = store.watch(("pods",), since={"pods": first_rv})
+    ev = stream.next(timeout=1)
+    assert ev is not None and ev.obj["metadata"]["name"] == "p2"
+    stream.close()
