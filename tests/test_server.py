@@ -326,3 +326,14 @@ def test_external_scheduler_over_http(server):
         assert got["ext-p1"] == "ext-n1"
     finally:
         di.scheduler_service.stop(timeout=None)
+
+
+def test_listwatch_410_on_foreign_resume_point(server):
+    """A reconnect carrying a resourceVersion this server never issued
+    (the signature of a server restart) answers 410 Gone so the client
+    drops its cache and relists — the etcd-compaction contract."""
+    status, body = _req(
+        server, "GET", "/api/v1/listwatchresources?podsLastResourceVersion=999999"
+    )
+    assert status == 410
+    assert "resourceVersion" in body["message"]
