@@ -649,3 +649,41 @@ def test_node_selector_ands_with_required_affinity_fixture():
     for ni, info in enumerate(infos):
         got = int(res.reason_bits[0, fi, ni]) == 0
         assert got == want[info["name"]], info["name"]
+
+
+def test_image_name_normalization_fixture():
+    """imagelocality normalizedImageName: a tag-less reference equals its
+    :latest form (and a digest/tag suffix is left alone), so a pod asking
+    for "img" scores against a node advertising "img:latest"."""
+    node = make_node("n0")
+    node["status"]["images"] = [
+        {"names": ["registry.example/app:latest"], "sizeBytes": 500 * 1024 * 1024}
+    ]
+    pod = make_pod("p0")
+    pod["spec"]["containers"] = [
+        {"name": "c", "image": "registry.example/app",
+         "resources": {"requests": {"cpu": "100m"}}}
+    ]
+    # 1 node: scaled = 500MB; score = int(100 * (500-23)/(1000-23)) = 48
+    states = oracle.build_image_states([node])
+    assert oracle.image_locality_score(pod, node, states, 1) == 48
+    _feats, res = _engine_result([node], [], [pod])
+    si = res.plugin_names.index("ImageLocality")
+    assert int(res.scores[0, si, 0]) == 48
+
+
+def test_match_labels_and_expressions_combined_fixture():
+    """metav1.LabelSelector: matchLabels and matchExpressions AND
+    together (used verbatim by topology spread / inter-pod selectors)."""
+    from ksim_tpu.state.selectors import match_label_selector
+
+    sel = {
+        "matchLabels": {"app": "web"},
+        "matchExpressions": [
+            {"key": "tier", "operator": "In", "values": ["frontend", "edge"]}
+        ],
+    }
+    assert match_label_selector(sel, {"app": "web", "tier": "edge"})
+    assert not match_label_selector(sel, {"app": "web"})          # expr fails
+    assert not match_label_selector(sel, {"tier": "edge"})        # label fails
+    assert not match_label_selector(sel, {"app": "db", "tier": "edge"})
