@@ -687,3 +687,29 @@ def test_match_labels_and_expressions_combined_fixture():
     assert not match_label_selector(sel, {"app": "web"})          # expr fails
     assert not match_label_selector(sel, {"tier": "edge"})        # label fails
     assert not match_label_selector(sel, {"app": "db", "tier": "edge"})
+
+
+def test_quantity_equivalence_through_featurization_fixture():
+    """resource.Quantity equivalences (upstream apimachinery): "0.5" cpu
+    == "500m", "1Gi" == str(2**30) bytes, "1e3" == "1000" — equivalent
+    spellings must lower to IDENTICAL tensor rows and identical scores."""
+    import numpy as np
+
+    node = make_node("n0", cpu="4", memory="8Gi")
+    spellings = [
+        make_pod("a", cpu="0.5", memory="1Gi"),
+        make_pod("b", cpu="500m", memory=str(2**30)),
+        make_pod("c", cpu="500m", memory="1024Mi"),
+    ]
+    feats, res = _engine_result([node], [], spellings)
+    rows = feats.pods.requests[: len(spellings)]
+    np.testing.assert_array_equal(rows[0], rows[1])
+    np.testing.assert_array_equal(rows[0], rows[2])
+    si = res.plugin_names.index("NodeResourcesFit")
+    scores = [int(res.scores[j, si, 0]) for j in range(3)]
+    assert scores[0] == scores[1] == scores[2]
+    # And scientific notation parses like the plain integer.
+    from ksim_tpu.state.quantity import parse_quantity
+
+    assert parse_quantity("1e3") == parse_quantity("1000")
+    assert parse_quantity("1.5Gi") == parse_quantity(str(3 * 2**29))
