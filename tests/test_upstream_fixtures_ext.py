@@ -713,3 +713,49 @@ def test_quantity_equivalence_through_featurization_fixture():
 
     assert parse_quantity("1e3") == parse_quantity("1000")
     assert parse_quantity("1.5Gi") == parse_quantity(str(3 * 2**29))
+
+
+def test_prefilter_prescore_status_plugin_sets_fixture():
+    """The recorded prefilter-result-status / prescore-result maps list
+    exactly the default-profile plugins whose UPSTREAM counterparts
+    implement PreFilter / PreScore (resultstore records one "success"
+    entry per wrapped Pre* invocation) — the byte contract the reference
+    UI renders."""
+    import json as _json
+
+    from ksim_tpu.engine.annotations import (
+        PRE_FILTER_STATUS_KEY,
+        PRE_SCORE_RESULT_KEY,
+        render_pod_results,
+    )
+    from ksim_tpu.engine import Engine
+    from ksim_tpu.engine.profiles import default_plugins
+    from ksim_tpu.state.featurizer import Featurizer
+
+    nodes = [make_node("n0"), make_node("n1")]
+    pod = make_pod("p0")
+    feats = Featurizer().featurize(nodes, [], queue_pods=[pod])
+    plugins = default_plugins(feats)
+    eng = Engine(feats, plugins, record="full")
+    res = eng.evaluate_batch()
+    anno = render_pod_results(feats, plugins, res, 0)
+    prefilter = _json.loads(anno[PRE_FILTER_STATUS_KEY])
+    prescore = _json.loads(anno[PRE_SCORE_RESULT_KEY])
+    # Upstream v1.30 default-profile PreFilter implementers present in
+    # our kernel set (CSI NodeVolumeLimits is in the filter chain too).
+    assert set(prefilter) == {
+        "NodeResourcesFit", "NodeAffinity", "PodTopologySpread",
+        "InterPodAffinity", "NodePorts", "VolumeBinding",
+        "VolumeRestrictions", "NodeVolumeLimits",
+    }
+    # Certain PreScore implementers must appear; plugins with no upstream
+    # PreScore must not.  (VolumeBinding's PreScore is feature-gate
+    # dependent upstream, so it is deliberately not pinned either way.)
+    assert {
+        "TaintToleration", "NodeAffinity", "PodTopologySpread",
+        "InterPodAffinity", "NodeResourcesFit",
+        "NodeResourcesBalancedAllocation",
+    } <= set(prescore)
+    assert not {"NodeName", "NodeUnschedulable", "ImageLocality"} & set(prescore)
+    assert set(prefilter.values()) == {"success"}
+    assert set(prescore.values()) == {"success"}
