@@ -759,3 +759,36 @@ def test_prefilter_prescore_status_plugin_sets_fixture():
     assert not {"NodeName", "NodeUnschedulable", "ImageLocality"} & set(prescore)
     assert set(prefilter.values()) == {"success"}
     assert set(prescore.values()) == {"success"}
+
+
+def test_single_feasible_node_skips_scoring_fixture():
+    """schedule_one.go early return: with exactly ONE feasible node,
+    scoring never runs — score-result / finalscore-result / prescore
+    record empty maps while selected-node is still set."""
+    import json as _json
+
+    from ksim_tpu.engine import Engine
+    from ksim_tpu.engine.annotations import (
+        FINAL_SCORE_RESULT_KEY,
+        PRE_SCORE_RESULT_KEY,
+        SCORE_RESULT_KEY,
+        SELECTED_NODE_KEY,
+        render_pod_results,
+    )
+    from ksim_tpu.engine.profiles import default_plugins
+    from ksim_tpu.state.featurizer import Featurizer
+
+    nodes = [
+        make_node("only-fit", cpu="8", memory="16Gi"),
+        make_node("tiny", cpu="100m", memory="64Mi"),
+    ]
+    pod = make_pod("p0", cpu="1", memory="1Gi")
+    feats = Featurizer().featurize(nodes, [], queue_pods=[pod])
+    plugins = default_plugins(feats)
+    eng = Engine(feats, plugins, record="full")
+    res = eng.evaluate_batch()
+    anno = render_pod_results(feats, plugins, res, 0)
+    assert anno[SELECTED_NODE_KEY] == "only-fit"
+    assert _json.loads(anno[SCORE_RESULT_KEY]) == {}
+    assert _json.loads(anno[FINAL_SCORE_RESULT_KEY]) == {}
+    assert _json.loads(anno[PRE_SCORE_RESULT_KEY]) == {}
