@@ -64,10 +64,32 @@ class PluginExtender:
     jax-traceable callables over the BATCHED tensors, compiled into the
     engine programs rather than wrapped around per-(pod,node) calls.
 
+    Device-side hooks (jax-traceable, compiled into the engine):
+
     - before_filter(state, pod, aux) -> (state, pod): rewrite inputs;
     - after_filter(state, pod, aux, out: FilterOutput) -> FilterOutput;
     - before_score(state, pod, aux) -> (state, pod);
     - after_score(state, pod, aux, scores) -> scores (pre-normalize).
+
+    Host-side hooks (plain Python over pod JSON, run by the scheduler
+    service around the corresponding host extension points — the
+    reference's Permit/PreBind/Bind/PostBind/PostFilter extender
+    interfaces, wrappedplugin.go:47-171).  ``before_*`` returning a
+    non-None string is a non-success status: the original plugin hook is
+    skipped and the message becomes the point's result (for post_bind the
+    original is skipped silently, matching wrappedplugin.go:728-738).
+    ``after_*`` receives the point's outcome and may replace it:
+
+    - before_post_filter(pod) -> str | None;
+      after_post_filter(pod, nominated, msg) -> (nominated, msg);
+    - before_permit(pod, node) -> str | None;
+      after_permit(pod, node, result) -> result (a PermitResult);
+    - before_pre_bind(pod, node) -> str | None;
+      after_pre_bind(pod, node, msg) -> str | None;
+    - before_bind(pod, node) -> str | None;
+      after_bind(pod, node, outcome) -> outcome;
+    - before_post_bind(pod, node) -> str | None;
+      after_post_bind(pod, node) -> None.
 
     Implement ``static_sig()`` for cross-instance program reuse; without
     it the engine keys the jit cache by extender identity (always safe).
@@ -77,6 +99,16 @@ class PluginExtender:
     after_filter: Any = None
     before_score: Any = None
     after_score: Any = None
+    before_post_filter: Any = None
+    after_post_filter: Any = None
+    before_permit: Any = None
+    after_permit: Any = None
+    before_pre_bind: Any = None
+    after_pre_bind: Any = None
+    before_bind: Any = None
+    after_bind: Any = None
+    before_post_bind: Any = None
+    after_post_bind: Any = None
 
     def static_sig(self) -> tuple | None:
         return None
@@ -92,14 +124,18 @@ class ScoredPlugin:
     score_enabled: bool = True
     extender: PluginExtender | None = None
     # Host-side recording hints (not part of the traced computation): is
-    # the plugin active at the Reserve/Permit/PreBind points (profiles can
-    # disable single extension points; the annotation renderer consults
-    # these for reserve-result/prebind-result, and the scheduler service
-    # consults permit_enabled before calling a plugin's host-side
-    # ``permit(pod, node_name)`` hook).
+    # the plugin active at the Reserve/Permit/PreBind/PostFilter/Bind/
+    # PostBind points (profiles can disable single extension points; the
+    # annotation renderer consults these for reserve-result/prebind-
+    # result, and the scheduler service consults them before calling a
+    # plugin's host-side ``permit(pod, node_name)`` / ``post_filter`` /
+    # ``pre_bind`` / ``bind`` / ``post_bind`` hooks).
     reserve_enabled: bool = True
     prebind_enabled: bool = True
     permit_enabled: bool = True
+    postfilter_enabled: bool = True
+    bind_enabled: bool = True
+    postbind_enabled: bool = True
 
 
 @dataclass
@@ -633,7 +669,7 @@ class Engine:
         far more than it costs to recompute, so nothing is retained)."""
         P = int(self._pods.valid.shape[0])
         if chunk is None:
-            chunk = min(P, self.SCHEDULE_CHUNK)
+            chunk = min(P, self._default_batch_chunk())
         carries = self._prog.init_carries(self._aux)
         for s in range(0, P, chunk):
             pods_c = jax.tree_util.tree_map(
@@ -662,6 +698,21 @@ class Engine:
     # carries thread through unchanged so chunking is semantically
     # invisible.
     SCHEDULE_CHUNK = 2048
+    # Batch-evaluation chunk on CPU: the vmapped batch program
+    # materializes [chunk, plugins, N] intermediates, and on CPU the pass
+    # is memory-bandwidth-bound — chunks small enough to stay cache-warm
+    # measure fastest (256: 15.9s vs 2048: 28.3s at 5000x1000 full-record;
+    # docs/scaling.md "batch-vs-scan platform asymmetry").  TPU keeps the
+    # large chunk: HBM bandwidth prefers big tiles and per-dispatch
+    # overhead is the scarce resource over the remote tunnel.
+    BATCH_CHUNK_CPU = 256
+
+    def _default_batch_chunk(self) -> int:
+        import jax as _jax
+
+        if _jax.default_backend() == "cpu":
+            return self.BATCH_CHUNK_CPU
+        return self.SCHEDULE_CHUNK
 
     def schedule(
         self, *, chunk: int | None = None, pull_state: bool = True

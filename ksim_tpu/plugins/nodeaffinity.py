@@ -24,6 +24,9 @@ from ksim_tpu.plugins.base import MAX_NODE_SCORE, FilterOutput, NodeStateView, P
 
 NAME = "NodeAffinity"
 ERR_REASON_POD = "node(s) didn't match Pod's node affinity/selector"
+ERR_REASON_ENFORCED = "node(s) didn't match scheduler-enforced node affinity"
+POD_MISMATCH_BIT = 1
+ENFORCED_MISMATCH_BIT = 2
 
 
 def _term_matches(aux) -> jnp.ndarray:
@@ -58,10 +61,27 @@ class NodeAffinity:
     name = NAME
 
     def filter(self, state: NodeStateView, pod: PodView, aux) -> FilterOutput:
-        ok = required_affinity_match(aux, pod)
-        return FilterOutput(ok=ok, reason_bits=jnp.where(ok, 0, 1).astype(jnp.int32))
+        a = aux["affinity"]
+        pod_ok = required_affinity_match(aux, pod)
+        # Profile-level addedAffinity (NodeAffinityArgs): checked FIRST
+        # upstream (node_affinity.go Filter, errReasonEnforced), ANDed for
+        # every pod of the profile.
+        term_ok = _term_matches(aux)
+        added_ok = jnp.where(
+            a["has_added"][0],
+            jnp.any(term_ok & a["added_terms"][None, :], axis=1),
+            True,
+        )
+        bits = jnp.where(added_ok, 0, ENFORCED_MISMATCH_BIT) | jnp.where(
+            pod_ok, 0, POD_MISMATCH_BIT
+        )
+        return FilterOutput(ok=bits == 0, reason_bits=bits.astype(jnp.int32))
 
     def decode_reasons(self, bits: int) -> list[str]:
+        # Upstream early-returns on the enforced mismatch, so the pod
+        # reason never co-occurs with it in a recorded status.
+        if bits & ENFORCED_MISMATCH_BIT:
+            return [ERR_REASON_ENFORCED]
         return [ERR_REASON_POD] if bits else []
 
     def static_sig(self) -> tuple:
@@ -75,7 +95,9 @@ class NodeAffinity:
     def score(self, state: NodeStateView, pod: PodView, aux, ok=None) -> jnp.ndarray:
         a = aux["affinity"]
         term_ok = _term_matches(aux)
-        weights = a["preferred_weights"][pod.index]  # [T] i32
+        # addedAffinity preferred terms score for every pod (upstream
+        # node_affinity.go Score: addedPrefSchedTerms).
+        weights = a["preferred_weights"][pod.index] + a["added_pref"]  # [T] i32
         return (term_ok.astype(jnp.int32) * weights[None, :]).sum(axis=1)
 
     def normalize(self, scores: jnp.ndarray, ok: jnp.ndarray) -> jnp.ndarray:

@@ -13,6 +13,20 @@ pins, simulator/go.mod):
   resource is overcommitted), weight-averaged with integer division,
   skipping zero-allocatable resources; ``r`` uses the *non-zero* request
   accumulation (resource_allocation.go calculatePodResourceRequest).
+- MostAllocated score: ``noderesources/most_allocated.go``
+  mostResourceScorer — per-resource ``min(r, c) * 100 // c`` (requests
+  above capacity clamp to capacity), same weighted integer average.
+- RequestedToCapacityRatio score:
+  ``noderesources/requested_to_capacity_ratio.go`` — utilization
+  ``r * 100 // c`` (overcommit or zero capacity evaluate at 100) fed
+  through the broken-linear shape function (helper/shape_score.go
+  BuildBrokenLinearFunction, Go truncating division), shape scores
+  pre-scaled x10 (MaxNodeScore/MaxCustomPriorityScore); only resources
+  with a POSITIVE score contribute to the weight sum, and the final
+  weighted average uses math.Round (exact here via (2n + d) // (2d)).
+  The simulator accepts all three strategies because the reference
+  decodes any upstream config (simulator/config/config.go:275-291) and
+  its tests exercise MostAllocated (config_test.go:30-56).
 - BalancedAllocation score: ``noderesources/balanced_allocation.go``
   balancedResourceScorer — fractions clamped to 1, two-resource case is
   ``std = |f1 - f2| / 2``, score ``int64((1 - std) * 100)``.
@@ -47,7 +61,9 @@ def _x64() -> bool:
 
 
 class NodeResourcesFit:
-    """Filter + LeastAllocated score (upstream defaults: cpu=1, memory=1)."""
+    """Filter + scoring strategy (upstream defaults: LeastAllocated over
+    cpu=1, memory=1; MostAllocated and RequestedToCapacityRatio are the
+    other two upstream strategies)."""
 
     name = FIT_NAME
 
@@ -57,9 +73,29 @@ class NodeResourcesFit:
         *,
         score_resources: tuple[tuple[str, int], ...] = (("cpu", 1), ("memory", 1)),
         base_resource_count: int = len(BASE_RESOURCES),
+        strategy: str = "LeastAllocated",
+        shape: tuple[tuple[int, int], ...] = (),
     ) -> None:
+        if strategy not in ("LeastAllocated", "MostAllocated", "RequestedToCapacityRatio"):
+            raise ValueError(f"unknown NodeResourcesFit scoring strategy {strategy!r}")
+        if strategy == "RequestedToCapacityRatio":
+            if not shape:
+                raise ValueError(
+                    "RequestedToCapacityRatio requires a non-empty shape "
+                    "(upstream validation: at least one UtilizationShapePoint)"
+                )
+            utils = [u for u, _ in shape]
+            if utils != sorted(set(utils)):
+                raise ValueError(
+                    "RequestedToCapacityRatio shape utilization must be "
+                    "strictly increasing (upstream validation)"
+                )
         self._resources = resources
         self._base_count = min(base_resource_count, len(resources))
+        self._strategy = strategy
+        # Shape scores arrive 0..10 and scale x10 to MaxNodeScore
+        # (upstream requestedToCapacityRatioScorer).
+        self._shape = tuple((int(u), int(s) * 10) for u, s in shape)
         idx = {r: i for i, r in enumerate(resources)}
         self._score_spec = tuple(
             (idx[r], w) for r, w in score_resources if r in idx
@@ -67,10 +103,10 @@ class NodeResourcesFit:
         # Bit 0 = "Too many pods", bit 1+r per resource (capped): the
         # engine downcasts result tensors when all widths fit (core.py).
         self.reason_bit_width = 1 + min(len(resources), MAX_RESOURCE_BITS)
-        self.final_score_bound = 100  # LeastAllocated is 0..MaxNodeScore
+        self.final_score_bound = 100  # all strategies are 0..MaxNodeScore
 
     def static_sig(self) -> tuple:
-        return (FIT_NAME, self._base_count, self._score_spec)
+        return (FIT_NAME, self._base_count, self._score_spec, self._strategy, self._shape)
 
     def failure_unresolvable(self, bits: int) -> bool:
         # Upstream returns Unschedulable: preempting pods frees resources.
@@ -113,22 +149,77 @@ class NodeResourcesFit:
                 out.append(f"Insufficient {r}")
         return out
 
-    # -- score (LeastAllocated) ---------------------------------------------
+    # -- score (strategy dispatch) -------------------------------------------
 
     def score(self, state: NodeStateView, pod: PodView, aux=None, ok=None) -> jnp.ndarray:
         req = state.nonzero_requested + pod.nonzero_requests[None, :]  # [N, R]
+        if self._strategy == "RequestedToCapacityRatio":
+            return self._score_rtcr(state, req)
+        node_score = jnp.zeros(state.pod_count.shape[0], dtype=jnp.int32)
+        weight_sum = jnp.zeros_like(node_score)
+        most = self._strategy == "MostAllocated"
+        for ri, w in self._score_spec:
+            c = state.allocatable[:, ri]
+            r = req[:, ri]
+            has = c > 0
+            if most:
+                # mostRequestedScore: min(r, c) * 100 // c.
+                s = jnp.where(
+                    has, (jnp.minimum(r, c) * MAX_NODE_SCORE) // jnp.maximum(c, 1), 0
+                )
+            else:
+                # leastRequestedScore: (c - r) * 100 // c, 0 when overcommitted.
+                s = jnp.where(
+                    has & (r <= c), ((c - r) * MAX_NODE_SCORE) // jnp.maximum(c, 1), 0
+                )
+            node_score = node_score + s.astype(jnp.int32) * w
+            weight_sum = weight_sum + jnp.where(has, w, 0)
+        return jnp.where(weight_sum > 0, node_score // jnp.maximum(weight_sum, 1), 0)
+
+    def _score_rtcr(self, state: NodeStateView, req: jnp.ndarray) -> jnp.ndarray:
+        """requested_to_capacity_ratio.go: broken-linear over integer
+        utilization; zero-capacity/overcommit evaluate at maxUtilization;
+        only positive per-resource scores count toward the weight sum;
+        final average is math.Round (exact integer (2n + d) // (2d))."""
         node_score = jnp.zeros(state.pod_count.shape[0], dtype=jnp.int32)
         weight_sum = jnp.zeros_like(node_score)
         for ri, w in self._score_spec:
             c = state.allocatable[:, ri]
             r = req[:, ri]
             has = c > 0
-            s = jnp.where(
-                has & (r <= c), ((c - r) * MAX_NODE_SCORE) // jnp.maximum(c, 1), 0
+            util = jnp.where(
+                has & (r <= c),
+                (r * MAX_NODE_SCORE) // jnp.maximum(c, 1),
+                MAX_NODE_SCORE,
             )
-            node_score = node_score + s.astype(jnp.int32) * w
-            weight_sum = weight_sum + jnp.where(has, w, 0)
-        return jnp.where(weight_sum > 0, node_score // jnp.maximum(weight_sum, 1), 0)
+            s = self._broken_linear(util)
+            # allocable==0 resources are skipped entirely; zero scores are
+            # computed but excluded from the weight sum (upstream quirk).
+            counts = has & (s > 0)
+            node_score = node_score + jnp.where(counts, s, 0).astype(jnp.int32) * w
+            weight_sum = weight_sum + jnp.where(counts, w, 0)
+        d = jnp.maximum(weight_sum, 1)
+        rounded = (2 * node_score + d) // (2 * d)
+        return jnp.where(weight_sum > 0, rounded, 0)
+
+    def _broken_linear(self, p: jnp.ndarray) -> jnp.ndarray:
+        """helper/shape_score.go BuildBrokenLinearFunction with Go's
+        truncating integer division (segment slopes may be negative, where
+        floor and trunc differ), unrolled over the static shape."""
+        shape = self._shape
+        res = jnp.full_like(p, shape[-1][1])
+        for i in range(len(shape) - 1, -1, -1):
+            u_i, s_i = shape[i]
+            if i == 0:
+                expr = jnp.full_like(p, s_i)
+            else:
+                u_p, s_p = shape[i - 1]
+                num = (s_i - s_p) * (p - u_p)
+                den = u_i - u_p
+                q = jnp.where(num >= 0, num // den, -((-num) // den))
+                expr = s_p + q
+            res = jnp.where(p <= u_i, expr, res)
+        return res
 
 
 class NodeResourcesBalancedAllocation:

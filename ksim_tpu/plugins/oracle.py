@@ -138,12 +138,24 @@ def taint_toleration_score(pod: JSON, info: NodeInfo) -> int:
 # -- NodeAffinity ------------------------------------------------------------
 
 
-def node_affinity_filter(pod: JSON, info: NodeInfo) -> list[str]:
-    """Upstream node_affinity.go Filter: nodeSelector AND required terms."""
+def node_affinity_filter(
+    pod: JSON, info: NodeInfo, added_affinity: JSON | None = None
+) -> list[str]:
+    """Upstream node_affinity.go Filter: the profile's enforced
+    addedAffinity first (early return, errReasonEnforced), then
+    nodeSelector AND required terms."""
     from ksim_tpu.state.selectors import match_node_selector_terms
 
     node = info["node"]
     labels = dict(node.get("metadata", {}).get("labels") or {})
+    if added_affinity:
+        added_req = added_affinity.get(
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        )
+        if added_req is not None and not match_node_selector_terms(
+            added_req.get("nodeSelectorTerms") or [], labels, info["name"]
+        ):
+            return ["node(s) didn't match scheduler-enforced node affinity"]
     spec = pod.get("spec", {})
     ns = spec.get("nodeSelector")
     if ns:
@@ -160,15 +172,24 @@ def node_affinity_filter(pod: JSON, info: NodeInfo) -> list[str]:
     return []
 
 
-def node_affinity_score(pod: JSON, info: NodeInfo) -> int:
-    """Upstream node_affinity.go Score: sum of matching preferred weights."""
+def node_affinity_score(
+    pod: JSON, info: NodeInfo, added_affinity: JSON | None = None
+) -> int:
+    """Upstream node_affinity.go Score: sum of matching preferred weights
+    (pod terms plus the profile's addedAffinity preferred terms)."""
     from ksim_tpu.state.selectors import match_node_selector_term
 
     node = info["node"]
     labels = dict(node.get("metadata", {}).get("labels") or {})
     aff = (pod.get("spec", {}).get("affinity") or {}).get("nodeAffinity") or {}
     score = 0
-    for pt in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+    pref = list(aff.get("preferredDuringSchedulingIgnoredDuringExecution") or [])
+    if added_affinity:
+        pref += list(
+            added_affinity.get("preferredDuringSchedulingIgnoredDuringExecution")
+            or []
+        )
+    for pt in pref:
         w = int(pt.get("weight", 0))
         if w == 0:
             continue
@@ -612,6 +633,78 @@ def least_allocated_score(
     return node_score // weight_sum
 
 
+def most_allocated_score(
+    pod: JSON,
+    info: NodeInfo,
+    resources: tuple[tuple[str, int], ...] = ((CPU, 1), (MEMORY, 1)),
+) -> int:
+    """Upstream most_allocated.go mostResourceScorer."""
+    pod_nz = pod_requests(pod, non_zero=True)
+    node_score = 0
+    weight_sum = 0
+    for r, weight in resources:
+        allocatable = info["allocatable"].get(r, 0)
+        if allocatable == 0:
+            continue
+        requested = info["nonzero_requested"].get(r, 0) + pod_nz.get(r, 0)
+        # Requests above capacity clamp (pods with no requests get minimums).
+        s = (min(requested, allocatable) * MAX_NODE_SCORE) // allocatable
+        node_score += s * weight
+        weight_sum += weight
+    if weight_sum == 0:
+        return 0
+    return node_score // weight_sum
+
+
+def _broken_linear(shape: tuple[tuple[int, int], ...], p: int) -> int:
+    """Upstream helper/shape_score.go BuildBrokenLinearFunction (scores
+    already scaled x10).  Go integer division truncates toward zero."""
+    for i, (u, s) in enumerate(shape):
+        if p <= u:
+            if i == 0:
+                return s
+            u_p, s_p = shape[i - 1]
+            num = (s - s_p) * (p - u_p)
+            den = u - u_p
+            q = num // den if num >= 0 else -((-num) // den)
+            return s_p + q
+    return shape[-1][1]
+
+
+def requested_to_capacity_ratio_score(
+    pod: JSON,
+    info: NodeInfo,
+    shape: tuple[tuple[int, int], ...],
+    resources: tuple[tuple[str, int], ...] = ((CPU, 1), (MEMORY, 1)),
+) -> int:
+    """Upstream requested_to_capacity_ratio.go
+    buildRequestedToCapacityRatioScorerFunction: shape scores pre-scaled
+    x10; zero-capacity/overcommit evaluate the shape at maxUtilization;
+    only positive resource scores enter the weight sum; the final average
+    is math.Round of a float division (exact for our int magnitudes)."""
+    pod_nz = pod_requests(pod, non_zero=True)
+    scaled = tuple((u, s * 10) for u, s in shape)
+    node_score = 0
+    weight_sum = 0
+    for r, weight in resources:
+        allocatable = info["allocatable"].get(r, 0)
+        if allocatable == 0:
+            continue
+        requested = info["nonzero_requested"].get(r, 0) + pod_nz.get(r, 0)
+        if requested > allocatable:
+            util = MAX_NODE_SCORE
+        else:
+            util = (requested * MAX_NODE_SCORE) // allocatable
+        s = _broken_linear(scaled, util)
+        if s > 0:
+            node_score += s * weight
+            weight_sum += weight
+    if weight_sum == 0:
+        return 0
+    # math.Round(n / d) for n >= 0 == (2n + d) // (2d).
+    return (2 * node_score + weight_sum) // (2 * weight_sum)
+
+
 def balanced_allocation_score(
     pod: JSON,
     info: NodeInfo,
@@ -893,10 +986,16 @@ def node_volume_limits_filter(
     pvcs: Sequence[JSON],
     pvs: Sequence[JSON],
     storage_classes: Sequence[JSON],
+    pools: tuple[str, ...] | None = None,
 ) -> list[str]:
+    """``pools`` restricts the check to the named attachable-volumes-*
+    suffixes — the legacy one-type plugins (EBSLimits, GCEPDLimits,
+    AzureDiskLimits, CinderLimits; upstream nodevolumelimits/non_csi.go);
+    None is the all-pool NodeVolumeLimits behavior."""
     from ksim_tpu.plugins.volumes import ERR_MAX_VOLUME_COUNT
     from ksim_tpu.state.volumes import (
         DISK_SOURCES,
+        LIMIT_ONLY_SOURCES,
         SOURCE_POOL,
         _csi_pool,
         _pod_volumes,
@@ -933,6 +1032,10 @@ def node_volume_limits_filter(
                 s = vol.get(src)
                 if s and s.get(id_field) and SOURCE_POOL.get(src):
                     out.add((SOURCE_POOL[src], f"{src}:{s[id_field]}"))
+            for src, id_field in LIMIT_ONLY_SOURCES:
+                s = vol.get(src)
+                if s and s.get(id_field) and SOURCE_POOL.get(src):
+                    out.add((SOURCE_POOL[src], f"{src}:{s[id_field]}"))
         return out
 
     alloc = node.get("status", {}).get("allocatable") or {}
@@ -952,6 +1055,8 @@ def node_volume_limits_filter(
     for pool, vid in pooled_volumes(pod):
         want.setdefault(pool, set()).add(vid)
     for pool, vids in want.items():
+        if pools is not None and pool not in pools:
+            continue
         if pool in limits and len(attached.get(pool, set()) | vids) > limits[pool]:
             return [ERR_MAX_VOLUME_COUNT]
     return []

@@ -131,16 +131,35 @@ class VolumeZone:
 
 
 class NodeVolumeLimits:
+    """Attach-limit filter over one or all attachable-volumes-* pools.
+
+    ``NodeVolumeLimits`` covers every pool (upstream v1.30's CSI plugin
+    counts migrated in-tree volumes too); the legacy registry names —
+    EBSLimits, GCEPDLimits, AzureDiskLimits, CinderLimits (upstream
+    nodevolumelimits/non_csi.go, carried by the reference's exported
+    default config, simulator/snapshot/snapshot_test.go:1415) — are
+    instances restricted to their one pool via ``pools``."""
+
     # Static reason-bit width: result tensors downcast when every
     # filter plugin's bits fit a narrower dtype (engine/core.py).
     reason_bit_width = 1
-    name = NODE_VOLUME_LIMITS
 
-    def __init__(self, vt: VolumeTensors) -> None:
-        self._n_pools = int(vt.n_pools)
+    def __init__(
+        self,
+        vt: VolumeTensors,
+        *,
+        name: str = NODE_VOLUME_LIMITS,
+        pools: tuple[str, ...] | None = None,
+    ) -> None:
+        self.name = name
+        self._pool_ids = tuple(
+            k
+            for k, pool in enumerate(vt.pool_names[: int(vt.n_pools)])
+            if pools is None or pool in pools
+        )
 
     def static_sig(self) -> tuple:
-        return (NODE_VOLUME_LIMITS, self._n_pools)
+        return (NODE_VOLUME_LIMITS, self.name, self._pool_ids)
 
     def failure_unresolvable(self, bits: int) -> bool:
         return False  # evicting pods detaches volumes
@@ -162,7 +181,7 @@ class NodeVolumeLimits:
         attached = carry > 0  # [N, V]
         pod_vol = a["pod_vol"][j]  # [V]
         over = jnp.zeros(state.valid.shape[0], dtype=bool)
-        for k in range(self._n_pools):  # static unroll over the pool vocab
+        for k in self._pool_ids:  # static unroll over this plugin's pools
             in_pool = a["vol_key"] == k  # [V]
             used = _dot_bool(attached, in_pool)  # [N]
             new = _dot_bool(~attached, pod_vol & in_pool)  # [N] dedup'd

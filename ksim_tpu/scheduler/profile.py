@@ -95,14 +95,21 @@ def _build_fit(feats, args):
         {"name": "cpu", "weight": 1},
         {"name": "memory", "weight": 1},
     ]
-    stype = strategy.get("type", "LeastAllocated")
-    if stype != "LeastAllocated":
-        raise ValueError(
-            f"NodeResourcesFit scoringStrategy {stype!r} not supported "
-            "(LeastAllocated only)"
+    # All three upstream strategies are valid config (the reference decodes
+    # any upstream KubeSchedulerConfiguration, simulator/config/config.go:
+    # 275-291, and its tests exercise MostAllocated, config_test.go:30-56);
+    # the kernel validates the name and the RTCR shape.
+    stype = strategy.get("type") or "LeastAllocated"
+    shape = tuple(
+        (int(p.get("utilization", 0)), int(p.get("score", 0)))
+        for p in (strategy.get("requestedToCapacityRatio") or {}).get("shape") or []
+    )
+    spec = tuple((r["name"], int(r.get("weight") or 1)) for r in resources)
+    return ScoredPlugin(
+        NodeResourcesFit(
+            feats.resources, score_resources=spec, strategy=stype, shape=shape
         )
-    spec = tuple((r["name"], int(r.get("weight", 1))) for r in resources)
-    return ScoredPlugin(NodeResourcesFit(feats.resources, score_resources=spec))
+    )
 
 
 def _build_balanced(feats, args):
@@ -125,8 +132,9 @@ def _build_taints(feats, args):
 def _build_node_affinity(feats, args):
     from ksim_tpu.plugins.nodeaffinity import NodeAffinity
 
-    if args.get("addedAffinity"):
-        raise ValueError("NodeAffinityArgs.addedAffinity is not supported yet")
+    # NodeAffinityArgs.addedAffinity rides the featurizer (profile-level
+    # terms in the affinity vocabulary, CompiledProfile.featurizer); the
+    # kernel reads the added_terms/added_pref aux fields unconditionally.
     return ScoredPlugin(NodeAffinity())
 
 
@@ -172,7 +180,32 @@ def _build_volume(cls_name):
     return build
 
 
-def load_plugin_import(spec: str) -> tuple[Builder, dict]:
+# Legacy registry name -> attachable-volumes-* pool suffix.  Upstream
+# v1.30 registers these as one-type non-CSI limit plugins
+# (nodevolumelimits/non_csi.go); the reference's exported default config
+# enables them in the filter set (snapshot_test.go:1415), so any
+# reference-exported snapshot must import here.
+LEGACY_VOLUME_LIMITS = {
+    "EBSLimits": "aws-ebs",
+    "GCEPDLimits": "gce-pd",
+    "AzureDiskLimits": "azure-disk",
+    "CinderLimits": "cinder",
+}
+
+
+def _build_legacy_volume_limits(name: str, pool: str):
+    def build(feats, args):
+        from ksim_tpu.plugins.volumes import NodeVolumeLimits
+
+        return ScoredPlugin(
+            NodeVolumeLimits(feats.aux["volumes"], name=name, pools=(pool,)),
+            score_enabled=False,
+        )
+
+    return build
+
+
+def load_plugin_import(spec: str) -> tuple[Builder, dict, dict]:
     """Resolve a ``pkg.module:attr`` plugin import — the TPU-native form
     of the reference's wasm-plugin loading, where out-of-tree plugins are
     registered purely from configuration (reference
@@ -183,7 +216,17 @@ def load_plugin_import(spec: str) -> tuple[Builder, dict]:
     The attribute may be a Builder ``(feats, args) -> ScoredPlugin``, or
     a dict/object exposing ``builder`` and optionally ``extra_encoders``
     (aux key -> featurizer extra encoder) for plugins that ship their own
-    tensors.
+    tensors, plus the snapshot-independent QUEUE hooks (upstream runs
+    these on the scheduling queue, outside the per-pod cycle, so they
+    live on the import target rather than the per-snapshot instance):
+
+    - ``queue_sort_key(pod, priority_of) -> sortable`` — a custom
+      QueueSort replacing PrioritySort (the reference wraps custom
+      QueueSort plugins, wrappedplugin.go:750-765; upstream allows
+      exactly one per profile);
+    - ``pre_enqueue(pod) -> str | None`` — a PreEnqueue gate
+      (wrappedplugin.go:376): a non-None message keeps the pod out of
+      the scheduling queue, like an unsatisfied scheduling gate.
 
     A non-empty ``KSIM_ALLOWED_PLUGIN_MODULES`` (comma-separated module
     prefixes) narrows the trust gate from all-or-nothing to an operator
@@ -216,19 +259,29 @@ def load_plugin_import(spec: str) -> tuple[Builder, dict]:
     if isinstance(target, dict):
         builder = target.get("builder")
         encoders = target.get("extra_encoders") or {}
+        hooks = {
+            k: target.get(k)
+            for k in ("queue_sort_key", "pre_enqueue")
+            if callable(target.get(k))
+        }
     else:
         builder = getattr(target, "builder", target)
         encoders = getattr(target, "extra_encoders", None) or {}
+        hooks = {
+            k: getattr(target, k)
+            for k in ("queue_sort_key", "pre_enqueue")
+            if callable(getattr(target, k, None))
+        }
     if not callable(builder):
         raise ValueError(
             f"plugin import {spec!r} does not provide a callable builder"
         )
-    return builder, dict(encoders)
+    return builder, dict(encoders), hooks
 
 
 def _load_config_plugins(
     profile_cfg: dict, registry: dict[str, Builder], allow_imports: bool
-) -> tuple[dict[str, Builder], dict]:
+) -> tuple[dict[str, Builder], dict, dict]:
     """Scan a profile's pluginConfig for ``builderImport`` args and
     register the loaded Builders (before plugin-set merging, like the
     reference registers wasm plugins before config conversion —
@@ -242,6 +295,7 @@ def _load_config_plugins(
     KSIM_ALLOW_PLUGIN_IMPORTS=1).  The reference's wasm guests are
     sandboxed; a Python import is not."""
     encoders: dict = {}
+    queue_hooks: dict[str, dict] = {}  # plugin name -> {hook: fn}
     for pc in profile_cfg.get("pluginConfig") or []:
         name = pc.get("name")
         spec = (pc.get("args") or {}).get("builderImport")
@@ -253,11 +307,13 @@ def _load_config_plugins(
                 "config source is not trusted for (enable with "
                 "allow_plugin_imports / KSIM_ALLOW_PLUGIN_IMPORTS=1)"
             )
-        builder, enc = load_plugin_import(spec)
+        builder, enc, hooks = load_plugin_import(spec)
         if name not in registry:
             registry[name] = builder
         encoders.update(enc)
-    return registry, encoders
+        if hooks:
+            queue_hooks[name] = hooks
+    return registry, encoders, queue_hooks
 
 
 INTREE_BUILDERS: dict[str, Builder] = {
@@ -275,6 +331,10 @@ INTREE_BUILDERS: dict[str, Builder] = {
     "NodeVolumeLimits": _build_volume("NodeVolumeLimits"),
     "VolumeBinding": _build_volume("VolumeBinding"),
     "VolumeZone": _build_volume("VolumeZone"),
+    **{
+        name: _build_legacy_volume_limits(name, pool)
+        for name, pool in LEGACY_VOLUME_LIMITS.items()
+    },
 }
 
 
@@ -295,17 +355,52 @@ class CompiledProfile:
     reserve_disabled: frozenset[str] = frozenset()
     prebind_disabled: frozenset[str] = frozenset()
     permit_disabled: frozenset[str] = frozenset()
+    postfilter_disabled: frozenset[str] = frozenset()
+    bind_disabled: frozenset[str] = frozenset()
+    postbind_disabled: frozenset[str] = frozenset()
+    # Snapshot-independent queue hooks from config-registered plugins
+    # (load_plugin_import): a custom QueueSort replacing PrioritySort
+    # (name, key fn), and PreEnqueue gates [(name, fn), ...].
+    queue_sort_plugin: "tuple[str, Callable] | None" = None
+    pre_enqueue_hooks: tuple = ()
     # Plugins added only through a per-point set: name -> points enabled.
     point_only: dict[str, frozenset[str]] = field(default_factory=dict)
     # Featurizer extra encoders shipped by config-loaded plugins
     # (load_plugin_import).
     extra_encoders: dict = field(default_factory=dict)
 
+    def spread_defaults(self) -> tuple | None:
+        """PodTopologySpreadArgs -> default-constraint tuple (upstream
+        v1 defaults.go: defaultingType defaults to System; List uses the
+        args' defaultConstraints; System forbids explicit ones)."""
+        from ksim_tpu.state.encoding import SYSTEM_DEFAULT_CONSTRAINTS
+
+        args = self.plugin_args.get("PodTopologySpread", {})
+        dtype = args.get("defaultingType") or "System"
+        explicit = args.get("defaultConstraints") or []
+        if dtype == "System":
+            if explicit:
+                raise ValueError(
+                    "PodTopologySpreadArgs: defaultConstraints must be "
+                    "empty when defaultingType is System (upstream "
+                    "validation)"
+                )
+            return SYSTEM_DEFAULT_CONSTRAINTS
+        if dtype != "List":
+            raise ValueError(
+                f"PodTopologySpreadArgs: unknown defaultingType {dtype!r}"
+            )
+        return tuple(explicit) or None
+
     def featurizer(self, *, pod_bucket_min: int | None = None) -> Featurizer:
         return Featurizer(
             interpod_hard_weight=self.hard_pod_affinity_weight,
             extra_encoders=self.extra_encoders,
             pod_bucket_min=pod_bucket_min,
+            added_affinity=self.plugin_args.get("NodeAffinity", {}).get(
+                "addedAffinity"
+            ),
+            spread_defaults=self.spread_defaults(),
         )
 
     def plugins(self, feats: FeaturizedSnapshot) -> tuple[ScoredPlugin, ...]:
@@ -319,18 +414,47 @@ class CompiledProfile:
             sp = builder(feats, self.plugin_args.get(name, {}))
             filter_on = sp.filter_enabled and name not in self.filter_disabled
             score_on = sp.score_enabled and name not in self.score_disabled
-            permit_on = (
-                hasattr(sp.plugin, "permit") and name not in self.permit_disabled
+
+            def host_on(hook: str, disabled: frozenset, point: str) -> bool:
+                ext = getattr(sp.extender, hook, None) if sp.extender else None
+                has = hasattr(sp.plugin, hook) or ext is not None
+                on = has and name not in disabled
+                if name in self.point_only:
+                    on = on and point in self.point_only[name]
+                return on
+
+            permit_on = host_on("permit", self.permit_disabled, "permit")
+            postfilter_on = host_on(
+                "post_filter", self.postfilter_disabled, "postFilter"
             )
+            prebind_host = host_on("pre_bind", self.prebind_disabled, "preBind")
+            bind_on = host_on("bind", self.bind_disabled, "bind")
+            postbind_on = host_on(
+                "post_bind", self.postbind_disabled, "postBind"
+            )
+            def point_on(point: str, disabled: frozenset) -> bool:
+                if name in disabled:
+                    return False
+                if name in self.point_only:
+                    return point in self.point_only[name]
+                return True
+
             if name in self.point_only:
                 points = self.point_only[name]
                 filter_on = filter_on and "filter" in points
                 score_on = score_on and "score" in points
-                permit_on = permit_on and "permit" in points
-            # A permit-only plugin stays in the set with both kernel
+            # A host-hook-only plugin stays in the set with both kernel
             # points off: the engine loops skip it, the service still
-            # runs its host-side permit hook.
-            if not filter_on and not score_on and not permit_on:
+            # runs its host-side hooks.
+            if not (
+                filter_on
+                or score_on
+                or permit_on
+                or postfilter_on
+                or prebind_host
+                or bind_on
+                or postbind_on
+            ):
                 continue
             out.append(
                 ScoredPlugin(
@@ -338,9 +462,17 @@ class CompiledProfile:
                     weight=weight if weight > 0 else 1,
                     filter_enabled=filter_on,
                     score_enabled=score_on,
-                    reserve_enabled=name not in self.reserve_disabled,
-                    prebind_enabled=name not in self.prebind_disabled,
+                    extender=sp.extender,
+                    # Point-only plugins are active ONLY at their named
+                    # points: prebind_enabled both gates the host
+                    # pre_bind hook (service._run_pre_bind) and the
+                    # recorded reserve/prebind success maps.
+                    reserve_enabled=point_on("reserve", self.reserve_disabled),
+                    prebind_enabled=point_on("preBind", self.prebind_disabled),
                     permit_enabled=permit_on,
+                    postfilter_enabled=postfilter_on,
+                    bind_enabled=bind_on,
+                    postbind_enabled=postbind_on,
                 )
             )
         return tuple(out)
@@ -389,11 +521,40 @@ def compile_profile(
     on unknown enabled plugins (reference registry behavior) unless they
     are upstream defaults without kernels (recorded in ``skipped``)."""
     profile_cfg = profile_cfg or {}
+    # In-code registry entries may be bare Builders or the same
+    # dict/object shape load_plugin_import accepts (builder + queue
+    # hooks); normalize to Builders + a hook map.
+    norm_registry: dict[str, Builder] = {}
+    queue_hooks: dict[str, dict] = {}
+    for name, entry in (registry or {}).items():
+        if callable(entry):
+            norm_registry[name] = entry
+            continue
+        get = entry.get if isinstance(entry, dict) else (
+            lambda k, _e=entry: getattr(_e, k, None)
+        )
+        builder = get("builder")
+        if not callable(builder):
+            raise ValueError(
+                f"registry entry {name!r} does not provide a callable "
+                "builder (dict/object entries need 'builder' alongside "
+                "their queue hooks)"
+            )
+        norm_registry[name] = builder
+        hooks = {
+            k: get(k)
+            for k in ("queue_sort_key", "pre_enqueue")
+            if callable(get(k))
+        }
+        if hooks:
+            queue_hooks[name] = hooks
     # Config-declared out-of-tree plugins register first (the reference's
     # RegisterWasmPlugins-before-conversion ordering).
-    registry, loaded_encoders = _load_config_plugins(
-        profile_cfg, dict(registry or {}), allow_plugin_imports
+    registry, loaded_encoders, loaded_hooks = _load_config_plugins(
+        profile_cfg, norm_registry, allow_plugin_imports
     )
+    for name, hooks in loaded_hooks.items():
+        queue_hooks.setdefault(name, hooks)
     plugins_cfg = profile_cfg.get("plugins") or {}
     merged = _merge_plugin_set(DEFAULT_MULTIPOINT, plugins_cfg.get("multiPoint"))
 
@@ -407,9 +568,13 @@ def compile_profile(
     reserve_off: set[str] = set()
     prebind_off: set[str] = set()
     permit_off: set[str] = set()
+    postfilter_off: set[str] = set()
+    bind_off: set[str] = set()
+    postbind_off: set[str] = set()
     point_only: dict[str, set[str]] = {}
-    for point in ("preFilter", "filter", "postFilter", "preScore", "score",
-                  "reserve", "permit", "preBind", "bind", "postBind"):
+    for point in ("queueSort", "preEnqueue", "preFilter", "filter",
+                  "postFilter", "preScore", "score", "reserve", "permit",
+                  "preBind", "bind", "postBind"):
         point_cfg = plugins_cfg.get(point)
         if not point_cfg:
             continue
@@ -425,6 +590,12 @@ def compile_profile(
             prebind_off |= have if "*" in disabled_here else disabled_here
         elif point == "permit":
             permit_off |= have if "*" in disabled_here else disabled_here
+        elif point == "postFilter":
+            postfilter_off |= have if "*" in disabled_here else disabled_here
+        elif point == "bind":
+            bind_off |= have if "*" in disabled_here else disabled_here
+        elif point == "postBind":
+            postbind_off |= have if "*" in disabled_here else disabled_here
         for p in point_cfg.get("enabled") or []:
             name = p.get("name")
             if not name:
@@ -467,7 +638,27 @@ def compile_profile(
             "hardPodAffinityWeight", DEFAULT_HARD_POD_AFFINITY_WEIGHT
         )
     )
-    return CompiledProfile(
+    # Queue hooks activate for ENABLED plugins only.  A plugin shipping
+    # queue_sort_key replaces PrioritySort's order for the profile;
+    # upstream allows exactly one QueueSort plugin per profile
+    # (wrappedplugin.go:357 "There must be only one in each profile").
+    enabled_names = {n for n, _ in merged}
+    sorters = [
+        (n, h["queue_sort_key"])
+        for n, h in queue_hooks.items()
+        if n in enabled_names and "queue_sort_key" in h
+    ]
+    if len(sorters) > 1:
+        raise ValueError(
+            "multiple queue-sort plugins enabled: "
+            + ", ".join(sorted(n for n, _ in sorters))
+        )
+    pre_enqueue_hooks = tuple(
+        (n, h["pre_enqueue"])
+        for n, h in sorted(queue_hooks.items())
+        if n in enabled_names and "pre_enqueue" in h
+    )
+    prof = CompiledProfile(
         scheduler_name=profile_cfg.get("schedulerName") or DEFAULT_SCHEDULER_NAME,
         enabled=tuple(merged),
         plugin_args=plugin_args,
@@ -479,9 +670,16 @@ def compile_profile(
         reserve_disabled=frozenset(reserve_off),
         prebind_disabled=frozenset(prebind_off),
         permit_disabled=frozenset(permit_off),
+        postfilter_disabled=frozenset(postfilter_off),
+        bind_disabled=frozenset(bind_off),
+        postbind_disabled=frozenset(postbind_off),
         point_only={k: frozenset(v) for k, v in point_only.items()},
         extra_encoders=loaded_encoders,
+        queue_sort_plugin=sorters[0] if sorters else None,
+        pre_enqueue_hooks=pre_enqueue_hooks,
     )
+    prof.spread_defaults()  # validate PodTopologySpreadArgs at compile time
+    return prof
 
 
 def compile_configuration(

@@ -67,6 +67,9 @@ class AffinityTensors:
         "has_required": "pod",
         "required_terms": "pod",
         "preferred_weights": "pod",
+        "added_terms": None,
+        "has_added": None,
+        "added_pref": None,
     }
 
     node_req_match: np.ndarray  # bool [N(padded), Q]
@@ -76,6 +79,13 @@ class AffinityTensors:
     has_required: np.ndarray  # bool [P]
     required_terms: np.ndarray  # bool [P, T]
     preferred_weights: np.ndarray  # int32 [P, T]
+    # NodeAffinityArgs.addedAffinity (profile-level, upstream
+    # node_affinity.go addedNodeSelector/addedPrefSchedTerms): required
+    # terms ANDed into every pod's filter, preferred weights added to
+    # every pod's score.
+    added_terms: np.ndarray  # bool [T]
+    has_added: np.ndarray  # bool [1]
+    added_pref: np.ndarray  # int32 [T]
 
     @property
     def n_terms(self) -> int:
@@ -163,7 +173,11 @@ def _parsed_node_affinity(pod: JSON) -> dict:
 
 
 def encode_affinity(
-    nodes: Sequence[JSON], pods: Sequence[JSON], n_padded: int, p_padded: int
+    nodes: Sequence[JSON],
+    pods: Sequence[JSON],
+    n_padded: int,
+    p_padded: int,
+    added_affinity: JSON | None = None,
 ) -> AffinityTensors:
     from ksim_tpu.state import objcache
 
@@ -176,6 +190,25 @@ def encode_affinity(
     has_req = np.zeros(p_padded, dtype=bool)
     req_terms: list[list[int]] = [[] for _ in range(p_padded)]
     pref: list[dict[int, int]] = [{} for _ in range(p_padded)]
+
+    # Profile-level addedAffinity terms register in the same vocabulary
+    # (upstream NodeAffinityArgs.addedAffinity, node_affinity.go New).
+    added_req_ids: list[int] = []
+    has_added = False
+    added_pref_ids: dict[int, int] = {}
+    if added_affinity:
+        required = added_affinity.get("requiredDuringSchedulingIgnoredDuringExecution")
+        if required is not None:
+            has_added = True
+            for t in required.get("nodeSelectorTerms") or []:
+                reqs = _term_reqs_from_selector_term(t)
+                if reqs is not None:
+                    added_req_ids.append(vocab.term_id(reqs))
+        for pt in added_affinity.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+            reqs = _term_reqs_from_selector_term(pt.get("preference") or {})
+            if reqs is not None:
+                tid = vocab.term_id(reqs)
+                added_pref_ids[tid] = added_pref_ids.get(tid, 0) + int(pt.get("weight", 0))
 
     for j, pod in enumerate(pods):
         parsed = _parsed_node_affinity(pod)
@@ -232,6 +265,13 @@ def encode_affinity(
         for tid, w in pref[j].items():
             preferred_weights[j, tid] = w
 
+    added_terms = np.zeros(max(T, 1), dtype=bool)
+    for tid in added_req_ids:
+        added_terms[tid] = True
+    added_pref = np.zeros(max(T, 1), dtype=np.int32)
+    for tid, w in added_pref_ids.items():
+        added_pref[tid] = w
+
     return AffinityTensors(
         node_req_match=node_req_match,
         term_req=term_req,
@@ -240,6 +280,9 @@ def encode_affinity(
         has_required=has_req,
         required_terms=required_terms,
         preferred_weights=preferred_weights,
+        added_terms=added_terms,
+        has_added=np.array([has_added]),
+        added_pref=added_pref,
     )
 
 
@@ -405,6 +448,90 @@ class SpreadTensors:
     has_score_con: np.ndarray  # bool [P]
 
 
+# Upstream pkg/scheduler/apis/config/v1/defaults.go systemDefaultConstraints
+# (defaultingType: System — the reference's exported default config carries
+# it, simulator/snapshot/snapshot_test.go:1415).
+SYSTEM_DEFAULT_CONSTRAINTS: tuple = (
+    {
+        "topologyKey": "topology.kubernetes.io/zone",
+        "whenUnsatisfiable": "ScheduleAnyway",
+        "maxSkew": 3,
+    },
+    {
+        "topologyKey": "kubernetes.io/hostname",
+        "whenUnsatisfiable": "ScheduleAnyway",
+        "maxSkew": 5,
+    },
+)
+
+
+def default_spread_selector(
+    pod: JSON,
+    services: Sequence[JSON] = (),
+    replication_controllers: Sequence[JSON] = (),
+    replica_sets: Sequence[JSON] = (),
+    stateful_sets: Sequence[JSON] = (),
+) -> JSON | None:
+    """Upstream helper.DefaultSelector (plugins/helper/spread.go): merge
+    the selectors of the services selecting the pod and the pod's
+    controller (RC/RS/StatefulSet).  Returns None when the merged
+    selector is EMPTY — buildDefaultConstraints then applies NO default
+    constraints (pod_topology_spread/common.go ``if selector.Empty()``).
+
+    The snapshot model carries none of these kinds (reference
+    simulator/snapshot/snapshot.go:33-42 — pods, nodes, pvs, pvcs,
+    storageClasses, priorityClasses, schedulerConfig), so in both the
+    reference and here the selector is always empty and
+    defaultConstraints/System defaulting are inert: the same blind spot,
+    by construction.  The parameters exist so the behavior stays
+    upstream-shaped if the snapshot model ever grows these kinds."""
+    from ksim_tpu.state.resources import namespace_of
+
+    ns = namespace_of(pod) or "default"
+    pod_labels = dict(labels_of(pod))
+    merged: dict[str, str] = {}
+    for svc in services:
+        if (namespace_of(svc) or "default") != ns:
+            continue
+        sel = (svc.get("spec") or {}).get("selector") or {}
+        if sel and all(pod_labels.get(k) == v for k, v in sel.items()):
+            merged.update(sel)
+    exprs: list[JSON] = []
+    owner = next(
+        (
+            o
+            for o in (pod.get("metadata", {}).get("ownerReferences") or [])
+            if o.get("controller")
+        ),
+        None,
+    )
+    if owner:
+        kind = owner.get("kind")
+        o_name = owner.get("name")
+        pool = {
+            "ReplicationController": replication_controllers,
+            "ReplicaSet": replica_sets,
+            "StatefulSet": stateful_sets,
+        }.get(kind, ())
+        for obj in pool:
+            if name_of(obj) != o_name or (namespace_of(obj) or "default") != ns:
+                continue
+            sel = (obj.get("spec") or {}).get("selector") or {}
+            if kind == "ReplicationController":
+                merged.update(sel)
+            else:
+                merged.update(sel.get("matchLabels") or {})
+                exprs.extend(sel.get("matchExpressions") or [])
+    if not merged and not exprs:
+        return None
+    out: JSON = {}
+    if merged:
+        out["matchLabels"] = merged
+    if exprs:
+        out["matchExpressions"] = exprs
+    return out
+
+
 def _effective_selector(con: JSON, pod: JSON) -> JSON:
     """labelSelector with matchLabelKeys folded in as In-requirements on
     the pod's own label values (upstream MatchLabelKeysInPodTopologySpread,
@@ -432,6 +559,7 @@ def encode_topology_spread(
     bound_map: "dict[int, JSON] | None" = None,
     changed_slots: "set[int] | None" = None,
     slot_of: "dict[str, int] | None" = None,
+    default_constraints: tuple | None = None,
 ) -> SpreadTensors:
     """``agg``/``bound_map``/``changed_slots``/``slot_of`` come from a
     persistent Featurizer (state/boundagg.py): the selector vocabulary
@@ -473,15 +601,30 @@ def encode_topology_spread(
 
     from ksim_tpu.state import objcache
 
+    defaults_token = _canon(list(default_constraints)) if default_constraints else ""
+
     def parsed_cons(pod: JSON) -> list[dict]:
         """Vocab-independent constraint parse, memoized per pod object
         (the effective selector and its canonical key are the expensive
-        parts; vocab ids are assigned per call)."""
+        parts; vocab ids are assigned per call).  Pods without their own
+        constraints fall back to the profile's defaultConstraints
+        (PodTopologySpreadArgs; upstream pod_topology_spread/common.go
+        buildDefaultConstraints) — whose selector comes from
+        default_spread_selector and is empty in the snapshot model, so
+        the fallback yields no constraints (documented there)."""
 
         def build() -> list[dict]:
             ns = namespace_of(pod) or "default"
             out = []
-            for con in pod.get("spec", {}).get("topologySpreadConstraints") or []:
+            own = pod.get("spec", {}).get("topologySpreadConstraints") or []
+            cons_src = own
+            if not own and default_constraints:
+                sel = default_spread_selector(pod)
+                if sel is not None:
+                    cons_src = [
+                        dict(c, labelSelector=sel) for c in default_constraints
+                    ]
+            for con in cons_src:
                 sel = _effective_selector(con, pod)
                 out.append(
                     {
@@ -499,7 +642,7 @@ def encode_topology_spread(
                 )
             return out
 
-        return objcache.cached("spreadcons", pod, build)
+        return objcache.cached("spreadcons", pod, build, defaults_token)
 
     # Pass 1: constraint tables.
     per_pod_cons: list[list[dict]] = []

@@ -144,11 +144,15 @@ class Featurizer:
         pod_bucket_min: int | None = None,
         interpod_hard_weight: int | None = None,
         extra_encoders: "dict[str, Any] | None" = None,
+        added_affinity: "JSON | None" = None,
+        spread_defaults: "tuple | None" = None,
     ) -> None:
         """``extra_encoders`` maps aux key -> fn(nodes, queue_pods,
         n_padded, p_padded) -> dataclass-with-AXES — the hook out-of-tree
         plugins use to ship their own tensors to the device (the sample
-        NodeNumber / data-provider plugins ride this)."""
+        NodeNumber / data-provider plugins ride this).  ``added_affinity``
+        is the profile's NodeAffinityArgs.addedAffinity (upstream
+        node_affinity.go addedNodeSelector/addedPrefSchedTerms)."""
         if interpod_hard_weight is None:
             from ksim_tpu.state.interpod import DEFAULT_HARD_POD_AFFINITY_WEIGHT
 
@@ -157,6 +161,12 @@ class Featurizer:
         self._pod_bucket_min = pod_bucket_min if pod_bucket_min else 8
         self._interpod_hard_weight = interpod_hard_weight
         self._extra_encoders = dict(extra_encoders or {})
+        self._added_affinity = added_affinity
+        # PodTopologySpreadArgs default constraints (List defaulting, or
+        # the upstream systemDefaultConstraints for System) — inert in
+        # the snapshot model (see encoding.default_spread_selector) but
+        # threaded so the behavior is upstream-shaped.
+        self._spread_defaults = spread_defaults
         # Incremental bound-pod aggregation across featurizations of the
         # SAME evolving cluster (state/boundagg.py): node-name slots keep
         # the node axis stable under churn, and the additive aggregates
@@ -431,12 +441,15 @@ class Featurizer:
         from ksim_tpu.state.volumes import encode_volumes
 
         aux = {
-            "affinity": encode_affinity(nodes, sched_pods, NP, PP),
+            "affinity": encode_affinity(
+                nodes, sched_pods, NP, PP, added_affinity=self._added_affinity
+            ),
             "taints": encode_taints(nodes, sched_pods, NP, PP),
             "spread": encode_topology_spread(
                 nodes, sched_pods, bound_pods, NP, PP,
                 agg=self._agg, bound_map=bound_map,
                 changed_slots=changed_slots, slot_of=node_index,
+                default_constraints=self._spread_defaults,
             ),
             "interpod": encode_inter_pod(
                 nodes, sched_pods, bound_pods, namespaces, NP, PP,

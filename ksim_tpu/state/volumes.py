@@ -69,15 +69,20 @@ DISK_SOURCES = (
 )
 
 # Sources that consume an attach-limit pool but have NO conflict rule
-# (upstream nodevolumelimits counts azure disks; volumerestrictions
-# doesn't restrict them).
-LIMIT_ONLY_SOURCES = (("azureDisk", "diskName"),)
+# (upstream nodevolumelimits counts azure disks and cinder volumes;
+# volumerestrictions doesn't restrict them).
+LIMIT_ONLY_SOURCES = (("azureDisk", "diskName"), ("cinder", "volumeID"))
 
 # Attachable-volume pools (pre-CSINode node allocatable keys) per source.
+# Pool names double as the per-plugin split for the legacy registry names
+# (upstream nodevolumelimits non_csi.go registers EBSLimits/GCEPDLimits/
+# AzureDiskLimits/CinderLimits as one-type filters; the reference's
+# exported default config carries them, snapshot_test.go:1415).
 SOURCE_POOL = {
-    "gcePersistentDisk": "attachable-volumes-gce-pd",
-    "awsElasticBlockStore": "attachable-volumes-aws-ebs",
-    "azureDisk": "attachable-volumes-azure-disk",
+    "gcePersistentDisk": "gce-pd",
+    "awsElasticBlockStore": "aws-ebs",
+    "azureDisk": "azure-disk",
+    "cinder": "cinder",
 }
 
 
@@ -126,6 +131,10 @@ class VolumeTensors:
     pod_disk_rw: np.ndarray  # bool [P, D] pod uses disk read-write
     disk_ro_shareable: np.ndarray  # bool [D] both-read-only sharing allowed
     n_pools: int  # K (static info)
+    # Pool id -> attachable-volumes-* suffix (static info): lets the
+    # legacy per-type plugins (EBSLimits et al.) restrict their check to
+    # one pool while NodeVolumeLimits covers all of them.
+    pool_names: tuple[str, ...] = ()
 
 
 _EMPTY_ROW = {"pv": (), "wffc": (), "vol": (), "rwop": (), "disk": (), "fail": 0}
@@ -190,6 +199,7 @@ def _trivial_volume_tensors(n_padded: int, p_padded: int) -> "VolumeTensors":
         pod_disk_rw=np.zeros((p_padded, D), dtype=bool),
         disk_ro_shareable=np.zeros(D, dtype=bool),
         n_pools=1,
+        pool_names=("",),
     )
     if len(_TRIVIAL) > 64:
         _TRIVIAL.clear()
@@ -531,6 +541,9 @@ def encode_volumes(
         pod_disk_rw=pod_disk_rw,
         disk_ro_shareable=disk_ro_shareable,
         n_pools=K,
+        pool_names=tuple(
+            sorted(pool_vocab, key=pool_vocab.get) + [""] * (K - len(pool_vocab))
+        ),
     )
 
 

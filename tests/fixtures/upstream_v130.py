@@ -285,3 +285,220 @@ IPA_PREFERRED_EXPECT_NORMALIZED = {
     "node-x": 0,
     "node-y": 0,
 }
+
+
+# ---------------------------------------------------------------------------
+# NodeResourcesFit scoring strategy: MostAllocated
+# (pkg/scheduler/framework/plugins/noderesources/most_allocated.go,
+#  mostResourceScorer): per-resource min(requested, allocatable) * 100 //
+# allocatable (integer division), weight-averaged with integer division;
+# zero-allocatable resources are skipped.  Requested uses the non-zero
+# accumulation (nonzero.go defaults when the pod declares no request).
+# ---------------------------------------------------------------------------
+
+MOST_ALLOCATED_CASES = [
+    {
+        # cpu: 3000 * 100 // 4000 = 75;  mem: 5000 * 100 // 10000 = 50
+        # (75*1 + 50*1) // 2 = 62
+        "name": "plain",
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "weights": (("cpu", 1), ("memory", 1)),
+        "want": 62,
+    },
+    {
+        # cpu overcommit clamps: min(3000, 2000) = 2000 -> 2000*100//2000
+        # = 100;  mem: 50 -> (100 + 50) // 2 = 75
+        "name": "overcommit-clamps",
+        "node_cpu_milli": 2000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "weights": (("cpu", 1), ("memory", 1)),
+        "want": 75,
+    },
+    {
+        # weighted: (75*3 + 50*1) // (3+1) = 275 // 4 = 68
+        "name": "weighted",
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "weights": (("cpu", 3), ("memory", 1)),
+        "want": 68,
+    },
+    {
+        # no requests -> nonzero defaults 100m / 200Mi:
+        # cpu: 100 * 100 // 1000 = 10
+        # mem: (200Mi * 100) // 1000Mi = 20   (Mi factors cancel)
+        # (10 + 20) // 2 = 15
+        "name": "nonzero-defaults",
+        "node_cpu_milli": 1000,
+        "node_mem": 1000 * MB,
+        "pod_cpu_milli": None,
+        "pod_mem": None,
+        "weights": (("cpu", 1), ("memory", 1)),
+        "want": 15,
+    },
+]
+
+# ---------------------------------------------------------------------------
+# NodeResourcesFit scoring strategy: RequestedToCapacityRatio
+# (noderesources/requested_to_capacity_ratio.go +
+#  helper/shape_score.go BuildBrokenLinearFunction):
+#   - shape scores are config 0..10, scaled x10 to MaxNodeScore range;
+#   - utilization p = requested * 100 // allocatable (Go integer division);
+#     zero allocatable or requested > allocatable evaluate the shape at
+#     p = 100;
+#   - broken-linear: first i with p <= u_i interpolates
+#     s_{i-1} + (s_i - s_{i-1}) * (p - u_{i-1}) / (u_i - u_{i-1})
+#     with Go division (truncates toward ZERO — differs from floor when
+#     the slope is negative);
+#   - only resources with score > 0 enter the weight sum (upstream quirk);
+#   - final: math.Round(nodeScore / weightSum), half away from zero.
+# ---------------------------------------------------------------------------
+
+RTCR_CASES = [
+    {
+        # shape 0->0, 100->10 (most-requested ramp), scaled (0,0),(100,100).
+        # cpu p = 3000*100//4000 = 75 -> 0 + (100-0)*(75-0)/100 = 75
+        # mem p = 5000*100//10000 = 50 -> 50
+        # round((75 + 50) / 2) = round(62.5) = 63  [differs from
+        # MostAllocated's 62: Round vs integer division]
+        "name": "ramp-up",
+        "shape": ((0, 0), (100, 10)),
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "weights": (("cpu", 1), ("memory", 1)),
+        "want": 63,
+    },
+    {
+        # shape 0->10, 100->0 (least-requested ramp), scaled (0,100),(100,0).
+        # cpu p = 75 -> 100 + (0-100)*75/100 = 100 + trunc(-75.0) = 25
+        # mem p = 50 -> 50
+        # round((25 + 50) / 2) = round(37.5) = 38
+        "name": "ramp-down",
+        "shape": ((0, 10), (100, 0)),
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "weights": (("cpu", 1), ("memory", 1)),
+        "want": 38,
+    },
+    {
+        # Truncation direction: shape (0,10),(3,0) scaled (0,100),(3,0).
+        # cpu: pod 10m of 1000m -> p = 10*100//1000 = 1
+        #   -> 100 + (0-100)*(1-0)/3 = 100 + trunc(-100/3) = 100 - 33 = 67
+        #   (floor division would give 100 - 34 = 66)
+        # mem: default 200Mi of 200Gi -> p = 200Mi*100 // 200Gi
+        #   = 100 // 1024 = 0 -> p <= u_0 = 0 -> s_0 = 100
+        # round((67 + 100) / 2) = round(83.5) = 84
+        "name": "trunc-toward-zero",
+        "shape": ((0, 10), (3, 0)),
+        "node_cpu_milli": 1000,
+        "node_mem": 200 * GI,
+        "pod_cpu_milli": 10,
+        "pod_mem": None,  # pod declares no memory request
+        "weights": (("cpu", 1), ("memory", 1)),
+        "want": 84,
+    },
+    {
+        # Zero scores leave the weight sum: shape (0,0),(100,10).
+        # cpu: default 100m of 20000m -> p = 100*100//20000 = 0 -> score 0
+        #   -> EXCLUDED from weightSum
+        # mem: default 200Mi of 400Mi -> p = 200*100//400 = 50 -> score 50
+        # weightSum = 1 -> round(50 / 1) = 50
+        # (a naive implementation averaging over both weights gives 25)
+        "name": "zero-score-excluded",
+        "shape": ((0, 0), (100, 10)),
+        "node_cpu_milli": 20000,
+        "node_mem": 400 * MB,
+        "pod_cpu_milli": None,
+        "pod_mem": None,
+        "weights": (("cpu", 1), ("memory", 1)),
+        "want": 50,
+    },
+    {
+        # Weighted rounding: cpu w2 p75 -> 75*2 = 150; mem w1 p50 -> 50.
+        # round((150 + 50) / 3) = round(66.67) = 67
+        "name": "weighted-round",
+        "shape": ((0, 0), (100, 10)),
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "weights": (("cpu", 2), ("memory", 1)),
+        "want": 67,
+    },
+]
+
+# ---------------------------------------------------------------------------
+# NodeAffinityArgs.addedAffinity
+# (plugins/nodeaffinity/node_affinity.go: New parses
+#  args.AddedAffinity; Filter checks the added required selector FIRST and
+#  early-returns "node(s) didn't match scheduler-enforced node affinity";
+#  Score adds the added preferred terms' weights for every pod, then
+#  DefaultNormalizeScore.)
+#
+# Nodes: n-a labels {zone: a, hw: x}; n-b labels {zone: b, hw: x}.
+# addedAffinity required: zone In [a]; addedAffinity preferred:
+# weight 10 -> zone In [a].
+# ---------------------------------------------------------------------------
+
+ADDED_AFFINITY_REQUIRED = {
+    "requiredDuringSchedulingIgnoredDuringExecution": {
+        "nodeSelectorTerms": [
+            {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["a"]}]}
+        ]
+    }
+}
+ADDED_AFFINITY_PREFERRED = {
+    "preferredDuringSchedulingIgnoredDuringExecution": [
+        {
+            "weight": 10,
+            "preference": {
+                "matchExpressions": [
+                    {"key": "zone", "operator": "In", "values": ["a"]}
+                ]
+            },
+        }
+    ]
+}
+# Plain pod under the required addedAffinity: n-a passes, n-b fails with
+# the enforced reason only.
+ADDED_AFFINITY_FILTER_EXPECT = {"n-a": [], "n-b": ["node(s) didn't match scheduler-enforced node affinity"]}
+# Pod whose own nodeSelector wants zone=b: n-a fails the POD reason
+# (added check passed), n-b fails the ENFORCED reason (early return).
+ADDED_AFFINITY_CROSS_EXPECT = {
+    "n-a": ["node(s) didn't match Pod's node affinity/selector"],
+    "n-b": ["node(s) didn't match scheduler-enforced node affinity"],
+}
+# Score under ADDED_AFFINITY_PREFERRED plus a pod preferred term
+# weight 5 -> hw In [x] (matches both nodes):
+#   raw n-a = 10 + 5 = 15, raw n-b = 5
+#   DefaultNormalizeScore(reverse=False): max = 15
+#     n-a = 100 * 15 // 15 = 100;  n-b = 100 * 5 // 15 = 33
+ADDED_AFFINITY_SCORE_EXPECT = {"n-a": 100, "n-b": 33}
+
+# ---------------------------------------------------------------------------
+# Legacy non-CSI volume-limit plugins: EBSLimits / GCEPDLimits /
+# AzureDiskLimits / CinderLimits (nodevolumelimits/non_csi.go; the
+# reference's exported default config enables the first three in its
+# filter list, simulator/snapshot/snapshot_test.go:1415).  Each counts
+# DISTINCT volumes of its one type against the node's
+# attachable-volumes-<pool> allocatable; failure reason is
+# "node(s) exceed max volume count".
+#
+# Scenario: node exposes attachable-volumes-aws-ebs = 1 and already runs
+# a bound pod attached to EBS volume vol-1.
+#   - queue pod with EBS vol-2: 1 attached + 1 new = 2 > 1 -> rejected
+#   - queue pod re-using vol-1: dedup -> 1 attached + 0 new -> fits
+#   - GCEPDLimits checks only the gce-pd pool -> the vol-2 pod passes it
+# ---------------------------------------------------------------------------
+
+EBS_LIMIT_REASON = "node(s) exceed max volume count"

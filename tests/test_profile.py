@@ -171,7 +171,7 @@ def test_builder_import_module_allowlist(monkeypatch):
 
     monkeypatch.setenv("KSIM_ALLOWED_PLUGIN_MODULES", "ksim_tpu.plugins, mycorp")
     # Allowed prefix loads (the sample plugin ships a builder).
-    builder, _enc = load_plugin_import(
+    builder, _enc, _hooks = load_plugin_import(
         "ksim_tpu.plugins.samples.nodenumber:NODE_NUMBER_PLUGIN"
     )
     assert callable(builder)
@@ -184,5 +184,5 @@ def test_builder_import_module_allowlist(monkeypatch):
     # Empty allowlist = no narrowing (the all-or-nothing gate upstream of
     # this function still applies).
     monkeypatch.delenv("KSIM_ALLOWED_PLUGIN_MODULES")
-    builder, _enc = load_plugin_import("json:loads")
+    builder, _enc, _hooks = load_plugin_import("json:loads")
     assert callable(builder)
