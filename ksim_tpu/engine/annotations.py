@@ -182,6 +182,8 @@ def render_pod_results(
     postfilter: dict | None = None,
     permit: tuple[dict, dict] | None = None,
     bound: bool = True,
+    prebind_extra: dict | None = None,
+    bind_map: dict | None = None,
     ctx: "RenderCtx | None" = None,
 ) -> dict[str, str]:
     """The 13 result annotations for queue pod ``pi`` (all keys present,
@@ -194,6 +196,10 @@ def render_pod_results(
     Bind (a Permit rejection): selected-node and reserve-result stay
     recorded — upstream wrote them at Reserve — while prebind/bind maps
     stay empty because those wrappers never ran.
+    ``prebind_extra`` merges out-of-tree PreBind hook results into the
+    prebind map; ``bind_map`` overrides the bind-result map when a
+    custom binder handled (or failed) the bind (wrappedplugin.go:699-726
+    AddBindResult records under the actual binder's name).
     Pass a shared ``ctx`` when rendering many pods of one pass."""
     if res.reason_bits is None:
         raise ValueError("render_pod_results needs record='full' results")
@@ -295,6 +301,12 @@ def render_pod_results(
 
     reserve_map = _point_map("reserve_enabled")
     prebind_map = _point_map("prebind_enabled", ran=bound)
+    if prebind_extra and selected >= 0:
+        prebind_map = {**prebind_map, **prebind_extra}
+    if bind_map is None:
+        bind_map = {"DefaultBinder": SUCCESS_MESSAGE} if selected >= 0 and bound else {}
+    elif selected < 0:
+        bind_map = {}
     out = {
         PRE_FILTER_RESULT_KEY: _marshal({}),
         PRE_FILTER_STATUS_KEY: _marshal(prefilter_status),
@@ -307,9 +319,7 @@ def render_pod_results(
         PERMIT_RESULT_KEY: _marshal(permit[0] if permit else {}),
         PERMIT_TIMEOUT_RESULT_KEY: _marshal(permit[1] if permit else {}),
         PRE_BIND_RESULT_KEY: _marshal(prebind_map),
-        BIND_RESULT_KEY: _marshal(
-            {"DefaultBinder": SUCCESS_MESSAGE} if selected >= 0 and bound else {}
-        ),
+        BIND_RESULT_KEY: _marshal(bind_map),
     }
     if selected >= 0:
         out[SELECTED_NODE_KEY] = node_names[selected]

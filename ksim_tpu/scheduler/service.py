@@ -48,6 +48,7 @@ from ksim_tpu.scheduler.permit import (
     PermitResult,
     go_duration_str,
 )
+from ksim_tpu.errors import NotFoundError
 from ksim_tpu.state.cluster import ClusterStore, WatchEvent
 from ksim_tpu.state.featurizer import FeaturizedSnapshot, Featurizer
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
@@ -89,6 +90,10 @@ class _WaitingPod:
     pending: dict[str, float]
     # Pre-rendered result annotations (written at resolution).
     anno: dict[str, str] = field(default_factory=dict)
+    # The pass's plugin tuple + compiled profile: the PreBind/Bind/
+    # PostBind chains run at allow time (upstream: after WaitOnPermit).
+    plugins: tuple = ()
+    prof: object = None
 
 
 class SchedulerService:
@@ -430,7 +435,25 @@ class SchedulerService:
             ]
             if not queue:
                 continue
-            queue.sort(key=lambda p: queue_sort_key(p, self._priority_of))
+            prof = (
+                self._profiles.get(sched_name)
+                if self._plugins_factory is None
+                else None
+            )
+            # PreEnqueue gates (upstream wrappedplugin.go:376; structural
+            # SchedulingGates already filtered in _is_pending): any hook
+            # returning a message keeps the pod out of this pass's queue.
+            if prof is not None and prof.pre_enqueue_hooks:
+                queue = [p for p in queue if self._pre_enqueue_admits(prof, p)]
+                if not queue:
+                    continue
+            # Custom QueueSort replaces PrioritySort's order
+            # (wrappedplugin.go:750-765).
+            if prof is not None and prof.queue_sort_plugin is not None:
+                _qs_name, qs_key = prof.queue_sort_plugin
+                queue.sort(key=lambda p: qs_key(p, self._priority_of))
+            else:
+                queue.sort(key=lambda p: queue_sort_key(p, self._priority_of))
             if self._max_pods_per_pass is not None:
                 queue = queue[: self._max_pods_per_pass]
             featurizer = self._featurizer_override
@@ -443,7 +466,6 @@ class SchedulerService:
                     )
                 factory: PluginsFactory = self._plugins_factory
             else:
-                prof = self._profiles[sched_name]
                 if featurizer is None:
                     featurizer = self._featurizers[sched_name] = prof.featurizer(
                         pod_bucket_min=self._pod_bucket_min
@@ -455,7 +477,8 @@ class SchedulerService:
                 # pod-at-a-time evaluation (the reference's scheduler is
                 # per-pod anyway; extenders are the slow path by design).
                 self._schedule_queue_with_extenders(
-                    queue, featurizer, factory, namespaces, volume_kw, placements
+                    queue, featurizer, factory, namespaces, volume_kw, placements,
+                    prof=prof,
                 )
                 continue
             with self.metrics.timer("featurize"):
@@ -469,7 +492,7 @@ class SchedulerService:
                     eng.shard(self._shard_mesh)
                 res, _ = eng.schedule(pull_state=False)
             with self.metrics.timer("bind"):
-                self._bind_results(queue, feats, plugins, res, placements)
+                self._bind_results(queue, feats, plugins, res, placements, prof=prof)
         # Bound _own_rvs growth for library use (schedule_pending without
         # the watch loop draining events).  The limit scales with the pass
         # size so one large pass never trims its own still-queued events
@@ -501,7 +524,8 @@ class SchedulerService:
         return placements
 
     def _schedule_queue_with_extenders(
-        self, queue, featurizer, factory, namespaces, volume_kw, placements
+        self, queue, featurizer, factory, namespaces, volume_kw, placements,
+        prof=None,
     ) -> None:
         """Per-pod cycle with extender webhooks (upstream
         findNodesThatPassExtenders + prioritizeNodes extender scores):
@@ -596,9 +620,9 @@ class SchedulerService:
             nominated, victims, postfilter = None, [], None
             # An aborted cycle (non-ignorable extender error) never runs
             # PostFilter — upstream gives up on the pod for this pass.
-            if selected is None and self._preemption and not failed:
-                nominated, victims, postfilter = self._attempt_preemption(
-                    pod, feats, plugins, res, 0
+            if selected is None and not failed:
+                nominated, victims, postfilter = self._run_post_filter(
+                    pod, feats, plugins, res, 0, prof=prof
                 )
             # Permit runs post-selection on this path too (upstream's
             # cycle is identical with or without extenders).
@@ -609,6 +633,20 @@ class SchedulerService:
                 permit_verdict, permit_maps, wait_deadlines = self._run_permit(
                     plugins, pod, selected
                 )
+            prebind_extra: dict[str, str] = {}
+            bind_map = None
+            bind_ok = True
+            if selected is not None and permit_verdict == SUCCESS:
+                prebind_extra, prebind_failed = self._run_pre_bind(
+                    plugins, pod, selected
+                )
+                if prebind_failed:
+                    bind_ok = False
+                    bind_map = {}
+                else:
+                    bind_map, bind_ok = self._run_bind(
+                        plugins, pod, selected, prof=prof
+                    )
             anno = render_pod_results(
                 feats,
                 plugins,
@@ -616,15 +654,20 @@ class SchedulerService:
                 0,
                 postfilter=postfilter,
                 permit=permit_maps,
-                bound=permit_verdict != REJECT,
+                bound=permit_verdict != REJECT and bind_ok,
+                prebind_extra=prebind_extra,
+                bind_map=bind_map,
             )
             anno.update(self._extenders.store.get_stored_result(pod))
             selected, parked = self._settle_permit(
-                pod, selected, permit_verdict, wait_deadlines, anno, placements
+                pod, selected, permit_verdict, wait_deadlines, anno, placements,
+                plugins=plugins, prof=prof,
             )
             if parked:
                 self._extenders.store.delete_data(pod)
                 continue
+            if not bind_ok:
+                selected = None
 
             def mutate(obj: JSON) -> None:
                 annos = obj.setdefault("metadata", {}).setdefault("annotations", {})
@@ -636,10 +679,23 @@ class SchedulerService:
                 elif nominated:
                     obj.setdefault("status", {})["nominatedNodeName"] = nominated
 
-            updated = self._store.patch("pods", name_of(pod), namespace_of(pod), mutate)
+            try:
+                updated = self._store.patch(
+                    "pods", name_of(pod), namespace_of(pod), mutate
+                )
+            except NotFoundError:
+                # Deleted mid-cycle: fail just this pod (see _bind_results).
+                logger.info(
+                    "pod %s/%s deleted mid-cycle; skipping its bind",
+                    namespace_of(pod), name_of(pod),
+                )
+                self._extenders.store.delete_data(pod)
+                continue
             self._extenders.store.delete_data(pod)
             with self._own_rvs_lock:
                 self._own_rvs.add(updated["metadata"]["resourceVersion"])
+            if selected is not None:
+                self._run_post_bind(plugins, updated, selected)
             for v in victims:
                 try:
                     self._store.delete("pods", name_of(v), namespace_of(v))
@@ -647,15 +703,15 @@ class SchedulerService:
                     logger.exception("failed to evict victim %s", name_of(v))
             placements[f"{namespace_of(pod)}/{name_of(pod)}"] = selected
 
-    def _bind_results(self, queue, feats, plugins, res, placements) -> None:
+    def _bind_results(self, queue, feats, plugins, res, placements, prof=None) -> None:
         render_ctx = RenderCtx(feats, plugins) if self._record == "full" else None
         for j, pod in enumerate(queue):
             sel = int(res.selected[j])
             node_name = feats.nodes.names[sel] if sel >= 0 else None
             nominated, victims, postfilter = None, [], None
-            if node_name is None and self._preemption:
-                nominated, victims, postfilter = self._attempt_preemption(
-                    pod, feats, plugins, res, j
+            if node_name is None:
+                nominated, victims, postfilter = self._run_post_filter(
+                    pod, feats, plugins, res, j, prof=prof
                 )
             # Permit runs after selection (upstream RunPermitPlugins is
             # post-Reserve, wrappedplugin.go:582-611).
@@ -666,6 +722,23 @@ class SchedulerService:
                 permit_verdict, permit_maps, wait_deadlines = self._run_permit(
                     plugins, pod, node_name
                 )
+            # PreBind/Bind chains (upstream: post-WaitOnPermit; for
+            # permit-parked pods they run at allow time instead,
+            # _finalize_waiting).
+            prebind_extra: dict[str, str] = {}
+            bind_map = None
+            bind_ok = True
+            if node_name is not None and permit_verdict == SUCCESS:
+                prebind_extra, prebind_failed = self._run_pre_bind(
+                    plugins, pod, node_name
+                )
+                if prebind_failed:
+                    bind_ok = False
+                    bind_map = {}
+                else:
+                    bind_map, bind_ok = self._run_bind(
+                        plugins, pod, node_name, prof=prof
+                    )
             anno = (
                 render_pod_results(
                     feats,
@@ -674,17 +747,25 @@ class SchedulerService:
                     j,
                     postfilter=postfilter,
                     permit=permit_maps,
-                    bound=permit_verdict != REJECT,
+                    bound=permit_verdict != REJECT and bind_ok,
+                    prebind_extra=prebind_extra,
+                    bind_map=bind_map,
                     ctx=render_ctx,
                 )
                 if self._record == "full"
                 else {}
             )
             node_name, parked = self._settle_permit(
-                pod, node_name, permit_verdict, wait_deadlines, anno, placements
+                pod, node_name, permit_verdict, wait_deadlines, anno, placements,
+                plugins=plugins, prof=prof,
             )
             if parked:
                 continue
+            if not bind_ok:
+                # A PreBind/Bind failure fails the cycle: the pod stays
+                # pending (upstream unreserves and requeues), the attempt
+                # is recorded.
+                node_name = None
 
             def rebuild(obj: JSON) -> JSON:
                 # Shallow re-wrap (store.rewrap contract): share the
@@ -711,11 +792,23 @@ class SchedulerService:
                 new["status"] = status
                 return new
 
-            updated = self._store.rewrap(
-                "pods", name_of(pod), namespace_of(pod), rebuild
-            )
+            try:
+                updated = self._store.rewrap(
+                    "pods", name_of(pod), namespace_of(pod), rebuild
+                )
+            except NotFoundError:
+                # The pod was deleted while this pass ran (a reset or an
+                # external delete during a long compile): upstream's Bind
+                # fails just THAT pod; the rest of the batch still binds.
+                logger.info(
+                    "pod %s/%s deleted mid-pass; skipping its bind",
+                    namespace_of(pod), name_of(pod),
+                )
+                continue
             with self._own_rvs_lock:
                 self._own_rvs.add(updated["metadata"]["resourceVersion"])
+            if node_name is not None:
+                self._run_post_bind(plugins, updated, node_name)
             # Evict the victims (the debuggable scheduler deletes them via
             # the apiserver; KWOK terminates immediately).  The DELETED
             # events trigger the next pass, which schedules the preemptor.
@@ -725,6 +818,210 @@ class SchedulerService:
                 except Exception:
                     logger.exception("failed to evict victim %s", name_of(v))
             placements[f"{namespace_of(pod)}/{name_of(pod)}"] = node_name
+
+    # -- host extension points (PreEnqueue/PostFilter/PreBind/Bind/PostBind) -
+
+    def _pre_enqueue_admits(self, prof, pod: JSON) -> bool:
+        """All PreEnqueue hooks must return None (upstream: any
+        non-success status keeps the pod out of the queue; an erroring
+        gate blocks, like an upstream Error status)."""
+        for name, hook in prof.pre_enqueue_hooks:
+            try:
+                msg = hook(pod)
+            except Exception as e:
+                logger.exception("pre-enqueue hook %s failed", name)
+                msg = f"pre-enqueue error: {e}"
+            if msg is not None:
+                return False
+        return True
+
+    def _run_post_filter(self, pod, feats, plugins, res, j, prof=None):
+        """The PostFilter chain: DefaultPreemption (structural) first in
+        its default-config position, then out-of-tree ``post_filter``
+        hooks in plugin order until one nominates a node — upstream
+        RunPostFilterPlugins stops at the first success
+        (wrappedplugin.go:550-577 wraps each).  Returns
+        (nominated, victims, postfilter_annotation_map)."""
+        nominated, victims, post = None, [], None
+        default_on = self._preemption and (
+            prof is None or "DefaultPreemption" not in prof.postfilter_disabled
+        )
+        if default_on:
+            nominated, victims, post = self._attempt_preemption(
+                pod, feats, plugins, res, j
+            )
+        if nominated is not None:
+            return nominated, victims, post
+        n_valid = feats.nodes.count
+        failed_nodes = [feats.nodes.names[i] for i in range(n_valid)]
+        ran_custom = False
+        for sp in plugins:
+            if not getattr(sp, "postfilter_enabled", False):
+                continue
+            hook = getattr(sp.plugin, "post_filter", None)
+            ext = getattr(sp, "extender", None)
+            before = getattr(ext, "before_post_filter", None) if ext else None
+            after = getattr(ext, "after_post_filter", None) if ext else None
+            if hook is None and before is None and after is None:
+                # plugins_factory-built sets carry default-True flags;
+                # only a real hook makes this a PostFilter plugin.
+                continue
+            ran_custom = True
+            name = sp.plugin.name
+            msg = None
+            nom = None
+            if before is not None:
+                try:
+                    msg = before(pod)
+                except Exception as e:
+                    logger.exception("postfilter extender %s failed", name)
+                    msg = f"postfilter extender error: {e}"
+            if msg is None:
+                if hook is not None:
+                    try:
+                        nom = hook(pod, list(failed_nodes))
+                    except Exception:
+                        logger.exception("postfilter plugin %s failed", name)
+                        nom = None
+                if after is not None:
+                    try:
+                        nom, msg = after(pod, nom, msg)
+                    except Exception:
+                        logger.exception("postfilter extender %s failed", name)
+                        nom = None
+            if nom is not None and nom in set(failed_nodes):
+                from ksim_tpu.scheduler.preemption import NOMINATED_MESSAGE
+
+                if post is None:
+                    post = {n: {} for n in failed_nodes}
+                post[nom] = {name: NOMINATED_MESSAGE}
+                return nom, victims, post
+        if post is None and ran_custom:
+            post = {n: {} for n in failed_nodes}
+        return nominated, victims, post
+
+    def _run_pre_bind(self, plugins, pod: JSON, node_name: str):
+        """Out-of-tree PreBind hooks (upstream RunPreBindPlugins stops at
+        the first failure; a failure fails the scheduling cycle).
+        Returns ({plugin: success-or-message}, failed)."""
+        extra: dict[str, str] = {}
+        for sp in plugins:
+            hook = getattr(sp.plugin, "pre_bind", None)
+            ext = getattr(sp, "extender", None)
+            before = getattr(ext, "before_pre_bind", None) if ext else None
+            after = getattr(ext, "after_pre_bind", None) if ext else None
+            if hook is None and before is None and after is None:
+                continue
+            if not getattr(sp, "prebind_enabled", True):
+                continue
+            name = sp.plugin.name
+            msg = None
+            if before is not None:
+                try:
+                    msg = before(pod, node_name)
+                except Exception as e:
+                    logger.exception("prebind extender %s failed", name)
+                    msg = f"prebind extender error: {e}"
+            if msg is None and hook is not None:
+                try:
+                    msg = hook(pod, node_name)
+                except Exception as e:
+                    logger.exception("prebind plugin %s failed", name)
+                    msg = f"prebind plugin error: {e}"
+            if after is not None:
+                try:
+                    msg = after(pod, node_name, msg)
+                except Exception as e:
+                    logger.exception("prebind extender %s failed", name)
+                    msg = f"prebind extender error: {e}"
+            from ksim_tpu.engine.annotations import SUCCESS_MESSAGE
+
+            extra[name] = SUCCESS_MESSAGE if msg is None else str(msg)
+            if msg is not None:
+                return extra, True
+        return extra, False
+
+    def _run_bind(self, plugins, pod: JSON, node_name: str, prof=None):
+        """The Bind chain (upstream RunBindPlugins: plugins in order; Skip
+        falls through, the first non-Skip handles the bind;
+        wrappedplugin.go:699-726 records per-binder results).  A custom
+        ``bind(pod, node_name)`` returns None to skip, True when it
+        accepts the bind (the store write — the simulated apiserver — is
+        still the service's, exactly as the reference's wrapped binder
+        ultimately binds through the simulator's apiserver), or a message
+        string on failure.  Returns ({binder: status}, ok)."""
+        from ksim_tpu.engine.annotations import SUCCESS_MESSAGE
+
+        for sp in plugins:
+            if not getattr(sp, "bind_enabled", False):
+                continue
+            hook = getattr(sp.plugin, "bind", None)
+            ext = getattr(sp, "extender", None)
+            before = getattr(ext, "before_bind", None) if ext else None
+            after = getattr(ext, "after_bind", None) if ext else None
+            name = sp.plugin.name
+            outcome = None
+            if before is not None:
+                try:
+                    outcome = before(pod, node_name)
+                except Exception as e:
+                    logger.exception("bind extender %s failed", name)
+                    outcome = f"bind extender error: {e}"
+            if outcome is None and hook is not None:
+                try:
+                    outcome = hook(pod, node_name)
+                except Exception as e:
+                    logger.exception("bind plugin %s failed", name)
+                    outcome = f"bind plugin error: {e}"
+            if after is not None:
+                try:
+                    outcome = after(pod, node_name, outcome)
+                except Exception as e:
+                    logger.exception("bind extender %s failed", name)
+                    outcome = f"bind extender error: {e}"
+            if outcome is None:
+                continue  # Skip: next bind plugin
+            if outcome is True:
+                return {name: SUCCESS_MESSAGE}, True
+            return {name: str(outcome)}, False
+        if prof is not None and "DefaultBinder" in prof.bind_disabled:
+            # No binder handled the pod (upstream: "no Bind plugin" error).
+            return {}, False
+        return {"DefaultBinder": SUCCESS_MESSAGE}, True
+
+    def _run_post_bind(self, plugins, pod: JSON, node_name: str) -> None:
+        """PostBind notifications after a successful bind (upstream
+        RunPostBindPlugins is void; wrappedplugin.go:728-746 — a
+        non-success BeforePostBind skips the original hook)."""
+        for sp in plugins:
+            if not getattr(sp, "postbind_enabled", False):
+                continue
+            hook = getattr(sp.plugin, "post_bind", None)
+            ext = getattr(sp, "extender", None)
+            before = getattr(ext, "before_post_bind", None) if ext else None
+            after = getattr(ext, "after_post_bind", None) if ext else None
+            name = sp.plugin.name
+            if before is not None:
+                try:
+                    if before(pod, node_name) is not None:
+                        logger.warning(
+                            "postbind extender %s blocked the original hook",
+                            name,
+                        )
+                        continue
+                except Exception:
+                    logger.exception("postbind extender %s failed", name)
+                    continue
+            if hook is not None:
+                try:
+                    hook(pod, node_name)
+                except Exception:
+                    logger.exception("postbind plugin %s failed", name)
+            if after is not None:
+                try:
+                    after(pod, node_name)
+                except Exception:
+                    logger.exception("postbind extender %s failed", name)
 
     # -- Permit (upstream RunPermitPlugins + waitingPodsMap) ----------------
 
@@ -745,14 +1042,44 @@ class SchedulerService:
         verdict = SUCCESS
         for sp in plugins:
             hook = getattr(sp.plugin, "permit", None)
-            if hook is None or not getattr(sp, "permit_enabled", True):
+            ext = getattr(sp, "extender", None)
+            before = getattr(ext, "before_permit", None) if ext else None
+            after = getattr(ext, "after_permit", None) if ext else None
+            if (hook is None and before is None and after is None) or not getattr(
+                sp, "permit_enabled", True
+            ):
                 continue
             name = sp.plugin.name
-            try:
-                result = hook(pod, node_name)
-            except Exception as e:  # an erroring plugin rejects (upstream Error status)
-                logger.exception("permit plugin %s failed", name)
-                result = PermitResult.reject(f"permit plugin error: {e}")
+            result = None
+            if before is not None:
+                # A non-success BeforePermit skips the original hook and
+                # becomes the point's status (extender iface semantics,
+                # wrappedplugin.go:47-171).
+                try:
+                    msg = before(pod, node_name)
+                except Exception as e:
+                    logger.exception("permit extender %s failed", name)
+                    msg = f"permit extender error: {e}"
+                if msg is not None:
+                    result = PermitResult.reject(str(msg))
+            if result is None:
+                if hook is not None:
+                    try:
+                        result = hook(pod, node_name)
+                    except Exception as e:  # an erroring plugin rejects (upstream Error status)
+                        logger.exception("permit plugin %s failed", name)
+                        result = PermitResult.reject(f"permit plugin error: {e}")
+                else:
+                    # Extender-only entry: a nil original permit succeeds
+                    # (the wrapped plugin returns success when the
+                    # original is absent).
+                    result = PermitResult.allow()
+                if after is not None:
+                    try:
+                        result = after(pod, node_name, result)
+                    except Exception as e:
+                        logger.exception("permit extender %s failed", name)
+                        result = PermitResult.reject(f"permit extender error: {e}")
             if not isinstance(result, PermitResult):
                 result = PermitResult.reject(f"permit plugin {name} returned {result!r}")
             # Recorded message: success/wait keywords, otherwise the
@@ -789,12 +1116,19 @@ class SchedulerService:
         deadlines: dict[str, float],
         anno: dict[str, str],
         placements: dict,
+        plugins: Sequence[ScoredPlugin] = (),
+        prof=None,
     ) -> tuple[str | None, bool]:
         """Resolve a permit verdict for a selected pod: WAIT parks it
         (returns (None, True) — caller skips the bind), REJECT clears the
-        selection (upstream Unreserve, no PostFilter), SUCCESS binds."""
+        selection (upstream Unreserve, no PostFilter), SUCCESS binds.
+        ``plugins``/``prof`` ride into the parked entry so the
+        PreBind/Bind/PostBind chains can run at allow time."""
         if node_name is not None and verdict == WAIT:
-            self._park_waiting(pod, node_name, deadlines, anno, placements)
+            self._park_waiting(
+                pod, node_name, deadlines, anno, placements,
+                plugins=plugins, prof=prof,
+            )
             return None, True
         if node_name is not None and verdict == REJECT:
             return None, False
@@ -807,6 +1141,8 @@ class SchedulerService:
         deadlines: dict[str, float],
         anno: dict[str, str],
         placements: dict,
+        plugins: Sequence[ScoredPlugin] = (),
+        prof=None,
     ) -> None:
         """Park a Permit-WAIT pod: no bind, no pod write yet; the waiting
         entry keeps it out of the queue and charges its node in
@@ -819,6 +1155,8 @@ class SchedulerService:
                 node_name=node_name,
                 pending=deadlines,
                 anno=anno,
+                plugins=tuple(plugins),
+                prof=prof,
             )
         placements[key] = node_name
         self._pass_waits += 1
@@ -936,7 +1274,48 @@ class SchedulerService:
                 message = f"node {wp.node_name} deleted while waiting on permit"
 
         anno = dict(wp.anno)
-        if not bind and anno:
+        chains_recorded = False
+        if bind and wp.plugins:
+            # The PreBind/Bind chains run now (upstream: after
+            # WaitOnPermit returns success), with the pass's plugin set.
+            import json as _json
+
+            pod_obj = {
+                "metadata": {"name": wp.name, "namespace": wp.namespace}
+            }
+            try:
+                pod_obj = self._store.get("pods", wp.name, wp.namespace)
+            except NotFoundError:
+                pass
+            prebind_extra, prebind_failed = self._run_pre_bind(
+                wp.plugins, pod_obj, wp.node_name
+            )
+            if prebind_failed:
+                bind = False
+                message = "prebind failed: " + next(
+                    (v for v in reversed(list(prebind_extra.values()))), ""
+                )
+            bind_map = {} if prebind_failed else None
+            if bind:
+                bind_map, bind_ok = self._run_bind(
+                    wp.plugins, pod_obj, wp.node_name, prof=wp.prof
+                )
+                if not bind_ok:
+                    bind = False
+                    message = "bind failed: " + ", ".join(bind_map.values())
+            if anno:
+                # The chains RAN — their results (including failure
+                # messages, wrappedplugin.go AddBindResult) are the
+                # record; the rejected-waiter reset below must not wipe
+                # them (the inline _bind_results path keeps them too).
+                chains_recorded = True
+                if prebind_extra and anno.get(PRE_BIND_RESULT_KEY):
+                    merged = _json.loads(anno[PRE_BIND_RESULT_KEY])
+                    merged.update(prebind_extra)
+                    anno[PRE_BIND_RESULT_KEY] = _marshal(merged)
+                if bind_map is not None:
+                    anno[BIND_RESULT_KEY] = _marshal(bind_map)
+        if not bind and anno and not chains_recorded:
             # Bind/PreBind never ran for a rejected waiter.
             anno[BIND_RESULT_KEY] = _marshal({})
             anno[PRE_BIND_RESULT_KEY] = _marshal({})
@@ -968,6 +1347,8 @@ class SchedulerService:
             self._own_rvs.add(updated["metadata"]["resourceVersion"])
         if bind:
             self.metrics.inc("pods_scheduled")
+            if wp.plugins:
+                self._run_post_bind(wp.plugins, updated, wp.node_name)
         else:
             logger.info("permit: pod %s/%s rejected: %s", wp.namespace, wp.name, message)
             key = f"{wp.namespace}/{wp.name}"
