@@ -96,8 +96,11 @@ def load_kubeconfig(path: str, context: str | None = None) -> dict[str, Any]:
     Returns {server, headers, ssl_context}; raises
     InvalidConfigError on a missing/odd file.  Supported auth: bearer
     ``token`` / ``tokenFile``, basic ``username``/``password``, client
-    certificates (path or inline ``-data``).  ``exec`` credential plugins
-    are not supported (no child processes from the simulator)."""
+    certificates (path or inline ``-data``), and — behind the explicit
+    ``KSIM_ALLOW_EXEC_CREDENTIALS=1`` opt-in — ``exec`` credential
+    plugins (the client-go ExecCredential protocol GKE/EKS kubeconfigs
+    use; running an operator-supplied command is a code-execution
+    capability, hence the gate, like builderImport's)."""
     import yaml
 
     try:
@@ -117,10 +120,33 @@ def load_kubeconfig(path: str, context: str | None = None) -> dict[str, Any]:
     if cluster is None or not cluster.get("server"):
         raise InvalidConfigError(f"kubeconfig {path!r}: context {ctx_name!r} has no cluster server")
     user = users.get(ctx.get("user"), {})
+    headers_expiry: float | None = None
+    headers_refresh = None
     if user.get("exec"):
-        raise InvalidConfigError(
-            f"kubeconfig {path!r}: exec credential plugins are not supported"
-        )
+        if os.environ.get("KSIM_ALLOW_EXEC_CREDENTIALS") != "1":
+            raise InvalidConfigError(
+                f"kubeconfig {path!r}: exec credential plugins run an "
+                "operator-supplied command; enable with "
+                "KSIM_ALLOW_EXEC_CREDENTIALS=1"
+            )
+        creds = _exec_credentials(path, user["exec"])
+        headers_expiry = creds.pop("_expiry", None)
+        user = dict(user, **creds)
+        if creds.get("token"):
+            # Exec tokens expire (EKS ~15 min): the source re-runs the
+            # plugin near expiry / on 401.  Cert-data exec creds refresh
+            # only at construction (rebuilding the TLS context mid-flight
+            # is not supported).
+            exec_spec = user["exec"]
+
+            def headers_refresh() -> "tuple[dict[str, str], float | None]":
+                fresh = _exec_credentials(path, exec_spec)
+                return (
+                    {"Authorization": f"Bearer {fresh['token']}"}
+                    if fresh.get("token")
+                    else {},
+                    fresh.pop("_expiry", None),
+                )
 
     server: str = cluster["server"].rstrip("/")
     headers: dict[str, str] = {}
@@ -151,7 +177,89 @@ def load_kubeconfig(path: str, context: str | None = None) -> dict[str, Any]:
         "server": server,
         "headers": headers,
         "ssl_context": ssl_context,
+        "headers_expiry": headers_expiry,
+        "headers_refresh": headers_refresh,
     }
+
+
+EXEC_CREDENTIAL_TIMEOUT_S = 20.0
+
+
+def _exec_credentials(path: str, spec: dict) -> dict:
+    """Run a client-go exec credential plugin (client-go
+    tools/clientcmd/api ExecConfig -> ExecCredential.status) and map its
+    status onto kubeconfig user fields: ``token``,
+    ``clientCertificateData``/``clientKeyData`` -> ``client-*-data``
+    (base64'd, our cert loader's inline form).  Watchdogged subprocess;
+    any failure is an InvalidConfigError — auth must fail loudly."""
+    import subprocess
+
+    command = spec.get("command")
+    if not command:
+        raise InvalidConfigError(f"kubeconfig {path!r}: exec plugin has no command")
+    env = dict(os.environ)
+    for pair in spec.get("env") or []:
+        if pair.get("name"):
+            env[pair["name"]] = pair.get("value", "")
+    # The protocol hands the plugin its own apiVersion + non-interactive
+    # mode via KUBERNETES_EXEC_INFO.
+    env["KUBERNETES_EXEC_INFO"] = json.dumps(
+        {
+            "apiVersion": spec.get("apiVersion")
+            or "client.authentication.k8s.io/v1",
+            "kind": "ExecCredential",
+            "spec": {"interactive": False},
+        }
+    )
+    try:
+        proc = subprocess.run(
+            [command, *(spec.get("args") or [])],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=EXEC_CREDENTIAL_TIMEOUT_S,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise InvalidConfigError(
+            f"kubeconfig {path!r}: exec plugin {command!r}: {e}"
+        ) from None
+    if proc.returncode != 0:
+        raise InvalidConfigError(
+            f"kubeconfig {path!r}: exec plugin {command!r} exited "
+            f"{proc.returncode}: {proc.stderr.strip()[:200]}"
+        )
+    try:
+        status = (json.loads(proc.stdout) or {}).get("status") or {}
+    except json.JSONDecodeError as e:
+        raise InvalidConfigError(
+            f"kubeconfig {path!r}: exec plugin {command!r} output: {e}"
+        ) from None
+    out: dict = {}
+    if status.get("expirationTimestamp"):
+        # RFC3339 -> epoch; an unparseable stamp means "no expiry known".
+        import datetime
+
+        try:
+            out["_expiry"] = datetime.datetime.fromisoformat(
+                status["expirationTimestamp"].replace("Z", "+00:00")
+            ).timestamp()
+        except ValueError:
+            pass
+    if status.get("token"):
+        out["token"] = status["token"]
+    if status.get("clientCertificateData"):
+        out["client-certificate-data"] = base64.b64encode(
+            status["clientCertificateData"].encode()
+        ).decode()
+    if status.get("clientKeyData"):
+        out["client-key-data"] = base64.b64encode(
+            status["clientKeyData"].encode()
+        ).decode()
+    if not any(k in out for k in ("token", "client-certificate-data", "client-key-data")):
+        raise InvalidConfigError(
+            f"kubeconfig {path!r}: exec plugin {command!r} returned no credentials"
+        )
+    return out
 
 
 def _build_ssl_context(path: str, cluster: dict, user: dict) -> ssl.SSLContext:
@@ -205,11 +313,20 @@ class KubeApiSource:
         headers: dict[str, str] | None = None,
         ssl_context: ssl.SSLContext | None = None,
         request_timeout: float = 30.0,
+        headers_expiry: float | None = None,
+        headers_refresh=None,
     ) -> None:
         self._server = server.rstrip("/")
         self._headers = dict(headers or {})
         self._ssl = ssl_context
         self._timeout = request_timeout
+        # Exec-credential rotation (load_kubeconfig): refresh() returns
+        # (new auth headers, new expiry epoch).  Checked before every
+        # request and retried once on 401 — long-running syncers outlive
+        # EKS/GKE token TTLs.
+        self._headers_expiry = headers_expiry
+        self._headers_refresh = headers_refresh
+        self._refresh_lock = threading.Lock()
 
     @classmethod
     def from_kubeconfig(cls, path: str, context: str | None = None) -> "KubeApiSource":
@@ -221,18 +338,42 @@ class KubeApiSource:
 
     # -- HTTP ----------------------------------------------------------------
 
+    def _maybe_refresh_auth(self, *, force: bool = False) -> None:
+        if self._headers_refresh is None:
+            return
+        with self._refresh_lock:
+            stale = force or (
+                self._headers_expiry is not None
+                and time.time() > self._headers_expiry - 60
+            )
+            if not stale:
+                return
+            try:
+                fresh, expiry = self._headers_refresh()
+            except Exception as e:
+                raise SimulatorError(f"credential refresh failed: {e}") from None
+            self._headers.update(fresh)
+            self._headers_expiry = expiry
+
     def _open(self, path: str, query: dict[str, Any], timeout: float):
         url = self._server + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
-        req = urllib.request.Request(url, headers=self._headers)
-        try:
-            return urllib.request.urlopen(req, timeout=timeout, context=self._ssl)
-        except urllib.error.HTTPError as e:
-            body = e.read(4096).decode(errors="replace")
-            raise SimulatorError(f"GET {path}: HTTP {e.code}: {body[:200]}") from None
-        except (urllib.error.URLError, OSError, ssl.SSLError) as e:
-            raise SimulatorError(f"GET {path}: {e}") from None
+        self._maybe_refresh_auth()
+        for attempt in (0, 1):
+            req = urllib.request.Request(url, headers=self._headers)
+            try:
+                return urllib.request.urlopen(req, timeout=timeout, context=self._ssl)
+            except urllib.error.HTTPError as e:
+                if e.code == 401 and attempt == 0 and self._headers_refresh is not None:
+                    # Token died before its advertised expiry: one forced
+                    # re-exec, then the retry below.
+                    self._maybe_refresh_auth(force=True)
+                    continue
+                body = e.read(4096).decode(errors="replace")
+                raise SimulatorError(f"GET {path}: HTTP {e.code}: {body[:200]}") from None
+            except (urllib.error.URLError, OSError, ssl.SSLError) as e:
+                raise SimulatorError(f"GET {path}: {e}") from None
 
     # -- SourceCluster -------------------------------------------------------
 

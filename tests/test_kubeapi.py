@@ -12,6 +12,7 @@ from __future__ import annotations
 import base64
 import copy
 import json
+import sys
 import threading
 import time
 import urllib.parse
@@ -334,12 +335,76 @@ def test_kubeconfig_token_file(tmp_path):
 
 def test_kubeconfig_rejects_exec_and_missing_context(tmp_path):
     p = _write_kubeconfig(tmp_path, {"exec": {"command": "aws"}})
-    with pytest.raises(InvalidConfigError, match="exec"):
+    with pytest.raises(InvalidConfigError, match="KSIM_ALLOW_EXEC_CREDENTIALS"):
         load_kubeconfig(p)
     with pytest.raises(InvalidConfigError, match="context"):
         load_kubeconfig(p, context="nope")
     with pytest.raises(InvalidConfigError):
         load_kubeconfig(str(tmp_path / "missing.yaml"))
+
+
+def _stub_exec_plugin(tmp_path, body: str) -> str:
+    """A stub credential plugin script (the shape GKE's
+    gke-gcloud-auth-plugin / EKS's aws eks get-token emit)."""
+    import stat
+
+    script = tmp_path / "cred-plugin.py"
+    script.write_text("#!/usr/bin/env python3\n" + body)
+    script.chmod(script.stat().st_mode | stat.S_IXUSR)
+    return str(script)
+
+
+def test_kubeconfig_exec_plugin_token(tmp_path, monkeypatch):
+    """Gated exec credential plugins (client-go ExecCredential protocol):
+    the plugin's status.token becomes the bearer header, and the plugin
+    sees KUBERNETES_EXEC_INFO."""
+    monkeypatch.setenv("KSIM_ALLOW_EXEC_CREDENTIALS", "1")
+    script = _stub_exec_plugin(
+        tmp_path,
+        "import json, os, sys\n"
+        "info = json.loads(os.environ['KUBERNETES_EXEC_INFO'])\n"
+        "assert info['kind'] == 'ExecCredential'\n"
+        "assert os.environ.get('PLUGIN_FLAVOR') == 'stub'\n"
+        "assert sys.argv[1:] == ['get-token']\n"
+        "print(json.dumps({'apiVersion': info['apiVersion'],"
+        " 'kind': 'ExecCredential',"
+        " 'status': {'token': 'exec-token'}}))\n",
+    )
+    p = _write_kubeconfig(
+        tmp_path,
+        {
+            "exec": {
+                "apiVersion": "client.authentication.k8s.io/v1beta1",
+                "command": sys.executable,
+                "args": [script, "get-token"],
+                "env": [{"name": "PLUGIN_FLAVOR", "value": "stub"}],
+            }
+        },
+    )
+    cc = load_kubeconfig(p)
+    assert cc["headers"]["Authorization"] == "Bearer exec-token"
+
+
+def test_kubeconfig_exec_plugin_failure_is_config_error(tmp_path, monkeypatch):
+    monkeypatch.setenv("KSIM_ALLOW_EXEC_CREDENTIALS", "1")
+    failing = _stub_exec_plugin(
+        tmp_path, "import sys\nsys.stderr.write('no creds')\nsys.exit(3)\n"
+    )
+    p = _write_kubeconfig(
+        tmp_path,
+        {"exec": {"command": sys.executable, "args": [failing]}},
+    )
+    with pytest.raises(InvalidConfigError, match="exited 3"):
+        load_kubeconfig(p)
+    # Empty status is an error too — auth must fail loudly.
+    sub = tmp_path / "e"
+    sub.mkdir()
+    empty = _stub_exec_plugin(sub, "print('{\"status\": {}}')\n")
+    p2 = _write_kubeconfig(
+        tmp_path, {"exec": {"command": sys.executable, "args": [empty]}}
+    )
+    with pytest.raises(InvalidConfigError, match="no credentials"):
+        load_kubeconfig(p2)
 
 
 def test_kubeapi_source_from_kubeconfig_lists(apiserver, tmp_path):
@@ -400,3 +465,49 @@ def test_syncer_survives_apiserver_outage():
             srv2.server_close()
     finally:
         syncer.stop()
+
+
+def test_exec_credentials_refresh_near_expiry(apiserver):
+    """An exec token past its expirationTimestamp re-runs the plugin
+    before the next request (client-go credential rotation; EKS tokens
+    live ~15 min while the syncer runs indefinitely)."""
+    _state, url = apiserver
+    calls = []
+
+    def refresh():
+        calls.append(1)
+        return {"Authorization": f"Bearer fresh-{len(calls)}"}, time.time() + 3600
+
+    src = KubeApiSource(
+        url,
+        headers={"Authorization": "Bearer stale"},
+        headers_expiry=time.time() - 10,
+        headers_refresh=refresh,
+    )
+    src.list("nodes")
+    assert calls == [1]
+    assert src._headers["Authorization"] == "Bearer fresh-1"
+    # Fresh expiry far in the future: no re-exec on the next request.
+    src.list("nodes")
+    assert calls == [1]
+
+
+def test_kubeconfig_exec_expiry_parsed(tmp_path, monkeypatch):
+    monkeypatch.setenv("KSIM_ALLOW_EXEC_CREDENTIALS", "1")
+    script = _stub_exec_plugin(
+        tmp_path,
+        "import json\n"
+        "print(json.dumps({'kind': 'ExecCredential', 'status': {\n"
+        "  'token': 'tok',\n"
+        "  'expirationTimestamp': '2099-01-01T00:00:00Z'}}))\n",
+    )
+    p = _write_kubeconfig(
+        tmp_path, {"exec": {"command": sys.executable, "args": [script]}}
+    )
+    cc = load_kubeconfig(p)
+    assert cc["headers"]["Authorization"] == "Bearer tok"
+    assert cc["headers_expiry"] > time.time()
+    # The refresh closure re-runs the plugin and returns fresh headers.
+    fresh, expiry = cc["headers_refresh"]()
+    assert fresh == {"Authorization": "Bearer tok"}
+    assert expiry > time.time()
