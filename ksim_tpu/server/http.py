@@ -79,9 +79,41 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def _body(self):
+        """Request body as an object.  JSON by default; a YAML
+        Content-Type parses as YAML — the reference UI's lingua franca
+        (its Monaco editors edit resources/config as YAML,
+        web/components/ResourceBar/YamlEditor.vue), so pasted manifests
+        round-trip without client-side conversion."""
         length = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(length) if length else b""
-        return json.loads(raw) if raw else {}
+        if not raw:
+            return {}
+        if "yaml" in (self.headers.get("Content-Type") or ""):
+            import yaml
+
+            return yaml.safe_load(raw)
+        return json.loads(raw)
+
+    def _wants_yaml(self, query: dict | None) -> bool:
+        fmt = (query or {}).get("format", [""])[0]
+        return fmt == "yaml" or "yaml" in (self.headers.get("Accept") or "")
+
+    def _yaml(self, code: int, obj) -> None:
+        import yaml
+
+        body = yaml.safe_dump(obj, sort_keys=False).encode()
+        self.send_response(code)
+        self._cors()
+        self.send_header("Content-Type", "application/yaml; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _object(self, code: int, obj, query: dict | None = None) -> None:
+        if self._wants_yaml(query):
+            self._yaml(code, obj)
+        else:
+            self._json(code, obj)
 
     # -- routing ------------------------------------------------------------
 
@@ -106,7 +138,11 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
         elif url.path == "/api/v1/schedulerconfiguration":
-            self._json(200, self.server.di.scheduler_service.get_scheduler_config())
+            self._object(
+                200,
+                self.server.di.scheduler_service.get_scheduler_config(),
+                parse_qs(url.query),
+            )
         elif url.path == "/api/v1/export":
             self._json(200, self.server.di.snapshot_service.snap())
         elif url.path == "/api/v1/metrics":
@@ -230,9 +266,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if method == "GET" and not name:
                 ns_filter = (query or {}).get("namespace", [""])[0]
-                self._json(200, {"items": store.list(kind, ns_filter)})
+                self._object(200, {"items": store.list(kind, ns_filter)}, query)
             elif method == "GET":
-                self._json(200, store.get(kind, name, namespace))
+                self._object(200, store.get(kind, name, namespace), query)
             elif method == "POST":
                 self._json(201, store.create(kind, self._body()))
             elif method == "PUT":

@@ -8,10 +8,12 @@ a per-node pod board with an "unscheduled" bucket (the reference's
 pods-by-node store, web/store/pod.ts:12-16,43-51), per-plugin
 Filter/Score/FinalScore tables decoded from the 13 result annotations
 with a result-history attempt browser (SchedulingResults.vue), resource
-create from prefilled templates (ResourceAddButton.vue), view/edit of
-any live resource round-tripped through the /api/v1/resources CRUD (the
-YamlEditor.vue + server-side-apply workflow, web/api/v1/pod.ts:22-53 —
-JSON here, same contract), delete, a scheduler-configuration editor
+create from prefilled templates — pasted YAML manifests create too —
+(ResourceAddButton.vue), view/edit of any live resource round-tripped
+through the /api/v1/resources CRUD as YAML (default) or JSON (the
+YamlEditor.vue + server-side-apply workflow, web/api/v1/pod.ts:22-53;
+YAML conversion is server-side, http.py _yaml/_body), delete, a
+scheduler-configuration editor with the same YAML/JSON toggle
 (SchedulerConfigurationEditButton.vue), snapshot export/import and reset
 (TopBar/), and a metrics panel.  Served at / by SimulatorServer."""
 
@@ -65,8 +67,9 @@ INDEX_HTML = """<!doctype html>
 </div>
 
 <div id="config" class="panel" style="display:none">
-  <b>KubeSchedulerConfiguration</b> (JSON; applying compiles the new
-  kernel set — the reference's scheduler restart)<br/>
+  <b>KubeSchedulerConfiguration</b> (applying compiles the new
+  kernel set — the reference's scheduler restart)
+  <span id="configFmtBtns"></span><br/>
   <textarea id="configText"></textarea><br/>
   <button onclick="applyConfig()">Apply</button>
   <button onclick="loadConfig()">Reload current</button>
@@ -93,7 +96,8 @@ INDEX_HTML = """<!doctype html>
     <span id="addMsg"></span>
   </div>
   <div id="editPanel" style="display:none">
-    <b>Edit <span id="editKey"></span></b> (live object; Save PUTs it back)<br/>
+    <b>Edit <span id="editKey"></span></b> (live object; Save PUTs it back)
+    <span id="editFmtBtns"></span><br/>
     <textarea id="editText"></textarea><br/>
     <button onclick="doSave()">Save</button>
     <button onclick="hideEdit()">Cancel</button>
@@ -378,29 +382,51 @@ function showAdd() {
 async function doAdd() {
   const msg = document.getElementById("addMsg");
   try {
-    const body = JSON.parse(document.getElementById("addText").value);
+    // Paste-a-manifest workflow: JSON if it parses, otherwise the text
+    // POSTs as YAML and the server parses it.
+    const text = document.getElementById("addText").value;
+    let body = text, ctype = "application/yaml";
+    try { body = JSON.stringify(JSON.parse(text)); ctype = "application/json"; }
+    catch (e) {}
     const r = await fetch(`/api/v1/resources/${activeKind}`, {
-      method: "POST", headers: {"Content-Type": "application/json"},
-      body: JSON.stringify(body)});
+      method: "POST", headers: {"Content-Type": ctype}, body});
     msg.textContent = r.ok ? "created" : `error ${r.status}: ${await r.text()}`;
     if (r.ok) document.getElementById("addPanel").style.display = "none";
   } catch (e) { msg.textContent = String(e); }
 }
 
-// -- view/edit any live resource (YamlEditor.vue workflow over JSON) --------
+// -- view/edit any live resource (the YamlEditor.vue workflow: YAML is
+// the default editing format, server-side converted; JSON one click away) ---
 
 let editTarget = null;  // {kind, key}
+let editFmt = "yaml";
 
-async function showEdit(key) {
-  const kind = activeKind;
+function fmtButtons(spanId, current, onPick) {
+  const span = document.getElementById(spanId);
+  span.innerHTML = ["yaml", "json"].map(f =>
+    `<span class="tab ${f===current?"active":""}" data-fmt="${f}">${f}</span>`).join("");
+  for (const el of span.querySelectorAll(".tab"))
+    el.onclick = () => onPick(el.dataset.fmt);
+}
+
+async function showEdit(key, fmt, kindOverride) {
+  // The format toggle re-invokes with the ORIGINAL kind: activeKind may
+  // have moved to another tab while the edit panel stayed open.
+  const kind = kindOverride || activeKind;
+  editFmt = fmt || editFmt;
   const msg = document.getElementById("editMsg");
   try {
-    const r = await fetch(resourcePath(kind, key));
+    const q = editFmt === "yaml" ? "?format=yaml" : "";
+    const r = await fetch(resourcePath(kind, key) + q);
     if (!r.ok) { msg.textContent = `load failed: ${r.status}`; return; }
-    const obj = await r.json();
     editTarget = {kind, key};
     document.getElementById("editKey").textContent = `${kind}/${key}`;
-    document.getElementById("editText").value = JSON.stringify(obj, null, 1);
+    document.getElementById("editText").value = editFmt === "yaml"
+      ? await r.text()
+      : JSON.stringify(await r.json(), null, 1);
+    fmtButtons("editFmtBtns", editFmt, f => {
+      if (editTarget) showEdit(editTarget.key, f, editTarget.kind);
+    });
     document.getElementById("editPanel").style.display = "block";
     msg.textContent = "";
   } catch (e) { msg.textContent = String(e); }
@@ -413,10 +439,14 @@ async function doSave() {
   const msg = document.getElementById("editMsg");
   if (!editTarget) return;
   try {
-    const body = JSON.parse(document.getElementById("editText").value);
+    const text = document.getElementById("editText").value;
+    let body = text, ctype = "application/yaml";
+    if (editFmt === "json") {
+      body = JSON.stringify(JSON.parse(text));
+      ctype = "application/json";
+    }
     const r = await fetch(resourcePath(editTarget.kind, editTarget.key), {
-      method: "PUT", headers: {"Content-Type": "application/json"},
-      body: JSON.stringify(body)});
+      method: "PUT", headers: {"Content-Type": ctype}, body});
     msg.textContent = r.ok ? "saved" : `rejected ${r.status}: ${await r.text()}`;
     if (r.ok) hideEdit();
   } catch (e) { msg.textContent = String(e); }
@@ -428,18 +458,28 @@ function toggle(id, onShow) {
   el.style.display = show ? "block" : "none";
   if (show && onShow) onShow();
 }
-async function loadConfig() {
-  const r = await fetch("/api/v1/schedulerconfiguration");
-  document.getElementById("configText").value = JSON.stringify(await r.json(), null, 1);
+let configFmt = "yaml";
+async function loadConfig(fmt) {
+  configFmt = fmt || configFmt;
+  const q = configFmt === "yaml" ? "?format=yaml" : "";
+  const r = await fetch("/api/v1/schedulerconfiguration" + q);
+  document.getElementById("configText").value = configFmt === "yaml"
+    ? await r.text()
+    : JSON.stringify(await r.json(), null, 1);
+  fmtButtons("configFmtBtns", configFmt, loadConfig);
   document.getElementById("configMsg").textContent = "";
 }
 async function applyConfig() {
   const msg = document.getElementById("configMsg");
   try {
-    const body = JSON.parse(document.getElementById("configText").value);
+    const text = document.getElementById("configText").value;
+    let body = text, ctype = "application/yaml";
+    if (configFmt === "json") {
+      body = JSON.stringify(JSON.parse(text));
+      ctype = "application/json";
+    }
     const r = await fetch("/api/v1/schedulerconfiguration", {
-      method: "POST", headers: {"Content-Type": "application/json"},
-      body: JSON.stringify(body)});
+      method: "POST", headers: {"Content-Type": ctype}, body});
     msg.textContent = r.ok ? "applied (kernel set recompiled)" : `rejected ${r.status}: ${await r.text()}`;
   } catch (e) { msg.textContent = String(e); }
 }

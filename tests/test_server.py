@@ -41,6 +41,15 @@ def _req(srv, method, path, body=None):
     return r.status, json.loads(data) if data else None
 
 
+def _raw(srv, method, path, body=None, ctype="application/json"):
+    c = _conn(srv)
+    c.request(method, path, body, {"Content-Type": ctype})
+    r = c.getresponse()
+    data = r.read()
+    c.close()
+    return r.status, data.decode()
+
+
 def test_full_cycle_over_http(server):
     di = server.di
     # Import a snapshot.
@@ -337,3 +346,63 @@ def test_listwatch_410_on_foreign_resume_point(server):
     )
     assert status == 410
     assert "resourceVersion" in body["message"]
+
+
+def test_yaml_resource_roundtrip(server):
+    """YAML is a first-class wire format for the CRUD + config routes
+    (the reference UI edits resources and config as YAML in Monaco,
+    web/components/ResourceBar/YamlEditor.vue): GET ?format=yaml serves
+    YAML, and YAML request bodies parse by Content-Type."""
+    import yaml
+
+    node_yaml = yaml.safe_dump(make_node("yaml-node"))
+    c = _conn(server)
+    c.request(
+        "POST", "/api/v1/resources/nodes", node_yaml,
+        {"Content-Type": "application/yaml"},
+    )
+    r = c.getresponse()
+    assert r.status == 201
+    r.read()
+    c.close()
+
+    status, raw = _raw(server, "GET", "/api/v1/resources/nodes/yaml-node?format=yaml")
+    assert status == 200
+    obj = yaml.safe_load(raw)
+    assert obj["metadata"]["name"] == "yaml-node"
+
+    # Edit workflow over YAML: mutate and PUT back as YAML.
+    obj["spec"]["unschedulable"] = True
+    c = _conn(server)
+    c.request(
+        "PUT", "/api/v1/resources/nodes/yaml-node", yaml.safe_dump(obj),
+        {"Content-Type": "application/yaml"},
+    )
+    r = c.getresponse()
+    assert r.status == 200
+    r.read()
+    c.close()
+    _status, body = _req(server, "GET", "/api/v1/resources/nodes/yaml-node")
+    assert body["spec"]["unschedulable"] is True
+
+    # Scheduler config serves + applies as YAML too.
+    status, raw = _raw(server, "GET", "/api/v1/schedulerconfiguration?format=yaml")
+    assert status == 200
+    cfg = yaml.safe_load(raw)
+    assert cfg["kind"] == "KubeSchedulerConfiguration"
+    cfg["profiles"] = [
+        {"plugins": {"multiPoint": {"disabled": [{"name": "ImageLocality"}]}}}
+    ]
+    c = _conn(server)
+    c.request(
+        "POST", "/api/v1/schedulerconfiguration", yaml.safe_dump(cfg),
+        {"Content-Type": "application/yaml"},
+    )
+    r = c.getresponse()
+    assert r.status == 202, r.read()
+    r.read()
+    c.close()
+    _status, got = _req(server, "GET", "/api/v1/schedulerconfiguration")
+    assert got["profiles"][0]["plugins"]["multiPoint"]["disabled"] == [
+        {"name": "ImageLocality"}
+    ]
