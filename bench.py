@@ -56,12 +56,18 @@ import traceback
 
 LADDER = [(1_000, 200), (5_000, 1_000), (10_000, 5_000)]
 # Fallback ladder when the chip is dead: CPU finishes 5000x1000 exact in
-# seconds (warm cache) — only the 10000x5000 record="full" rung exceeds
-# its cap on CPU.
+# seconds (warm cache).  The 10000x5000 rung runs SLICED on CPU (below)
+# rather than timing out: the full sequential scan exceeds its cap there.
 CPU_LADDER = [(1_000, 200), (5_000, 1_000)]
-# Churn size CPU can replay well inside the stage cap (events, nodes) —
-# used by both the planned-fallback clamp and the mid-run retry.
-CPU_CHURN_CAP = (10_000, 1_000)
+# CPU bounds the 10kx5k MEASUREMENT, not the rung (round-3 verdict): the
+# full 10k-pod cluster is generated and featurized, and the scan+batch
+# timing runs over the first CPU_SLICE_PODS queue pods x all 5k nodes —
+# a measured pairs/s record for the north-star shape on any platform.
+CPU_SLICE_PODS = 2_000
+# Churn size CPU replays inside the stage cap (events, nodes): the FULL
+# config-5 shape — ~176 s measured on this image's CPU (round-3), well
+# under CHURN_TIMEOUT; used by both fallback paths.
+CPU_CHURN_CAP = (50_000, 2_000)
 
 # Per-stage subprocess timeouts (seconds).  Cold XLA compiles of the
 # large-shape scan programs cost 5-60 s each; the persistent compile cache
@@ -83,10 +89,13 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 def _child_setup() -> None:
     import jax
 
-    from ksim_tpu.util import enable_compilation_cache
+    from ksim_tpu.util import enable_compilation_cache, raise_map_count_limit
 
     # One-time-per-machine XLA compiles, shared across rung subprocesses.
     enable_compilation_cache()
+    # Long children (the 50k churn replay) compile/load many programs in
+    # one process; vm.max_map_count's 65530 default kills exactly that.
+    raise_map_count_limit()
     # Exact mode for the headline: int64/float64 scoring paths active.
     jax.config.update("jax_enable_x64", True)
 
@@ -98,7 +107,9 @@ def child_probe() -> dict:
     return {"platform": devs[0].platform, "device_count": len(devs)}
 
 
-def child_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
+def child_rung(
+    n_pods: int, n_nodes: int, seed: int, repeats: int, slice_pods: int = 0
+) -> dict:
     import jax
 
     from ksim_tpu.engine import Engine
@@ -110,16 +121,24 @@ def child_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
     t0 = time.perf_counter()
     nodes, pods = random_cluster(seed, n_nodes=n_nodes, n_pods=n_pods, bound_fraction=0.0)
     t1 = time.perf_counter()
-    feats = Featurizer().featurize(nodes, pods)
+    # slice_pods bounds the MEASUREMENT, not the cluster: the workload is
+    # still the full config shape, but scan/batch timing covers the first
+    # ``slice_pods`` queue pods over ALL nodes — the measured pairs/s for
+    # the completed slice (how a platform too slow for the full rung still
+    # produces a recorded number; round-3 verdict item 2).
+    sliced = 0 < slice_pods < n_pods
+    queue = pods[:slice_pods] if sliced else pods
+    feats = Featurizer().featurize(nodes, pods, queue_pods=queue)
     t2 = time.perf_counter()
     print(
         f"[{n_pods}x{n_nodes}] gen {t1-t0:.1f}s featurize {t2-t1:.1f}s; padded "
         f"P={feats.pods.valid.shape[0]} N={feats.nodes.padded} "
+        f"{'slice=' + str(len(queue)) + ' ' if sliced else ''}"
         f"on {jax.devices()[0].platform}",
         file=sys.stderr,
         flush=True,
     )
-    pairs = n_pods * n_nodes
+    pairs = len(queue) * n_nodes
 
     # Sequential-commit scan (the real scheduling semantics), exact mode
     # (x64 active) — headline.
@@ -181,6 +200,9 @@ def child_rung(n_pods: int, n_nodes: int, seed: int, repeats: int) -> dict:
         "exact": True,
         "platform": jax.devices()[0].platform,
     }
+    if sliced:
+        rung["slice_pods"] = len(queue)
+        rung["pairs_measured"] = pairs
     print(
         f"[{n_pods}x{n_nodes}] scan-exact {sched_s*1e3:.0f}ms "
         f"({pairs/sched_s/1e6:.2f}M pairs/s, {n_sched} placed), "
@@ -240,7 +262,9 @@ def _child_main(args: argparse.Namespace) -> None:
         if args.child == "probe":
             out = child_probe()
         elif args.child == "rung":
-            out = child_rung(args.pods, args.nodes, args.seed, args.repeats)
+            out = child_rung(
+                args.pods, args.nodes, args.seed, args.repeats, args.slice_pods
+            )
         elif args.child == "churn":
             out = child_churn(args.seed, args.churn_nodes, args.churn_events)
         else:  # pragma: no cover
@@ -326,10 +350,21 @@ class _Orchestrator:
         rungs = self.payload["rungs"]
         headline = 0
         headline_platform = None
-        for key, r in rungs.items():
-            if key != "churn" and isinstance(r, dict) and "sched_pairs_per_sec" in r:
+        # Sliced rungs (bounded CPU measurements of the big shapes) stay
+        # recorded per-rung but only claim the headline when no fully-run
+        # rung exists.
+        for sliced_ok in (False, True):
+            for key, r in rungs.items():
+                if key == "churn" or not isinstance(r, dict):
+                    continue
+                if "sched_pairs_per_sec" not in r:
+                    continue
+                if bool(r.get("slice_pods")) != sliced_ok:
+                    continue
                 headline = r["sched_pairs_per_sec"]
                 headline_platform = r.get("platform")
+            if headline:
+                break
         self.payload["value"] = headline
         self.payload["vs_baseline"] = round(headline / 50_000, 2)
         if headline_platform:
@@ -442,6 +477,7 @@ def main() -> None:
     ap.add_argument("--child", choices=["probe", "rung", "churn"], default=None)
     ap.add_argument("--pods", type=int, default=0)
     ap.add_argument("--nodes", type=int, default=0)
+    ap.add_argument("--slice-pods", type=int, default=0)
     ap.add_argument("--out", type=str, default="")
     args = ap.parse_args()
 
@@ -509,27 +545,30 @@ def main() -> None:
         payload["fallback_cpu"] = True
         return True
 
-    def run_rung_stage(n_pods: int, n_nodes: int) -> None:
+    def run_rung_stage(n_pods: int, n_nodes: int, slice_pods: int = 0) -> None:
         key = f"{n_pods}x{n_nodes}"
         cap = CPU_RUNG_TIMEOUT if fallback else RUNG_TIMEOUT.get(key, 600)
         if orch.remaining() < 30:
             payload["rungs"][key] = {"error": "skipped: budget exhausted"}
             return
-        result = orch.run_child(
-            "rung", ["--pods", str(n_pods), "--nodes", str(n_nodes), *common], env, cap
-        )
+        if fallback and not slice_pods and (n_pods, n_nodes) not in CPU_LADDER:
+            # Already on CPU with a TPU-sized shape: the full run is a
+            # guaranteed timeout — go straight to the bounded measurement
+            # instead of burning the stage cap first.
+            slice_pods = CPU_SLICE_PODS
+        extra = ["--pods", str(n_pods), "--nodes", str(n_nodes), *common]
+        if slice_pods:
+            extra += ["--slice-pods", str(slice_pods)]
+        result = orch.run_child("rung", extra, env, cap)
         if "error" in result and check_mid_run_fallback():
-            # Fresh transition only: retry small (CPU-sized) rungs once in
-            # the sanitized env; a run that was ALWAYS on CPU gains
-            # nothing from an identical retry.
-            if (n_pods, n_nodes) in CPU_LADDER:
-                retry = orch.run_child(
-                    "rung",
-                    ["--pods", str(n_pods), "--nodes", str(n_nodes), *common],
-                    env,
-                    CPU_RUNG_TIMEOUT,
-                )
-                result = retry if "error" not in retry else result
+            # Fresh transition only: retry once in the sanitized env —
+            # CPU-sized rungs as-is, bigger shapes sliced (a run that was
+            # ALWAYS on CPU gains nothing from an identical retry).
+            retry_extra = list(extra)
+            if (n_pods, n_nodes) not in CPU_LADDER and not slice_pods:
+                retry_extra += ["--slice-pods", str(CPU_SLICE_PODS)]
+            retry = orch.run_child("rung", retry_extra, env, CPU_RUNG_TIMEOUT)
+            result = retry if "error" not in retry else result
         payload["rungs"][key] = result
         orch.flush_partial()
 
@@ -582,13 +621,22 @@ def main() -> None:
         run_rung_stage(*ladder[0])
     run_churn_stage()
     for n_pods, n_nodes in ladder[1:]:
-        if fallback and (n_pods, n_nodes) not in CPU_LADDER:
-            # The backend fell back mid-run: the big rungs are TPU-sized.
-            payload["rungs"][f"{n_pods}x{n_nodes}"] = {
-                "error": "skipped: backend fell back to CPU mid-run"
-            }
-            continue
         run_rung_stage(n_pods, n_nodes)
+    if fallback:
+        # The north-star shape still gets a measured record on CPU: the
+        # full cluster, timing bounded to a CPU_SLICE_PODS slice of the
+        # scan + batch paths (round-3 verdict item 2: "bound the
+        # measurement, not the rung").  An error entry (a TPU attempt
+        # that died before the mid-run fallback, or its failed retry)
+        # does NOT satisfy the record — only a measured one does.
+        for n_pods, n_nodes in LADDER:
+            key = f"{n_pods}x{n_nodes}"
+            have = payload["rungs"].get(key)
+            if have is None or (
+                isinstance(have, dict)
+                and "sched_pairs_per_sec" not in have
+            ):
+                run_rung_stage(n_pods, n_nodes, slice_pods=CPU_SLICE_PODS)
 
     orch.emit()
 

@@ -21,11 +21,24 @@ def enable_compilation_cache(cache_dir: str | None = None) -> None:
     import, so embedding applications keep control of jax.config.
 
     ``KSIM_COMPILE_CACHE`` overrides the location; set it to ``off`` to
-    disable."""
+    disable.
+
+    The default location is fingerprinted by the HOST CPU's feature set:
+    XLA:CPU caches AOT-compiled code, and an artifact produced on a
+    machine with different vector extensions can SIGILL when loaded on
+    this one (cpu_aot_loader warns exactly that; images here migrate
+    across heterogeneous hosts between rounds, and a round-4 suite run
+    crashed on a stale cross-host artifact).  One subdirectory per
+    feature set makes the cache per-machine-model instead of
+    per-filesystem."""
     env = os.environ.get("KSIM_COMPILE_CACHE")
     if env == "off":
         return
-    cache_dir = env or cache_dir or os.path.expanduser("~/.cache/ksim_tpu/jax")
+    cache_dir = env or cache_dir
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.expanduser("~/.cache/ksim_tpu/jax"), _host_fingerprint()
+        )
     import jax
 
     try:
@@ -35,6 +48,41 @@ def enable_compilation_cache(cache_dir: str | None = None) -> None:
         return
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def raise_map_count_limit(target: int = 1_000_000) -> None:
+    """Best-effort raise of vm.max_map_count: every XLA:CPU executable
+    mmaps code pages, and a long single process (the full test suite, a
+    50k-event churn replay) can hit the kernel's 65530 default —
+    observed as SIGSEGV/SIGABRT inside LLVM at ~63k maps (round 4).
+    No-op without root/procfs."""
+    try:
+        with open("/proc/sys/vm/max_map_count") as f:
+            if int(f.read()) >= target:
+                return
+        with open("/proc/sys/vm/max_map_count", "w") as f:
+            f.write(str(target))
+    except (OSError, ValueError):
+        pass
+
+
+def _host_fingerprint() -> str:
+    """Short stable hash of this host's CPU feature flags (falls back to
+    the platform string when /proc/cpuinfo is unavailable)."""
+    import hashlib
+    import platform
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    basis = flags or platform.processor() or platform.machine() or "unknown"
+    return "host-" + hashlib.sha256(basis.encode()).hexdigest()[:12]
 
 
 def retry_with_exponential_backoff(

@@ -20,3 +20,44 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 assert jax.device_count() >= 8, "virtual device mesh not active"
+
+# Persistent compile cache (host-fingerprinted, ksim_tpu.util): the suite
+# compiles many hundreds of XLA:CPU programs in one process, and this
+# image's jaxlib segfaulted inside LLVM codegen late in two full-suite
+# runs (reproducibly ~92% in, never in isolation).  A warm cache drops
+# the per-process compile count to ~zero, which both sidesteps the crash
+# and cuts suite wall-clock.  KSIM_COMPILE_CACHE=off disables.
+import sys as _sys  # noqa: E402
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from ksim_tpu.util import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
+
+# The real round-4 crash root cause: a full-suite process accumulates
+# ~8k memory maps/min (every XLA:CPU executable mmaps code pages) and
+# dies at the kernel's vm.max_map_count (65530 default) — SIGSEGV when
+# it hits during LLVM codegen, SIGABRT during cache deserialization,
+# always ~92% through the suite, never in half-suite runs (observed
+# maps=62797 ten seconds before death).  Two best-effort guards: raise
+# the limit (this image runs as root), and shed live executables when
+# the map count nears the ceiling.
+
+
+from ksim_tpu.util import raise_map_count_limit  # noqa: E402
+
+raise_map_count_limit()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _shed_executables_when_map_bound_nears():
+    yield
+    try:
+        with open("/proc/self/maps") as f:
+            n = sum(1 for _ in f)
+    except OSError:
+        return
+    if n > 40_000:
+        jax.clear_caches()
