@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-tpu bench serve lint
+.PHONY: test test-tpu bench bench-tpu perf-table serve lint
 
 test:
 	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
@@ -12,6 +12,19 @@ test-tpu:
 
 bench:
 	$(PY) bench.py
+
+# One command to refresh TPU perf records the moment the chip is alive:
+# runs the full ladder + churn on the default (TPU) backend, saves the
+# JSON line as a dated local record, and regenerates the README table.
+bench-tpu:
+	$(PY) bench.py --budget 2400 2>bench_tpu.log | tail -1 \
+	  > BENCH_local_tpu_$$(date +%Y%m%d).json
+	@grep -q '"platform": "tpu"' BENCH_local_tpu_$$(date +%Y%m%d).json \
+	  || echo "WARNING: record is not from the TPU backend (chip wedged?)"
+	$(PY) tools/perf_table.py --update
+
+perf-table:
+	$(PY) tools/perf_table.py --update
 
 serve:
 	$(PY) -m ksim_tpu.cmd.simulator
