@@ -835,6 +835,31 @@ class SchedulerService:
                 return False
         return True
 
+    @staticmethod
+    def _host_hooks(sp, hook_attr: str):
+        """(hook, before, after) for one host extension point: the
+        plugin's own ``hook_attr`` method plus the extender pair named
+        ``before_<hook_attr>`` / ``after_<hook_attr>`` (PluginExtender
+        host fields — the reference's Before/After extender interfaces,
+        wrappedplugin.go:47-171).  All None when nothing is implemented."""
+        hook = getattr(sp.plugin, hook_attr, None)
+        ext = getattr(sp, "extender", None)
+        before = getattr(ext, f"before_{hook_attr}", None) if ext else None
+        after = getattr(ext, f"after_{hook_attr}", None) if ext else None
+        return hook, before, after
+
+    @staticmethod
+    def _call_hook(point: str, name: str, fn, *args):
+        """Run one hook under the shared error contract: an exception is
+        logged and maps to the point's error status string (upstream
+        converts plugin panics to Error statuses).  Returns
+        (value, error_message) — exactly one is meaningful."""
+        try:
+            return fn(*args), None
+        except Exception as e:
+            logger.exception("%s hook of plugin %s failed", point, name)
+            return None, f"{point} error: {e}"
+
     def _run_post_filter(self, pod, feats, plugins, res, j, prof=None):
         """The PostFilter chain: DefaultPreemption (structural) first in
         its default-config position, then out-of-tree ``post_filter``
@@ -858,10 +883,7 @@ class SchedulerService:
         for sp in plugins:
             if not getattr(sp, "postfilter_enabled", False):
                 continue
-            hook = getattr(sp.plugin, "post_filter", None)
-            ext = getattr(sp, "extender", None)
-            before = getattr(ext, "before_post_filter", None) if ext else None
-            after = getattr(ext, "after_post_filter", None) if ext else None
+            hook, before, after = self._host_hooks(sp, "post_filter")
             if hook is None and before is None and after is None:
                 # plugins_factory-built sets carry default-True flags;
                 # only a real hook makes this a PostFilter plugin.
@@ -871,24 +893,25 @@ class SchedulerService:
             msg = None
             nom = None
             if before is not None:
-                try:
-                    msg = before(pod)
-                except Exception as e:
-                    logger.exception("postfilter extender %s failed", name)
-                    msg = f"postfilter extender error: {e}"
+                msg, err = self._call_hook("postfilter extender", name, before, pod)
+                msg = err if err is not None else msg
             if msg is None:
                 if hook is not None:
-                    try:
-                        nom = hook(pod, list(failed_nodes))
-                    except Exception:
-                        logger.exception("postfilter plugin %s failed", name)
-                        nom = None
+                    nom, _err = self._call_hook(
+                        "postfilter", name, hook, pod, list(failed_nodes)
+                    )
                 if after is not None:
-                    try:
-                        nom, msg = after(pod, nom, msg)
-                    except Exception:
-                        logger.exception("postfilter extender %s failed", name)
-                        nom = None
+                    pair, err = self._call_hook(
+                        "postfilter extender", name, after, pod, nom, msg
+                    )
+                    if err is not None or not (
+                        isinstance(pair, tuple) and len(pair) == 2
+                    ):
+                        nom, msg = None, err or (
+                            f"postfilter extender {name} returned {pair!r}"
+                        )
+                    else:
+                        nom, msg = pair
             if nom is not None and nom in set(failed_nodes):
                 from ksim_tpu.scheduler.preemption import NOMINATED_MESSAGE
 
@@ -904,12 +927,11 @@ class SchedulerService:
         """Out-of-tree PreBind hooks (upstream RunPreBindPlugins stops at
         the first failure; a failure fails the scheduling cycle).
         Returns ({plugin: success-or-message}, failed)."""
+        from ksim_tpu.engine.annotations import SUCCESS_MESSAGE
+
         extra: dict[str, str] = {}
         for sp in plugins:
-            hook = getattr(sp.plugin, "pre_bind", None)
-            ext = getattr(sp, "extender", None)
-            before = getattr(ext, "before_pre_bind", None) if ext else None
-            after = getattr(ext, "after_pre_bind", None) if ext else None
+            hook, before, after = self._host_hooks(sp, "pre_bind")
             if hook is None and before is None and after is None:
                 continue
             if not getattr(sp, "prebind_enabled", True):
@@ -917,25 +939,16 @@ class SchedulerService:
             name = sp.plugin.name
             msg = None
             if before is not None:
-                try:
-                    msg = before(pod, node_name)
-                except Exception as e:
-                    logger.exception("prebind extender %s failed", name)
-                    msg = f"prebind extender error: {e}"
+                msg, err = self._call_hook("prebind extender", name, before, pod, node_name)
+                msg = err if err is not None else msg
             if msg is None and hook is not None:
-                try:
-                    msg = hook(pod, node_name)
-                except Exception as e:
-                    logger.exception("prebind plugin %s failed", name)
-                    msg = f"prebind plugin error: {e}"
+                msg, err = self._call_hook("prebind plugin", name, hook, pod, node_name)
+                msg = err if err is not None else msg
             if after is not None:
-                try:
-                    msg = after(pod, node_name, msg)
-                except Exception as e:
-                    logger.exception("prebind extender %s failed", name)
-                    msg = f"prebind extender error: {e}"
-            from ksim_tpu.engine.annotations import SUCCESS_MESSAGE
-
+                out, err = self._call_hook(
+                    "prebind extender", name, after, pod, node_name, msg
+                )
+                msg = err if err is not None else out
             extra[name] = SUCCESS_MESSAGE if msg is None else str(msg)
             if msg is not None:
                 return extra, True
@@ -955,30 +968,20 @@ class SchedulerService:
         for sp in plugins:
             if not getattr(sp, "bind_enabled", False):
                 continue
-            hook = getattr(sp.plugin, "bind", None)
-            ext = getattr(sp, "extender", None)
-            before = getattr(ext, "before_bind", None) if ext else None
-            after = getattr(ext, "after_bind", None) if ext else None
+            hook, before, after = self._host_hooks(sp, "bind")
             name = sp.plugin.name
             outcome = None
             if before is not None:
-                try:
-                    outcome = before(pod, node_name)
-                except Exception as e:
-                    logger.exception("bind extender %s failed", name)
-                    outcome = f"bind extender error: {e}"
+                outcome, err = self._call_hook("bind extender", name, before, pod, node_name)
+                outcome = err if err is not None else outcome
             if outcome is None and hook is not None:
-                try:
-                    outcome = hook(pod, node_name)
-                except Exception as e:
-                    logger.exception("bind plugin %s failed", name)
-                    outcome = f"bind plugin error: {e}"
+                outcome, err = self._call_hook("bind plugin", name, hook, pod, node_name)
+                outcome = err if err is not None else outcome
             if after is not None:
-                try:
-                    outcome = after(pod, node_name, outcome)
-                except Exception as e:
-                    logger.exception("bind extender %s failed", name)
-                    outcome = f"bind extender error: {e}"
+                out, err = self._call_hook(
+                    "bind extender", name, after, pod, node_name, outcome
+                )
+                outcome = err if err is not None else out
             if outcome is None:
                 continue  # Skip: next bind plugin
             if outcome is True:
@@ -996,32 +999,21 @@ class SchedulerService:
         for sp in plugins:
             if not getattr(sp, "postbind_enabled", False):
                 continue
-            hook = getattr(sp.plugin, "post_bind", None)
-            ext = getattr(sp, "extender", None)
-            before = getattr(ext, "before_post_bind", None) if ext else None
-            after = getattr(ext, "after_post_bind", None) if ext else None
+            hook, before, after = self._host_hooks(sp, "post_bind")
             name = sp.plugin.name
             if before is not None:
-                try:
-                    if before(pod, node_name) is not None:
-                        logger.warning(
-                            "postbind extender %s blocked the original hook",
-                            name,
-                        )
-                        continue
-                except Exception:
-                    logger.exception("postbind extender %s failed", name)
+                msg, err = self._call_hook("postbind extender", name, before, pod, node_name)
+                if msg is not None or err is not None:
+                    # Non-success BeforePostBind skips the original hook
+                    # silently (wrappedplugin.go:728-738).
+                    logger.warning(
+                        "postbind extender %s blocked the original hook", name
+                    )
                     continue
             if hook is not None:
-                try:
-                    hook(pod, node_name)
-                except Exception:
-                    logger.exception("postbind plugin %s failed", name)
+                self._call_hook("postbind plugin", name, hook, pod, node_name)
             if after is not None:
-                try:
-                    after(pod, node_name)
-                except Exception:
-                    logger.exception("postbind extender %s failed", name)
+                self._call_hook("postbind extender", name, after, pod, node_name)
 
     # -- Permit (upstream RunPermitPlugins + waitingPodsMap) ----------------
 
@@ -1041,10 +1033,7 @@ class SchedulerService:
         deadlines: dict[str, float] = {}
         verdict = SUCCESS
         for sp in plugins:
-            hook = getattr(sp.plugin, "permit", None)
-            ext = getattr(sp, "extender", None)
-            before = getattr(ext, "before_permit", None) if ext else None
-            after = getattr(ext, "after_permit", None) if ext else None
+            hook, before, after = self._host_hooks(sp, "permit")
             if (hook is None and before is None and after is None) or not getattr(
                 sp, "permit_enabled", True
             ):
@@ -1055,31 +1044,27 @@ class SchedulerService:
                 # A non-success BeforePermit skips the original hook and
                 # becomes the point's status (extender iface semantics,
                 # wrappedplugin.go:47-171).
-                try:
-                    msg = before(pod, node_name)
-                except Exception as e:
-                    logger.exception("permit extender %s failed", name)
-                    msg = f"permit extender error: {e}"
+                msg, err = self._call_hook("permit extender", name, before, pod, node_name)
+                msg = err if err is not None else msg
                 if msg is not None:
                     result = PermitResult.reject(str(msg))
             if result is None:
                 if hook is not None:
-                    try:
-                        result = hook(pod, node_name)
-                    except Exception as e:  # an erroring plugin rejects (upstream Error status)
-                        logger.exception("permit plugin %s failed", name)
-                        result = PermitResult.reject(f"permit plugin error: {e}")
+                    # An erroring plugin rejects (upstream Error status).
+                    result, err = self._call_hook("permit plugin", name, hook, pod, node_name)
+                    if err is not None:
+                        result = PermitResult.reject(err)
                 else:
                     # Extender-only entry: a nil original permit succeeds
                     # (the wrapped plugin returns success when the
                     # original is absent).
                     result = PermitResult.allow()
                 if after is not None:
-                    try:
-                        result = after(pod, node_name, result)
-                    except Exception as e:
-                        logger.exception("permit extender %s failed", name)
-                        result = PermitResult.reject(f"permit extender error: {e}")
+                    result, err = self._call_hook(
+                        "permit extender", name, after, pod, node_name, result
+                    )
+                    if err is not None:
+                        result = PermitResult.reject(err)
             if not isinstance(result, PermitResult):
                 result = PermitResult.reject(f"permit plugin {name} returned {result!r}")
             # Recorded message: success/wait keywords, otherwise the
