@@ -182,6 +182,7 @@ def render_pod_results(
     postfilter: dict | None = None,
     permit: tuple[dict, dict] | None = None,
     bound: bool = True,
+    reserve_extra: dict | None = None,
     prebind_extra: dict | None = None,
     bind_map: dict | None = None,
     ctx: "RenderCtx | None" = None,
@@ -196,10 +197,11 @@ def render_pod_results(
     Bind (a Permit rejection): selected-node and reserve-result stay
     recorded — upstream wrote them at Reserve — while prebind/bind maps
     stay empty because those wrappers never ran.
-    ``prebind_extra`` merges out-of-tree PreBind hook results into the
-    prebind map; ``bind_map`` overrides the bind-result map when a
-    custom binder handled (or failed) the bind (wrappedplugin.go:699-726
-    AddBindResult records under the actual binder's name).
+    ``reserve_extra``/``prebind_extra`` merge out-of-tree Reserve and
+    PreBind hook results into their maps; ``bind_map`` overrides the
+    bind-result map when a custom binder handled (or failed) the bind
+    (wrappedplugin.go:699-726 AddBindResult records under the actual
+    binder's name).
     Pass a shared ``ctx`` when rendering many pods of one pass."""
     if res.reason_bits is None:
         raise ValueError("render_pod_results needs record='full' results")
@@ -300,6 +302,8 @@ def render_pod_results(
         }
 
     reserve_map = _point_map("reserve_enabled")
+    if reserve_extra and selected >= 0:
+        reserve_map = {**reserve_map, **reserve_extra}
     prebind_map = _point_map("prebind_enabled", ran=bound)
     if prebind_extra and selected >= 0:
         prebind_map = {**prebind_map, **prebind_extra}

@@ -69,7 +69,17 @@ class PluginExtender:
     - before_filter(state, pod, aux) -> (state, pod): rewrite inputs;
     - after_filter(state, pod, aux, out: FilterOutput) -> FilterOutput;
     - before_score(state, pod, aux) -> (state, pod);
-    - after_score(state, pod, aux, scores) -> scores (pre-normalize).
+    - after_score(state, pod, aux, scores) -> scores (pre-normalize);
+    - before_normalize(state, pod, aux, raw, ok) -> raw;
+      after_normalize(state, pod, aux, normalized, ok) -> normalized
+      (the NormalizeScore extender pair, wrappedplugin.go:388-418;
+      weight applies after).
+
+    The reference's PreFilter/PreScore extenders have no separate hooks
+    here by design: those upstream points precompute per-cycle state
+    that this architecture folds into the featurizer and the fused
+    filter/score kernels, so before_filter/before_score are their
+    extension seams (they see the same batched inputs the kernels do).
 
     Host-side hooks (plain Python over pod JSON, run by the scheduler
     service around the corresponding host extension points — the
@@ -82,6 +92,11 @@ class PluginExtender:
 
     - before_post_filter(pod) -> str | None;
       after_post_filter(pod, nominated, msg) -> (nominated, msg);
+    - before_reserve(pod, node) -> str | None;
+      after_reserve(pod, node, msg) -> str | None;
+    - before_unreserve(pod, node) -> str | None (non-None skips the
+      original unreserve, like BeforePostBind);
+      after_unreserve(pod, node) -> None;
     - before_permit(pod, node) -> str | None;
       after_permit(pod, node, result) -> result (a PermitResult);
     - before_pre_bind(pod, node) -> str | None;
@@ -99,8 +114,14 @@ class PluginExtender:
     after_filter: Any = None
     before_score: Any = None
     after_score: Any = None
+    before_normalize: Any = None
+    after_normalize: Any = None
     before_post_filter: Any = None
     after_post_filter: Any = None
+    before_reserve: Any = None
+    after_reserve: Any = None
+    before_unreserve: Any = None
+    after_unreserve: Any = None
     before_permit: Any = None
     after_permit: Any = None
     before_pre_bind: Any = None
@@ -354,16 +375,25 @@ def _final_from_raw(
     pod=None,
     aux=None,
     kw=None,
+    ext=None,
 ) -> jnp.ndarray:
     """normalize (if the plugin defines it) then apply weight — the
     reference's applyWeightOnScore (resultstore/store.go:504-507).
     Plugins declaring ``normalize_needs_ctx = True`` get the evaluation
-    context (PodTopologySpread's normalize depends on the pod)."""
+    context (PodTopologySpread's normalize depends on the pod).  The
+    extender's before/after_normalize hooks wrap the plugin's normalize
+    (the reference's NormalizeScorePluginExtender,
+    wrappedplugin.go:388-418): before may rewrite the raw scores, after
+    the normalized ones — both jax-traceable, pre-weight."""
+    if ext is not None and ext.before_normalize is not None:
+        raw = ext.before_normalize(state, pod, aux, raw, ok)
     if hasattr(plugin, "normalize"):
         if getattr(plugin, "normalize_needs_ctx", False):
             raw = plugin.normalize(raw, ok, state=state, pod=pod, aux=aux, **(kw or {}))
         else:
             raw = plugin.normalize(raw, ok)
+    if ext is not None and ext.after_normalize is not None:
+        raw = ext.after_normalize(state, pod, aux, raw, ok)
     return raw * weight
 
 
@@ -447,7 +477,10 @@ class _Program:
             raw = sp.plugin.score(s_state, s_pod, aux, ok=filter_ok, **kw)
             if ext is not None and ext.after_score is not None:
                 raw = ext.after_score(s_state, s_pod, aux, raw)
-            final = _final_from_raw(sp.plugin, raw, filter_ok, sp.weight, s_state, s_pod, aux, kw)
+            final = _final_from_raw(
+                sp.plugin, raw, filter_ok, sp.weight, s_state, s_pod, aux, kw,
+                ext=ext,
+            )
             raw_scores.append(raw)
             final_scores.append(final)
             total = total + final.astype(jnp.int32)

@@ -416,14 +416,20 @@ class CompiledProfile:
             score_on = sp.score_enabled and name not in self.score_disabled
 
             def host_on(hook: str, disabled: frozenset, point: str) -> bool:
-                ext = getattr(sp.extender, hook, None) if sp.extender else None
-                has = hasattr(sp.plugin, hook) or ext is not None
-                on = has and name not in disabled
+                ext = sp.extender
+                has_ext = ext is not None and (
+                    getattr(ext, f"before_{hook}", None) is not None
+                    or getattr(ext, f"after_{hook}", None) is not None
+                )
+                on = (hasattr(sp.plugin, hook) or has_ext) and name not in disabled
                 if name in self.point_only:
                     on = on and point in self.point_only[name]
                 return on
 
             permit_on = host_on("permit", self.permit_disabled, "permit")
+            reserve_host = host_on(
+                "reserve", self.reserve_disabled, "reserve"
+            ) or host_on("unreserve", self.reserve_disabled, "reserve")
             postfilter_on = host_on(
                 "post_filter", self.postfilter_disabled, "postFilter"
             )
@@ -450,6 +456,7 @@ class CompiledProfile:
                 filter_on
                 or score_on
                 or permit_on
+                or reserve_host
                 or postfilter_on
                 or prebind_host
                 or bind_on

@@ -624,19 +624,29 @@ class SchedulerService:
                 nominated, victims, postfilter = self._run_post_filter(
                     pod, feats, plugins, res, 0, prof=prof
                 )
-            # Permit runs post-selection on this path too (upstream's
-            # cycle is identical with or without extenders).
+            # Reserve -> Permit -> PreBind/Bind on this path too
+            # (upstream's cycle is identical with or without extenders).
+            reserve_extra: dict[str, str] = {}
+            reserve_failed = False
+            if selected is not None:
+                reserve_extra, reserve_failed = self._run_reserve(
+                    plugins, pod, selected
+                )
+                if reserve_failed:
+                    self._run_unreserve(plugins, pod, selected)
             permit_maps = None
             permit_verdict = SUCCESS
             wait_deadlines: dict[str, float] = {}
-            if selected is not None:
+            if selected is not None and not reserve_failed:
                 permit_verdict, permit_maps, wait_deadlines = self._run_permit(
                     plugins, pod, selected
                 )
+                if permit_verdict == REJECT:
+                    self._run_unreserve(plugins, pod, selected)
             prebind_extra: dict[str, str] = {}
             bind_map = None
-            bind_ok = True
-            if selected is not None and permit_verdict == SUCCESS:
+            bind_ok = not reserve_failed
+            if selected is not None and not reserve_failed and permit_verdict == SUCCESS:
                 prebind_extra, prebind_failed = self._run_pre_bind(
                     plugins, pod, selected
                 )
@@ -647,6 +657,8 @@ class SchedulerService:
                     bind_map, bind_ok = self._run_bind(
                         plugins, pod, selected, prof=prof
                     )
+                if not bind_ok:
+                    self._run_unreserve(plugins, pod, selected)
             anno = render_pod_results(
                 feats,
                 plugins,
@@ -655,13 +667,15 @@ class SchedulerService:
                 postfilter=postfilter,
                 permit=permit_maps,
                 bound=permit_verdict != REJECT and bind_ok,
+                reserve_extra=reserve_extra,
                 prebind_extra=prebind_extra,
                 bind_map=bind_map,
             )
             anno.update(self._extenders.store.get_stored_result(pod))
+            selected_settle = None if reserve_failed else selected
             selected, parked = self._settle_permit(
-                pod, selected, permit_verdict, wait_deadlines, anno, placements,
-                plugins=plugins, prof=prof,
+                pod, selected_settle, permit_verdict, wait_deadlines, anno,
+                placements, plugins=plugins, prof=prof,
             )
             if parked:
                 self._extenders.store.delete_data(pod)
@@ -713,22 +727,35 @@ class SchedulerService:
                 nominated, victims, postfilter = self._run_post_filter(
                     pod, feats, plugins, res, j, prof=prof
                 )
+            # Reserve runs first on a selected node (upstream cycle
+            # order: Reserve -> Permit -> WaitOnPermit -> PreBind ->
+            # Bind); its failure unreserves and fails the cycle.
+            reserve_extra: dict[str, str] = {}
+            reserve_failed = False
+            if node_name is not None:
+                reserve_extra, reserve_failed = self._run_reserve(
+                    plugins, pod, node_name
+                )
+                if reserve_failed:
+                    self._run_unreserve(plugins, pod, node_name)
             # Permit runs after selection (upstream RunPermitPlugins is
             # post-Reserve, wrappedplugin.go:582-611).
             permit_maps = None
             permit_verdict = SUCCESS
             wait_deadlines: dict[str, float] = {}
-            if node_name is not None:
+            if node_name is not None and not reserve_failed:
                 permit_verdict, permit_maps, wait_deadlines = self._run_permit(
                     plugins, pod, node_name
                 )
+                if permit_verdict == REJECT:
+                    self._run_unreserve(plugins, pod, node_name)
             # PreBind/Bind chains (upstream: post-WaitOnPermit; for
             # permit-parked pods they run at allow time instead,
             # _finalize_waiting).
             prebind_extra: dict[str, str] = {}
             bind_map = None
-            bind_ok = True
-            if node_name is not None and permit_verdict == SUCCESS:
+            bind_ok = not reserve_failed
+            if node_name is not None and not reserve_failed and permit_verdict == SUCCESS:
                 prebind_extra, prebind_failed = self._run_pre_bind(
                     plugins, pod, node_name
                 )
@@ -739,6 +766,8 @@ class SchedulerService:
                     bind_map, bind_ok = self._run_bind(
                         plugins, pod, node_name, prof=prof
                     )
+                if not bind_ok:
+                    self._run_unreserve(plugins, pod, node_name)
             anno = (
                 render_pod_results(
                     feats,
@@ -748,6 +777,7 @@ class SchedulerService:
                     postfilter=postfilter,
                     permit=permit_maps,
                     bound=permit_verdict != REJECT and bind_ok,
+                    reserve_extra=reserve_extra,
                     prebind_extra=prebind_extra,
                     bind_map=bind_map,
                     ctx=render_ctx,
@@ -755,16 +785,17 @@ class SchedulerService:
                 if self._record == "full"
                 else {}
             )
+            node_name_settle = None if reserve_failed else node_name
             node_name, parked = self._settle_permit(
-                pod, node_name, permit_verdict, wait_deadlines, anno, placements,
-                plugins=plugins, prof=prof,
+                pod, node_name_settle, permit_verdict, wait_deadlines, anno,
+                placements, plugins=plugins, prof=prof,
             )
             if parked:
                 continue
             if not bind_ok:
-                # A PreBind/Bind failure fails the cycle: the pod stays
-                # pending (upstream unreserves and requeues), the attempt
-                # is recorded.
+                # A Reserve/PreBind/Bind failure fails the cycle: the pod
+                # stays pending (upstream unreserves and requeues), the
+                # attempt is recorded.
                 node_name = None
 
             def rebuild(obj: JSON) -> JSON:
@@ -922,6 +953,63 @@ class SchedulerService:
         if post is None and ran_custom:
             post = {n: {} for n in failed_nodes}
         return nominated, victims, post
+
+    def _run_reserve(self, plugins, pod: JSON, node_name: str):
+        """The Reserve chain (upstream RunReservePlugins: plugins in
+        order; the first failure fails the cycle and triggers Unreserve;
+        wrappedplugin.go:616-648 records per-plugin results — the
+        wrapper also records the selected node there, which this
+        codebase does via the selected-node annotation).  Returns
+        ({plugin: success-or-message}, failed)."""
+        from ksim_tpu.engine.annotations import SUCCESS_MESSAGE
+
+        extra: dict[str, str] = {}
+        for sp in plugins:
+            hook, before, after = self._host_hooks(sp, "reserve")
+            if hook is None and before is None and after is None:
+                continue
+            if not getattr(sp, "reserve_enabled", True):
+                continue
+            name = sp.plugin.name
+            msg = None
+            if before is not None:
+                msg, err = self._call_hook("reserve extender", name, before, pod, node_name)
+                msg = err if err is not None else msg
+            if msg is None and hook is not None:
+                msg, err = self._call_hook("reserve plugin", name, hook, pod, node_name)
+                msg = err if err is not None else msg
+            if after is not None:
+                out, err = self._call_hook(
+                    "reserve extender", name, after, pod, node_name, msg
+                )
+                msg = err if err is not None else out
+            extra[name] = SUCCESS_MESSAGE if msg is None else str(msg)
+            if msg is not None:
+                return extra, True
+        return extra, False
+
+    def _run_unreserve(self, plugins, pod: JSON, node_name: str) -> None:
+        """Unreserve in REVERSE order (upstream RunReservePlugins'
+        failure path and every post-Reserve failure; void, errors
+        logged; wrappedplugin.go:650-668).  A non-None BeforeUnreserve
+        skips the original hook, like BeforePostBind."""
+        for sp in reversed(list(plugins)):
+            hook, before, after = self._host_hooks(sp, "unreserve")
+            if hook is None and before is None and after is None:
+                continue
+            if not getattr(sp, "reserve_enabled", True):
+                continue
+            name = sp.plugin.name
+            if before is not None:
+                msg, err = self._call_hook(
+                    "unreserve extender", name, before, pod, node_name
+                )
+                if msg is not None or err is not None:
+                    continue
+            if hook is not None:
+                self._call_hook("unreserve plugin", name, hook, pod, node_name)
+            if after is not None:
+                self._call_hook("unreserve extender", name, after, pod, node_name)
 
     def _run_pre_bind(self, plugins, pod: JSON, node_name: str):
         """Out-of-tree PreBind hooks (upstream RunPreBindPlugins stops at
@@ -1260,18 +1348,19 @@ class SchedulerService:
 
         anno = dict(wp.anno)
         chains_recorded = False
+        # The real pod object for the hook chains (both the bind-time
+        # PreBind/Bind run and any failure path's Unreserve — hooks key
+        # reservations on uid/spec, not just the name).
+        pod_obj = {"metadata": {"name": wp.name, "namespace": wp.namespace}}
+        try:
+            pod_obj = self._store.get("pods", wp.name, wp.namespace)
+        except NotFoundError:
+            pass
         if bind and wp.plugins:
             # The PreBind/Bind chains run now (upstream: after
             # WaitOnPermit returns success), with the pass's plugin set.
             import json as _json
 
-            pod_obj = {
-                "metadata": {"name": wp.name, "namespace": wp.namespace}
-            }
-            try:
-                pod_obj = self._store.get("pods", wp.name, wp.namespace)
-            except NotFoundError:
-                pass
             prebind_extra, prebind_failed = self._run_pre_bind(
                 wp.plugins, pod_obj, wp.node_name
             )
@@ -1304,6 +1393,10 @@ class SchedulerService:
             # Bind/PreBind never ran for a rejected waiter.
             anno[BIND_RESULT_KEY] = _marshal({})
             anno[PRE_BIND_RESULT_KEY] = _marshal({})
+        if not bind and wp.plugins:
+            # Any post-Reserve failure unreserves (upstream Unreserve on
+            # permit rejection/timeout and bind failures alike).
+            self._run_unreserve(wp.plugins, pod_obj, wp.node_name)
 
         def rebuild(obj: JSON) -> JSON:
             new = dict(obj)

@@ -455,3 +455,199 @@ def test_point_only_plugin_does_not_run_hooks_at_other_points():
     )
     assert svc.schedule_pending() == {"default/p1": "n1"}
     assert calls == []
+
+
+def test_reserve_and_unreserve_hooks():
+    """Reserve runs before Permit on the selected node; a Reserve failure
+    unreserves (reverse order) and fails the cycle with the message
+    recorded (upstream RunReservePlugins, wrappedplugin.go:616-668)."""
+    from ksim_tpu.engine.annotations import RESERVE_RESULT_KEY
+
+    events = []
+
+    def build_ok(feats, args):
+        return ScoredPlugin(
+            _marker(
+                "Claimer",
+                reserve=staticmethod(
+                    lambda pod, node: events.append(("reserve", node)) and None
+                ),
+                unreserve=staticmethod(
+                    lambda pod, node: events.append(("unreserve", node))
+                ),
+            ),
+            filter_enabled=False,
+            score_enabled=False,
+        )
+
+    store = _store(("nodes", make_node("n1")), ("pods", make_pod("p1")))
+    svc = SchedulerService(
+        store,
+        config={
+            "profiles": [
+                {"plugins": {"reserve": {"enabled": [{"name": "Claimer"}]}}}
+            ]
+        },
+        registry={"Claimer": build_ok},
+    )
+    assert svc.schedule_pending() == {"default/p1": "n1"}
+    assert events == [("reserve", "n1")]  # success: no unreserve
+    pod = store.get("pods", "p1")
+    reserve = json.loads(pod["metadata"]["annotations"][RESERVE_RESULT_KEY])
+    assert reserve["Claimer"] == "success"
+
+    # Failure path: reserve rejects -> unreserve runs, pod stays pending.
+    events.clear()
+
+    def build_fail(feats, args):
+        return ScoredPlugin(
+            _marker(
+                "Claimer",
+                reserve=staticmethod(lambda pod, node: "quota exhausted"),
+                unreserve=staticmethod(
+                    lambda pod, node: events.append(("unreserve", node))
+                ),
+            ),
+            filter_enabled=False,
+            score_enabled=False,
+        )
+
+    store2 = _store(("nodes", make_node("n1")), ("pods", make_pod("p2")))
+    svc2 = SchedulerService(
+        store2,
+        config={
+            "profiles": [
+                {"plugins": {"reserve": {"enabled": [{"name": "Claimer"}]}}}
+            ]
+        },
+        registry={"Claimer": build_fail},
+    )
+    assert svc2.schedule_pending() == {"default/p2": None}
+    assert events == [("unreserve", "n1")]
+    pod2 = store2.get("pods", "p2")
+    assert not pod2.get("spec", {}).get("nodeName")
+    reserve2 = json.loads(pod2["metadata"]["annotations"][RESERVE_RESULT_KEY])
+    assert reserve2["Claimer"] == "quota exhausted"
+
+
+def test_unreserve_runs_on_permit_rejection():
+    from ksim_tpu.scheduler.permit import PermitResult
+
+    events = []
+
+    def build(feats, args):
+        return ScoredPlugin(
+            _marker(
+                "Guard",
+                reserve=staticmethod(lambda pod, node: None),
+                unreserve=staticmethod(
+                    lambda pod, node: events.append("unreserve")
+                ),
+                permit=staticmethod(
+                    lambda pod, node: PermitResult.reject("not today")
+                ),
+            ),
+            filter_enabled=False,
+            score_enabled=False,
+        )
+
+    store = _store(("nodes", make_node("n1")), ("pods", make_pod("p1")))
+    svc = SchedulerService(
+        store,
+        config={
+            "profiles": [
+                {
+                    "plugins": {
+                        "reserve": {"enabled": [{"name": "Guard"}]},
+                        "permit": {"enabled": [{"name": "Guard"}]},
+                    }
+                }
+            ]
+        },
+        registry={"Guard": build},
+    )
+    assert svc.schedule_pending() == {"default/p1": None}
+    assert events == ["unreserve"]
+
+
+def test_normalize_extender_rescales_scores():
+    """The NormalizeScore extender pair wraps a plugin's normalize
+    inside the compiled program (wrappedplugin.go:388-418): after_
+    normalize halves NodeAffinity's normalized scores before weighting."""
+    import jax.numpy as jnp
+
+    from ksim_tpu.engine import Engine
+    from ksim_tpu.engine.profiles import default_plugins
+    from ksim_tpu.state.featurizer import Featurizer
+
+    nodes = [
+        make_node("n-a", labels={"zone": "a"}),
+        make_node("n-b", labels={"zone": "b"}),
+    ]
+    pod = make_pod(
+        "p",
+        affinity={
+            "nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": 10,
+                        "preference": {
+                            "matchExpressions": [
+                                {"key": "zone", "operator": "In", "values": ["a"]}
+                            ]
+                        },
+                    }
+                ]
+            }
+        },
+    )
+    feats = Featurizer().featurize(nodes, [], queue_pods=[pod])
+    ext = PluginExtender(
+        after_normalize=lambda state, p, aux, norm, ok: norm // 2
+    )
+    base = default_plugins(feats)
+    wrapped = tuple(
+        ScoredPlugin(
+            sp.plugin, weight=sp.weight, filter_enabled=sp.filter_enabled,
+            score_enabled=sp.score_enabled,
+            extender=ext if sp.plugin.name == "NodeAffinity" else sp.extender,
+        )
+        for sp in base
+    )
+    plain = Engine(feats, base, record="full").evaluate_batch()
+    halved = Engine(feats, wrapped, record="full").evaluate_batch()
+    si = plain.plugin_names.index("NodeAffinity")
+    # Normalized score on n-a is 100 (weight 2 -> final 200); halved -> 50*2.
+    assert int(plain.final_scores[0, si, 0]) == 200
+    assert int(halved.final_scores[0, si, 0]) == 100
+
+
+def test_extender_only_host_plugin_is_retained():
+    """A plugin whose only host surface is an extender pair (no method
+    on the plugin object) must stay in the compiled plugin set — the
+    wrapped plugin always exists upstream and the extender runs around
+    the nil original."""
+    calls = []
+
+    def build(feats, args):
+        return ScoredPlugin(
+            _marker("ExtOnly"),
+            filter_enabled=False,
+            score_enabled=False,
+            extender=PluginExtender(
+                before_permit=lambda pod, node: calls.append(node) and None
+            ),
+        )
+
+    store = _store(("nodes", make_node("n1")), ("pods", make_pod("p1")))
+    svc = SchedulerService(
+        store,
+        config={
+            "profiles": [
+                {"plugins": {"permit": {"enabled": [{"name": "ExtOnly"}]}}}
+            ]
+        },
+        registry={"ExtOnly": build},
+    )
+    assert svc.schedule_pending() == {"default/p1": "n1"}
+    assert calls == ["n1"]  # extender ran around the nil original permit
