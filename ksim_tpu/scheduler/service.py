@@ -954,93 +954,110 @@ class SchedulerService:
             post = {n: {} for n in failed_nodes}
         return nominated, victims, post
 
-    def _run_reserve(self, plugins, pod: JSON, node_name: str):
-        """The Reserve chain (upstream RunReservePlugins: plugins in
-        order; the first failure fails the cycle and triggers Unreserve;
-        wrappedplugin.go:616-648 records per-plugin results — the
-        wrapper also records the selected node there, which this
-        codebase does via the selected-node annotation).  Returns
-        ({plugin: success-or-message}, failed)."""
-        from ksim_tpu.engine.annotations import SUCCESS_MESSAGE
-
-        extra: dict[str, str] = {}
-        for sp in plugins:
-            hook, before, after = self._host_hooks(sp, "reserve")
-            if hook is None and before is None and after is None:
-                continue
-            if not getattr(sp, "reserve_enabled", True):
-                continue
-            name = sp.plugin.name
-            msg = None
-            if before is not None:
-                msg, err = self._call_hook("reserve extender", name, before, pod, node_name)
-                msg = err if err is not None else msg
-            if msg is None and hook is not None:
-                msg, err = self._call_hook("reserve plugin", name, hook, pod, node_name)
-                msg = err if err is not None else msg
-            if after is not None:
-                out, err = self._call_hook(
-                    "reserve extender", name, after, pod, node_name, msg
-                )
-                msg = err if err is not None else out
-            extra[name] = SUCCESS_MESSAGE if msg is None else str(msg)
-            if msg is not None:
-                return extra, True
-        return extra, False
-
-    def _run_unreserve(self, plugins, pod: JSON, node_name: str) -> None:
-        """Unreserve in REVERSE order (upstream RunReservePlugins'
-        failure path and every post-Reserve failure; void, errors
-        logged; wrappedplugin.go:650-668).  A non-None BeforeUnreserve
-        skips the original hook, like BeforePostBind."""
-        for sp in reversed(list(plugins)):
-            hook, before, after = self._host_hooks(sp, "unreserve")
-            if hook is None and before is None and after is None:
-                continue
-            if not getattr(sp, "reserve_enabled", True):
-                continue
-            name = sp.plugin.name
-            if before is not None:
-                msg, err = self._call_hook(
-                    "unreserve extender", name, before, pod, node_name
-                )
-                if msg is not None or err is not None:
-                    continue
-            if hook is not None:
-                self._call_hook("unreserve plugin", name, hook, pod, node_name)
-            if after is not None:
-                self._call_hook("unreserve extender", name, after, pod, node_name)
-
-    def _run_pre_bind(self, plugins, pod: JSON, node_name: str):
-        """Out-of-tree PreBind hooks (upstream RunPreBindPlugins stops at
-        the first failure; a failure fails the scheduling cycle).
+    def _run_status_chain(
+        self,
+        plugins,
+        pod: JSON,
+        node_name: str,
+        *,
+        hook_attr: str,
+        point: str,
+        enabled_attr: str,
+    ):
+        """Shared shape of the Reserve and PreBind chains (upstream runs
+        both in order and stops at the first failure, which fails the
+        cycle): before may short-circuit with a message, the original
+        hook returns a message on failure, after may replace it.
         Returns ({plugin: success-or-message}, failed)."""
         from ksim_tpu.engine.annotations import SUCCESS_MESSAGE
 
         extra: dict[str, str] = {}
         for sp in plugins:
-            hook, before, after = self._host_hooks(sp, "pre_bind")
+            hook, before, after = self._host_hooks(sp, hook_attr)
             if hook is None and before is None and after is None:
                 continue
-            if not getattr(sp, "prebind_enabled", True):
+            if not getattr(sp, enabled_attr, True):
                 continue
             name = sp.plugin.name
             msg = None
             if before is not None:
-                msg, err = self._call_hook("prebind extender", name, before, pod, node_name)
+                msg, err = self._call_hook(f"{point} extender", name, before, pod, node_name)
                 msg = err if err is not None else msg
             if msg is None and hook is not None:
-                msg, err = self._call_hook("prebind plugin", name, hook, pod, node_name)
+                msg, err = self._call_hook(f"{point} plugin", name, hook, pod, node_name)
                 msg = err if err is not None else msg
             if after is not None:
                 out, err = self._call_hook(
-                    "prebind extender", name, after, pod, node_name, msg
+                    f"{point} extender", name, after, pod, node_name, msg
                 )
                 msg = err if err is not None else out
             extra[name] = SUCCESS_MESSAGE if msg is None else str(msg)
             if msg is not None:
                 return extra, True
         return extra, False
+
+    def _run_notify_chain(
+        self,
+        plugins,
+        pod: JSON,
+        node_name: str,
+        *,
+        hook_attr: str,
+        point: str,
+        enabled_attr: str,
+        enabled_default: bool,
+        reverse: bool = False,
+    ) -> None:
+        """Shared shape of the void notification chains (PostBind, and
+        Unreserve which runs in REVERSE order — upstream
+        wrappedplugin.go:650-668, :728-746): a non-None Before skips the
+        original hook; all errors are logged, never propagated."""
+        ordered = reversed(list(plugins)) if reverse else plugins
+        for sp in ordered:
+            if not getattr(sp, enabled_attr, enabled_default):
+                continue
+            hook, before, after = self._host_hooks(sp, hook_attr)
+            if hook is None and before is None and after is None:
+                continue
+            name = sp.plugin.name
+            if before is not None:
+                msg, err = self._call_hook(
+                    f"{point} extender", name, before, pod, node_name
+                )
+                if msg is not None or err is not None:
+                    logger.warning(
+                        "%s extender %s blocked the original hook", point, name
+                    )
+                    continue
+            if hook is not None:
+                self._call_hook(f"{point} plugin", name, hook, pod, node_name)
+            if after is not None:
+                self._call_hook(f"{point} extender", name, after, pod, node_name)
+
+    def _run_reserve(self, plugins, pod: JSON, node_name: str):
+        """The Reserve chain (upstream RunReservePlugins; the wrapper
+        also records the selected node there, wrappedplugin.go:616-648 —
+        this codebase does that via the selected-node annotation)."""
+        return self._run_status_chain(
+            plugins, pod, node_name,
+            hook_attr="reserve", point="reserve", enabled_attr="reserve_enabled",
+        )
+
+    def _run_unreserve(self, plugins, pod: JSON, node_name: str) -> None:
+        """Unreserve on every post-Reserve failure (wrappedplugin.go:650-668)."""
+        self._run_notify_chain(
+            plugins, pod, node_name,
+            hook_attr="unreserve", point="unreserve",
+            enabled_attr="reserve_enabled", enabled_default=True, reverse=True,
+        )
+
+    def _run_pre_bind(self, plugins, pod: JSON, node_name: str):
+        """Out-of-tree PreBind hooks (upstream RunPreBindPlugins stops at
+        the first failure; a failure fails the scheduling cycle)."""
+        return self._run_status_chain(
+            plugins, pod, node_name,
+            hook_attr="pre_bind", point="prebind", enabled_attr="prebind_enabled",
+        )
 
     def _run_bind(self, plugins, pod: JSON, node_name: str, prof=None):
         """The Bind chain (upstream RunBindPlugins: plugins in order; Skip
@@ -1084,24 +1101,11 @@ class SchedulerService:
         """PostBind notifications after a successful bind (upstream
         RunPostBindPlugins is void; wrappedplugin.go:728-746 — a
         non-success BeforePostBind skips the original hook)."""
-        for sp in plugins:
-            if not getattr(sp, "postbind_enabled", False):
-                continue
-            hook, before, after = self._host_hooks(sp, "post_bind")
-            name = sp.plugin.name
-            if before is not None:
-                msg, err = self._call_hook("postbind extender", name, before, pod, node_name)
-                if msg is not None or err is not None:
-                    # Non-success BeforePostBind skips the original hook
-                    # silently (wrappedplugin.go:728-738).
-                    logger.warning(
-                        "postbind extender %s blocked the original hook", name
-                    )
-                    continue
-            if hook is not None:
-                self._call_hook("postbind plugin", name, hook, pod, node_name)
-            if after is not None:
-                self._call_hook("postbind extender", name, after, pod, node_name)
+        self._run_notify_chain(
+            plugins, pod, node_name,
+            hook_attr="post_bind", point="postbind",
+            enabled_attr="postbind_enabled", enabled_default=False,
+        )
 
     # -- Permit (upstream RunPermitPlugins + waitingPodsMap) ----------------
 

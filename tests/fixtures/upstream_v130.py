@@ -435,6 +435,23 @@ RTCR_CASES = [
         "weights": (("cpu", 2), ("memory", 1)),
         "want": 67,
     },
+    {
+        # Three-point shape exercises the MIDDLE segment: (0,0),(50,10),
+        # (100,5) scaled (0,0),(50,100),(100,50).
+        # cpu p = 75 -> segment (50,100]: 100 + (50-100)*(75-50)/(100-50)
+        #   = 100 + trunc(-1250/50) = 100 - 25 = 75
+        # mem p = 50 -> first i with p <= u_i is i=1:
+        #   0 + (100-0)*(50-0)/(50-0) = 100
+        # round((75 + 100) / 2) = round(87.5) = 88
+        "name": "three-point-middle-segment",
+        "shape": ((0, 0), (50, 10), (100, 5)),
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "weights": (("cpu", 1), ("memory", 1)),
+        "want": 88,
+    },
 ]
 
 # ---------------------------------------------------------------------------
@@ -502,3 +519,54 @@ ADDED_AFFINITY_SCORE_EXPECT = {"n-a": 100, "n-b": 33}
 # ---------------------------------------------------------------------------
 
 EBS_LIMIT_REASON = "node(s) exceed max volume count"
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread per-constraint policies (v1.30 common.go/filtering.go):
+#
+# - nodeAffinityPolicy (default Honor): Honor excludes nodes failing the
+#   POD's nodeSelector/required-affinity from domain counting; Ignore
+#   counts them.
+# - nodeTaintsPolicy (default Ignore): Honor excludes nodes whose taints
+#   the incoming pod does not tolerate.
+# - matchLabelKeys (beta, on): each key folds the incoming pod's own
+#   label value into the selector as an In-requirement.
+# - Filter skew for node n: matchNum(n's domain; 0 when the domain was
+#   excluded) + selfMatch(1 if the pod matches its own selector)
+#   - minMatchNum(over ELIGIBLE domains); violates when > maxSkew.
+#
+# Scenario T (taints policy): zone A node a1 (untainted) runs 2 app=web
+# pods; zone B node b1 carries an intolerable NoSchedule taint and runs
+# none.  Incoming app=web, maxSkew 1, DoNotSchedule over zone.
+#   Ignore (default): min over {A:2, B:0} = 0 -> a1 skew 2+1-0=3 >1
+#     VIOLATES; b1 skew 0+1-0=1 passes.
+#   Honor: B excluded -> min over {A}=2 -> a1 skew 3-2=1 passes;
+#     b1 matchNum 0 -> skew 1-2=-1 passes.
+SPREAD_TAINTS_POLICY_EXPECT = {
+    "Ignore": {"a1": True, "b1": False},   # True = spread VIOLATION
+    "Honor": {"a1": False, "b1": False},
+}
+
+# Scenario N (affinity policy): a1 {zone A, tier frontend} runs 2
+# app=web pods; b1 {zone B} lacks tier.  Incoming has nodeSelector
+# tier=frontend, same constraint.
+#   Honor (default): b1 excluded -> min=2 -> a1 skew 1 passes.
+#   Ignore: min=0 -> a1 skew 3 VIOLATES; b1 passes (its own NodeAffinity
+#     failure is a different plugin's verdict).
+SPREAD_AFFINITY_POLICY_EXPECT = {
+    "Honor": {"a1": False, "b1": False},
+    "Ignore": {"a1": True, "b1": False},
+}
+
+# Scenario M (matchLabelKeys): a1 zone A runs 2 {app web, version v1}
+# pods; b1 zone B runs 1 {app web, version v2}.  Incoming {app web,
+# version v2}, selector app=web, maxSkew 1, DoNotSchedule over zone.
+#   With matchLabelKeys [version]: effective selector app=web AND
+#     version=v2 -> counts A=0, B=1, min=0 -> a1 skew 0+1-0=1 passes;
+#     b1 skew 1+1-0=2 VIOLATES.
+#   Without: counts A=2, B=1, min=1 -> a1 skew 2+1-1=2 VIOLATES;
+#     b1 skew 1+1-1=1 passes.  (Full inversion.)
+SPREAD_MATCH_LABEL_KEYS_EXPECT = {
+    "with": {"a1": False, "b1": True},
+    "without": {"a1": True, "b1": False},
+}

@@ -15,7 +15,8 @@ from ksim_tpu.engine import Engine
 from ksim_tpu.engine.profiles import default_plugins
 from ksim_tpu.plugins import oracle
 from ksim_tpu.state.featurizer import Featurizer
-from tests.helpers import make_node, make_pod
+from tests.fixtures import upstream_v130 as fx
+from tests.helpers import make_node, make_pod, pods_by_node
 
 ZONE_KEY = "topology.kubernetes.io/zone"
 
@@ -792,3 +793,94 @@ def test_single_feasible_node_skips_scoring_fixture():
     assert _json.loads(anno[SCORE_RESULT_KEY]) == {}
     assert _json.loads(anno[FINAL_SCORE_RESULT_KEY]) == {}
     assert _json.loads(anno[PRE_SCORE_RESULT_KEY]) == {}
+
+
+def _policy_spread_con(**over):
+    con = {
+        "maxSkew": 1,
+        "topologyKey": ZONE_KEY,
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "web"}},
+    }
+    con.update(over)
+    return con
+
+
+def _assert_spread_violations(nodes, bound, pod, expect):
+    infos = oracle.build_node_infos(nodes, bound)
+    rows = oracle.topology_spread_filter_all(pod, infos, pods_by_node(bound))
+    for info, reasons in zip(infos, rows):
+        assert bool(reasons) == expect[info["name"]], ("oracle", info["name"])
+    _feats, res = _engine_result(nodes, bound, [pod])
+    fi = res.filter_plugin_names.index("PodTopologySpread")
+    for ni, info in enumerate(infos):
+        got = int(res.reason_bits[0, fi, ni]) != 0
+        assert got == expect[info["name"]], ("kernel", info["name"])
+
+
+def test_spread_node_taints_policy_fixture():
+    """nodeTaintsPolicy Honor excludes intolerably-tainted nodes from the
+    domain stats (v1.30 common.go); the default Ignore counts them —
+    which flips the min-match domain and with it a1's verdict."""
+    nodes = [
+        make_node("a1", labels={ZONE_KEY: "A"}),
+        make_node(
+            "b1",
+            labels={ZONE_KEY: "B"},
+            taints=[{"key": "dedicated", "value": "x", "effect": "NoSchedule"}],
+        ),
+    ]
+    bound = [
+        make_pod(f"w{i}", labels={"app": "web"}, node_name="a1") for i in range(2)
+    ]
+    for policy, expect in fx.SPREAD_TAINTS_POLICY_EXPECT.items():
+        over = {} if policy == "Ignore" else {"nodeTaintsPolicy": "Honor"}
+        pod = make_pod(
+            "incoming",
+            labels={"app": "web"},
+            topology_spread_constraints=[_policy_spread_con(**over)],
+        )
+        _assert_spread_violations(nodes, bound, pod, expect)
+
+
+def test_spread_node_affinity_policy_fixture():
+    """nodeAffinityPolicy Honor (the default) excludes nodes failing the
+    pod's own nodeSelector from the stats; Ignore counts them."""
+    nodes = [
+        make_node("a1", labels={ZONE_KEY: "A", "tier": "frontend"}),
+        make_node("b1", labels={ZONE_KEY: "B"}),
+    ]
+    bound = [
+        make_pod(f"w{i}", labels={"app": "web"}, node_name="a1") for i in range(2)
+    ]
+    for policy, expect in fx.SPREAD_AFFINITY_POLICY_EXPECT.items():
+        over = {} if policy == "Honor" else {"nodeAffinityPolicy": "Ignore"}
+        pod = make_pod(
+            "incoming",
+            labels={"app": "web"},
+            node_selector={"tier": "frontend"},
+            topology_spread_constraints=[_policy_spread_con(**over)],
+        )
+        _assert_spread_violations(nodes, bound, pod, expect)
+
+
+def test_spread_match_label_keys_fixture():
+    """matchLabelKeys folds the incoming pod's own label values into the
+    selector (MatchLabelKeysInPodTopologySpread, beta/on in v1.30) —
+    fully inverting the verdicts in this scenario."""
+    nodes = [
+        make_node("a1", labels={ZONE_KEY: "A"}),
+        make_node("b1", labels={ZONE_KEY: "B"}),
+    ]
+    bound = [
+        make_pod(f"v1-{i}", labels={"app": "web", "version": "v1"}, node_name="a1")
+        for i in range(2)
+    ] + [make_pod("v2-0", labels={"app": "web", "version": "v2"}, node_name="b1")]
+    for mode, expect in fx.SPREAD_MATCH_LABEL_KEYS_EXPECT.items():
+        over = {"matchLabelKeys": ["version"]} if mode == "with" else {}
+        pod = make_pod(
+            "incoming",
+            labels={"app": "web", "version": "v2"},
+            topology_spread_constraints=[_policy_spread_con(**over)],
+        )
+        _assert_spread_violations(nodes, bound, pod, expect)
