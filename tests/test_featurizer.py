@@ -67,3 +67,21 @@ def test_padding_masks():
     assert f.nodes.padded == 8 and f.nodes.count == 3
     assert np.sum(f.nodes.valid) == 3
     assert np.sum(f.pods.valid) == 5
+
+
+def test_bucket_size_three_quarter_step():
+    """From the 8192 pow2 up the bucket ladder gains a 3/4 step (6144,
+    12288, …): caps padding waste at 1/3 where the big-shape scans pay
+    for it, every step divisible by 2048 for mesh sharding, and NO new
+    recompile boundaries at churn-scale shapes (<= 4096)."""
+    assert bucket_size(4097) == 6144
+    assert bucket_size(5000) == 6144
+    assert bucket_size(6144) == 6144
+    assert bucket_size(6145) == 8192
+    assert bucket_size(10000) == 12288
+    assert bucket_size(12289) == 16384
+    # Below the threshold the ladder is unchanged.
+    assert bucket_size(2049) == 4096
+    assert bucket_size(2048) == 2048
+    assert bucket_size(4096) == 4096
+    assert bucket_size(1000) == 1024
