@@ -110,12 +110,31 @@ class InterPodAffinity:
         a = aux["interpod"]
         j = pod.index
         i32 = jnp.int32
-        dom_t = a["dom_t"]  # [N, T] constant
-        cnt = carry["cnt"]  # [N, T]
         raff = a["req_aff"][j].astype(i32)  # [T]
         ranti = a["req_anti"][j].astype(i32)
         qm_t = a["pod_term_match"][j].astype(i32)  # [T]
+        n = a["dom_t"].shape[0]
 
+        def heavy(_):
+            return self._filter_code(a, carry, raff, ranti, qm_t, j)
+
+        # Upstream's PreFilter Skip (filtering.go): a pod with no required
+        # (anti-)affinity terms of its own that also matches no existing
+        # pod's term selectors cannot fail any of the three checks — the
+        # heavy branch provably yields code 0 for it (every check dots
+        # against raff/ranti/qm_t).  lax.cond skips the matvec work in the
+        # sequential scan; under vmap it lowers to select (both branches,
+        # as before).
+        pred = (jnp.sum(raff) + jnp.sum(ranti) + jnp.sum(qm_t)) > 0
+        code = jax.lax.cond(
+            pred, heavy, lambda _: jnp.zeros(n, jnp.int32), None
+        )
+        return FilterOutput(ok=code == 0, reason_bits=code)
+
+    def _filter_code(self, a, carry, raff, ranti, qm_t, j):
+        i32 = jnp.int32
+        dom_t = a["dom_t"]  # [N, T] constant
+        cnt = carry["cnt"]  # [N, T]
         # (1) required affinity: all topology keys present AND every term's
         # domain count > 0 — or the global-empty + self-match escape.
         # Upstream keys affinityCounts by topologyPair (key, value) SHARED
@@ -140,12 +159,11 @@ class InterPodAffinity:
         # (3) existing pods' required anti-affinity vs this pod.
         viol_existing = jnp.dot((carry["ecnt"] > 0).astype(i32), qm_t) > 0
 
-        code = jnp.where(
+        return jnp.where(
             ~pass_aff,
             AFFINITY_BIT,
             jnp.where(viol_anti, ANTI_BIT, jnp.where(viol_existing, EXISTING_ANTI_BIT, 0)),
         ).astype(i32)
-        return FilterOutput(ok=code == 0, reason_bits=code)
 
     def decode_reasons(self, bits: int) -> list[str]:
         if bits & AFFINITY_BIT:
@@ -162,23 +180,43 @@ class InterPodAffinity:
         a = aux["interpod"]
         j = pod.index
         qm_t = a["pod_term_match"][j].astype(jnp.int32)
-        return (
-            jnp.dot(carry["cnt"], a["pref_w"][j]) + jnp.dot(carry["ew"], qm_t)
-        ).astype(jnp.int32)
+        n = a["dom_t"].shape[0]
+
+        def heavy(_):
+            return (
+                jnp.dot(carry["cnt"], a["pref_w"][j]) + jnp.dot(carry["ew"], qm_t)
+            ).astype(jnp.int32)
+
+        # Scoring Skip: no preferred weights of its own and no term
+        # selector matching this pod -> both dot products are provably 0.
+        pred = jnp.any(a["pref_w"][j] != 0) | jnp.any(qm_t > 0)
+        return jax.lax.cond(pred, heavy, lambda _: jnp.zeros(n, jnp.int32), None)
 
     def normalize(self, scores: jnp.ndarray, ok: jnp.ndarray) -> jnp.ndarray:
-        big = jnp.iinfo(jnp.int32).max
-        any_ok = jnp.any(ok)
-        mn = jnp.where(any_ok, jnp.min(jnp.where(ok, scores, big)), 0)
-        mx = jnp.where(any_ok, jnp.max(jnp.where(ok, scores, -big - 1)), 0)
-        diff = mx - mn
-        # Go: fScore = float64(MaxNodeScore) * (float64(s-min)/float64(diff));
-        # int64(fScore) truncates (values >= 0 -> floor).  Division first.
-        # float64 under x64 (exact vs the float64 oracle/upstream); float32
-        # on TPU (documented +-1 rounding tolerance at exact-integer ratio
-        # boundaries, same caveat as PodTopologySpread.score).
-        ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        ratio = (scores - mn).astype(ftype) / jnp.maximum(diff, 1).astype(ftype)
-        val = jnp.floor(ftype(MAX_NODE_SCORE) * ratio)
-        out = jnp.where(diff > 0, val, 0.0)
-        return jnp.where(ok, out, 0).astype(jnp.int32)
+        def heavy(_):
+            big = jnp.iinfo(jnp.int32).max
+            any_ok = jnp.any(ok)
+            mn = jnp.where(any_ok, jnp.min(jnp.where(ok, scores, big)), 0)
+            mx = jnp.where(any_ok, jnp.max(jnp.where(ok, scores, -big - 1)), 0)
+            diff = mx - mn
+            # Go: fScore = float64(MaxNodeScore) * (float64(s-min) /
+            # float64(diff)); int64(fScore) truncates (values >= 0 ->
+            # floor).  Division first.  float64 under x64 (exact vs the
+            # float64 oracle/upstream); float32 on TPU (documented +-1
+            # rounding tolerance at exact-integer ratio boundaries, same
+            # caveat as PodTopologySpread.score).
+            ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            ratio = (scores - mn).astype(ftype) / jnp.maximum(diff, 1).astype(ftype)
+            val = jnp.floor(ftype(MAX_NODE_SCORE) * ratio)
+            out = jnp.where(diff > 0, val, 0.0)
+            return jnp.where(ok, out, 0).astype(jnp.int32)
+
+        # All-zero raw scores normalize to all zeros (diff == 0 branch);
+        # skip the float work for the majority of pods the score cond
+        # already zeroed.
+        return jax.lax.cond(
+            jnp.any(scores != 0),
+            heavy,
+            lambda _: jnp.zeros(scores.shape[0], jnp.int32),
+            None,
+        )

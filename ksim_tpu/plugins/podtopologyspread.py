@@ -158,10 +158,14 @@ class PodTopologySpread:
             out = jnp.where((con["tk"] == k)[None, :], ldom[:, k : k + 1], out)
         return out
 
-    def _policy_elig(self, state, pod, aux, con) -> jnp.ndarray:
-        """[N, MC] inclusion-policy eligibility per constraint."""
-        aff = required_affinity_match(aux, pod)
-        tnt = forbidding_taints_tolerated(aux, pod)
+    def _policy_elig(self, state, con, aff, tnt) -> jnp.ndarray:
+        """[N, MC] inclusion-policy eligibility per constraint.
+
+        ``aff``/``tnt`` are computed by the CALLER outside the skip
+        cond: the same expressions the NodeAffinity / TaintToleration
+        kernels evaluate, so XLA CSE makes them free whenever those
+        plugins are enabled — inside the cond branch they would be
+        recomputed per extension point instead."""
         e = state.valid[:, None]
         e = e & jnp.where(con["honor_aff"][None, :], aff[:, None], True)
         e = e & jnp.where(con["honor_taints"][None, :], tnt[:, None], True)
@@ -241,30 +245,55 @@ class PodTopologySpread:
     def filter(self, state: NodeStateView, pod: PodView, aux, carry) -> FilterOutput:
         con = self._constraint_arrays(aux, pod)
         active = con["valid"] & (con["mode"] == 0)  # [MC]
-        l_mc = self._ldom_mc(aux, con)  # [N, MC]
-        haskey = l_mc >= 0
-        allkeys = jnp.all(haskey | ~active[None, :], axis=1)  # [N]
-        elig = self._policy_elig(state, pod, aux, con) & allkeys[:, None]
-        stat = elig & haskey  # [N, MC]
-        cnt_mc = self._sel_counts(carry, con)
-        x = jnp.where(stat, cnt_mc, 0)
-        seg_at, dom_num, min_match = self._per_key_stats(
-            aux, con, stat, lambda _reg_at: x
+        n = state.valid.shape[0]
+        aff = required_affinity_match(aux, pod)
+        tnt = forbidding_taints_tolerated(aux, pod)
+
+        def heavy(_):
+            l_mc = self._ldom_mc(aux, con)  # [N, MC]
+            haskey = l_mc >= 0
+            allkeys = jnp.all(haskey | ~active[None, :], axis=1)  # [N]
+            elig = self._policy_elig(state, con, aff, tnt) & allkeys[:, None]
+            stat = elig & haskey  # [N, MC]
+            cnt_mc = self._sel_counts(carry, con)
+            x = jnp.where(stat, cnt_mc, 0)
+            seg_at, dom_num, min_match = self._per_key_stats(
+                aux, con, stat, lambda _reg_at: x
+            )
+            min_match = jnp.where(dom_num > 0, min_match, 0)
+            min_match = jnp.where(
+                (con["min_domains"] > 0) & (dom_num < con["min_domains"]),
+                0,
+                min_match,
+            )
+            match_num = jnp.where(haskey, seg_at, 0)
+            skew = (
+                match_num
+                + con["self"].astype(jnp.int32)[None, :]
+                - min_match[None, :]
+            )
+            viol = skew > con["max_skew"][None, :]
+            code_mc = jnp.where(
+                ~haskey, MISSING_LABEL_BIT, jnp.where(viol, SKEW_BIT, 0)
+            ).astype(jnp.int32)
+            # First failing active constraint wins (upstream constraint
+            # order).
+            code = jnp.zeros(n, dtype=jnp.int32)
+            for ci in range(self._mc):
+                code = jnp.where(active[ci] & (code == 0), code_mc[:, ci], code)
+            return code
+
+        # Upstream's PreFilter Skip (filtering.go): a pod with no
+        # DoNotSchedule constraints passes everywhere with nothing
+        # recorded.  Inside the sequential scan lax.cond executes only
+        # the taken branch, so the ~majority of pods without constraints
+        # skip the whole domain-statistics machinery; the heavy branch
+        # yields exactly zeros for such pods (active gates every code
+        # write), so the split is bit-exact.  Under vmap (batch path)
+        # cond lowers to select — same cost as before, same results.
+        code = jax.lax.cond(
+            jnp.any(active), heavy, lambda _: jnp.zeros(n, jnp.int32), None
         )
-        min_match = jnp.where(dom_num > 0, min_match, 0)
-        min_match = jnp.where(
-            (con["min_domains"] > 0) & (dom_num < con["min_domains"]), 0, min_match
-        )
-        match_num = jnp.where(haskey, seg_at, 0)
-        skew = match_num + con["self"].astype(jnp.int32)[None, :] - min_match[None, :]
-        viol = skew > con["max_skew"][None, :]
-        code_mc = jnp.where(
-            ~haskey, MISSING_LABEL_BIT, jnp.where(viol, SKEW_BIT, 0)
-        ).astype(jnp.int32)
-        # First failing active constraint wins (upstream constraint order).
-        code = jnp.zeros(l_mc.shape[0], dtype=jnp.int32)
-        for ci in range(self._mc):
-            code = jnp.where(active[ci] & (code == 0), code_mc[:, ci], code)
         return FilterOutput(ok=code == 0, reason_bits=code)
 
     def decode_reasons(self, bits: int) -> list[str]:
@@ -287,44 +316,69 @@ class PodTopologySpread:
         return active, l_mc, haskey, ignored
 
     def score(self, state: NodeStateView, pod: PodView, aux, ok=None, carry=None) -> jnp.ndarray:
-        con = self._constraint_arrays(aux, pod)
-        active, l_mc, haskey, ignored = self._score_parts(aux, con, pod)
-        filtered = ok & ~ignored  # [N]
+        n = state.valid.shape[0]
+        aff = required_affinity_match(aux, pod)
+        tnt = forbidding_taints_tolerated(aux, pod)
 
-        # Registered domains: present among framework-feasible, non-ignored
-        # nodes (upstream calPreScoreState filteredNodes); contributors are
-        # policy-passing nodes whose domain is registered.
-        fd = filtered[:, None] & haskey  # [N, MC]
-        elig0 = self._policy_elig(state, pod, aux, con) & haskey
-        cnt_mc = self._sel_counts(carry, con)
-        seg_at, dom_num, _min_unused = self._per_key_stats(
-            aux, con, fd, lambda reg_at: jnp.where(elig0 & reg_at, cnt_mc, 0)
-        )
+        def heavy(_):
+            con = self._constraint_arrays(aux, pod)
+            active, l_mc, haskey, ignored = self._score_parts(aux, con, pod)
+            filtered = ok & ~ignored  # [N]
 
-        ft = _ftype()
-        tp_weight = jnp.log(dom_num.astype(ft) + 2.0)  # [MC]
-        contrib = seg_at.astype(ft) * tp_weight[None, :] + (
-            con["max_skew"].astype(ft)[None, :] - 1.0
+            # Registered domains: present among framework-feasible,
+            # non-ignored nodes (upstream calPreScoreState filteredNodes);
+            # contributors are policy-passing nodes whose domain is
+            # registered.
+            fd = filtered[:, None] & haskey  # [N, MC]
+            elig0 = self._policy_elig(state, con, aff, tnt) & haskey
+            cnt_mc = self._sel_counts(carry, con)
+            seg_at, dom_num, _min_unused = self._per_key_stats(
+                aux, con, fd, lambda reg_at: jnp.where(elig0 & reg_at, cnt_mc, 0)
+            )
+
+            ft = _ftype()
+            tp_weight = jnp.log(dom_num.astype(ft) + 2.0)  # [MC]
+            contrib = seg_at.astype(ft) * tp_weight[None, :] + (
+                con["max_skew"].astype(ft)[None, :] - 1.0
+            )
+            gate = active[None, :] & filtered[:, None]
+            total = jnp.sum(jnp.where(gate, contrib, 0.0), axis=1)
+            return jnp.round(total).astype(jnp.int32)
+
+        # Upstream's PreScore Skip: no ScheduleAnyway constraints ->
+        # raw score 0 (normalize pins the final contribution to 0 too).
+        # The heavy branch's `gate` zeroes every contribution for such
+        # pods, so skipping it is bit-exact; lax.cond makes the skip free
+        # in the sequential scan.
+        has_con = aux["spread"]["has_score_con"][pod.index]
+        return jax.lax.cond(
+            has_con, heavy, lambda _: jnp.zeros(n, jnp.int32), None
         )
-        gate = active[None, :] & filtered[:, None]
-        total = jnp.sum(jnp.where(gate, contrib, 0.0), axis=1)
-        return jnp.round(total).astype(jnp.int32)
 
     def normalize(self, scores, ok, *, state=None, pod=None, aux=None, carry=None):
-        con = self._constraint_arrays(aux, pod)
-        _active, _l_mc, _haskey, ignored = self._score_parts(aux, con, pod)
-        scoreable = ok & ~ignored
+        def heavy(_):
+            con = self._constraint_arrays(aux, pod)
+            _active, _l_mc, _haskey, ignored = self._score_parts(aux, con, pod)
+            scoreable = ok & ~ignored
+            mx = jnp.max(jnp.where(scoreable, scores, jnp.iinfo(jnp.int32).min))
+            mn = jnp.min(jnp.where(scoreable, scores, _BIG))
+            any_scoreable = jnp.any(scoreable)
+            mx = jnp.where(any_scoreable, mx, 0)
+            mn = jnp.where(any_scoreable, mn, 0)
+            norm = jnp.where(
+                mx == 0,
+                MAX_NODE_SCORE,
+                (MAX_NODE_SCORE * (mx + mn - scores)) // jnp.maximum(mx, 1),
+            )
+            return jnp.where(ignored, 0, norm).astype(jnp.int32)
+
+        # PreScore Skip: no ScheduleAnyway constraints -> no contribution
+        # (the old unconditional `where(has_con, out, 0)` tail, now a
+        # cond so skipped pods pay nothing in the scan).
         has_con = aux["spread"]["has_score_con"][pod.index]
-        mx = jnp.max(jnp.where(scoreable, scores, jnp.iinfo(jnp.int32).min))
-        mn = jnp.min(jnp.where(scoreable, scores, _BIG))
-        any_scoreable = jnp.any(scoreable)
-        mx = jnp.where(any_scoreable, mx, 0)
-        mn = jnp.where(any_scoreable, mn, 0)
-        norm = jnp.where(
-            mx == 0,
-            MAX_NODE_SCORE,
-            (MAX_NODE_SCORE * (mx + mn - scores)) // jnp.maximum(mx, 1),
+        return jax.lax.cond(
+            has_con,
+            heavy,
+            lambda _: jnp.zeros(scores.shape[0], jnp.int32),
+            None,
         )
-        out = jnp.where(ignored, 0, norm)
-        # PreScore Skip: no ScheduleAnyway constraints -> no contribution.
-        return jnp.where(has_con, out, 0).astype(jnp.int32)
