@@ -146,9 +146,18 @@ class ScenarioRunner:
             obj["spec"].pop("nodeName", None)
             obj.get("status", {}).pop("phase", None)
 
-        for pod in self.store.list("pods", copy_objs=False):
-            if pod.get("spec", {}).get("nodeName") in node_names:
-                self.store.patch("pods", name_of(pod), namespace_of(pod), clear)
+        # The store's nodeName partition bounds the walk to bound pods
+        # (the original full-list walk matched only those anyway); the
+        # matches sort by (name, "ns/name") — exactly list("pods")'s
+        # (name, key) order — so patches apply (and consume
+        # resourceVersions) in the same order the full walk produced.
+        hit = [
+            (name_of(p), f"{namespace_of(p) or 'default'}/{name_of(p)}", namespace_of(p))
+            for p in self.store.pods_with_node()
+            if p.get("spec", {}).get("nodeName") in node_names
+        ]
+        for name, _key, ns in sorted(hit):
+            self.store.patch("pods", name, ns, clear)
 
     # -- replay -------------------------------------------------------------
 

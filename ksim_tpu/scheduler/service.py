@@ -347,17 +347,19 @@ class SchedulerService:
         return copy.deepcopy(self._pending_pods_live())
 
     def pending_count(self) -> int:
-        """Number of pending pods (no copies — the hot-loop counter)."""
+        """Number of pending pods (no copies — the hot-loop counter).
+        The store's nodeName partition bounds the walk to the unbound
+        side (every bound pod fails _is_pending's first check)."""
         return sum(
             1
-            for p in self._store.list("pods", copy_objs=False)
+            for p in self._store.pods_without_node()
             if self._is_pending(p)
         )
 
     def _pending_pods_live(self) -> list[JSON]:
         """Internal read-only variant over the store's live dicts."""
         return sorted(
-            (p for p in self._store.list("pods", copy_objs=False) if self._is_pending(p)),
+            (p for p in self._store.pods_without_node() if self._is_pending(p)),
             key=lambda p: queue_sort_key(p, self._priority_of),
         )
 
@@ -423,11 +425,19 @@ class SchedulerService:
         for sched_name in self._scheduler_names:
             # Fresh pod snapshot per profile: earlier profiles' bindings
             # must charge their nodes before the next profile evaluates.
-            pods = self._store.list("pods", copy_objs=False)
-            pods = self._assume_waiting(pods)
+            # The store's nodeName partition replaces the O(all pods)
+            # walk: queue candidates come from the without-node side
+            # (permit-assumed pods gain a nodeName in the wrap and fall
+            # out via _is_pending, exactly as they did from the full
+            # list), bound pods from the with-node side.
+            without = self._assume_waiting(self._store.pods_without_node())
+            bound_pods = self._store.pods_with_node()
+            assumed = [p for p in without if p.get("spec", {}).get("nodeName")]
+            if assumed:
+                bound_pods = bound_pods + assumed
             queue = [
                 p
-                for p in pods
+                for p in without
                 if self._is_pending(p)
                 and not self._in_backoff(p)
                 and (p.get("spec", {}).get("schedulerName") or DEFAULT_SCHEDULER_NAME)
@@ -483,7 +493,12 @@ class SchedulerService:
                 continue
             with self.metrics.timer("featurize"):
                 feats = featurizer.featurize(
-                    nodes, pods, queue_pods=queue, namespaces=namespaces, **volume_kw
+                    nodes,
+                    (),
+                    queue_pods=queue,
+                    bound_pods=bound_pods,
+                    namespaces=namespaces,
+                    **volume_kw,
                 )
             plugins = tuple(factory(feats))
             with self.metrics.timer("engine"):

@@ -88,6 +88,53 @@ class ClusterStore:
         # replay mutates membership every step — re-sorting thousands of
         # unchanged objects per list() dominated churn-replay host time.
         self._sorted_keys: dict[str, list[tuple[str, str]]] = {k: [] for k in KINDS}
+        # Pod partition by spec.nodeName presence (phase-agnostic; the
+        # consumers apply their own phase/queue predicates).  The
+        # scheduler walks "all pods" several times per pass only to pick
+        # one side of this split — at churn scale those O(pods) walks
+        # over a 15k+ population dominated saturated host time.  Values
+        # are the same live frozen dicts ``_objects`` holds.
+        self._with_node: dict[str, JSON] = {}
+        self._without_node: dict[str, JSON] = {}
+
+    # -- pod node-name index ------------------------------------------------
+
+    def _index_pod(self, key: str, obj: JSON | None) -> None:
+        """Maintain the nodeName partition (callers hold the lock)."""
+        self._with_node.pop(key, None)
+        self._without_node.pop(key, None)
+        if obj is None:
+            return
+        if obj.get("spec", {}).get("nodeName"):
+            self._with_node[key] = obj
+        else:
+            self._without_node[key] = obj
+
+    # The sides are deliberately UNORDERED (dict insertion order):
+    # maintaining incremental (name, key) orders costs an O(side)
+    # memmove per pod transition (bind = delete+insert on 15k-entry
+    # lists), which measured out slower than the walks the partition
+    # saves, and a per-call sort of the bound side costs the same again.
+    # Order-sensitive consumers sort the (small) subset they select.
+
+    def pods_with_node(self) -> list[JSON]:
+        """Live dicts of pods carrying spec.nodeName (ANY phase),
+        UNORDERED.  Read-only, same liveness contract as
+        ``list(copy_objs=False)``."""
+        with self._lock:
+            return list(self._with_node.values())
+
+    def pods_without_node(self) -> list[JSON]:
+        """Live dicts of pods without spec.nodeName (ANY phase),
+        (name, key)-sorted — the scheduling queue's stable pre-order;
+        the pending side is small, so the sort is cheap."""
+        with self._lock:
+            return [
+                o
+                for _n, _k, o in sorted(
+                    (name_of(o), k, o) for k, o in self._without_node.items()
+                )
+            ]
 
     # -- CRUD ---------------------------------------------------------------
 
@@ -105,6 +152,8 @@ class ClusterStore:
             md.setdefault("uid", f"uid-{kind}-{md['resourceVersion']}")
             self._objects[kind][key] = obj
             bisect.insort(self._sorted_keys[kind], (name_of(obj), key))
+            if kind == "pods":
+                self._index_pod(key, obj)
             # The stored object is frozen (writes replace, never mutate), so
             # the event and history can share it without a copy.
             self._notify(WatchEvent(kind, ADDED, obj))
@@ -152,6 +201,8 @@ class ClusterStore:
             md["uid"] = current["metadata"].get("uid")
             md["resourceVersion"] = str(next(self._rv))
             self._objects[kind][key] = obj
+            if kind == "pods":
+                self._index_pod(key, obj)
             self._notify(WatchEvent(kind, MODIFIED, obj))
             return copy.deepcopy(obj)
 
@@ -169,6 +220,8 @@ class ClusterStore:
             mutate(obj)
             obj["metadata"]["resourceVersion"] = str(next(self._rv))
             self._objects[kind][key] = obj
+            if kind == "pods":
+                self._index_pod(key, obj)
             self._notify(WatchEvent(kind, MODIFIED, obj))
             return copy.deepcopy(obj)
 
@@ -198,6 +251,8 @@ class ClusterStore:
             md = obj["metadata"] = dict(obj.get("metadata") or {})
             md["resourceVersion"] = str(next(self._rv))
             self._objects[kind][key] = obj
+            if kind == "pods":
+                self._index_pod(key, obj)
             self._notify(WatchEvent(kind, MODIFIED, obj))
             return obj
 
@@ -208,6 +263,8 @@ class ClusterStore:
             obj = self._objects[kind].pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{kind} {key!r} not found")
+            if kind == "pods":
+                self._index_pod(key, None)
             entry = (name_of(obj), key)
             idx = bisect.bisect_left(self._sorted_keys[kind], entry)
             sk = self._sorted_keys[kind]
@@ -341,6 +398,9 @@ class ClusterStore:
                     self._notify(WatchEvent(kind, DELETED, obj))
                 self._objects[kind].clear()
                 self._sorted_keys[kind] = []
+                if kind == "pods":
+                    self._with_node.clear()
+                    self._without_node.clear()
             for kind, objs in dump.items():
                 self._check_kind(kind)
                 for key, obj in objs.items():
@@ -350,6 +410,8 @@ class ClusterStore:
                     )
                     self._objects[kind][key] = restored
                     bisect.insort(self._sorted_keys[kind], (name_of(restored), key))
+                    if kind == "pods":
+                        self._index_pod(key, restored)
                     self._notify(WatchEvent(kind, ADDED, restored))
 
     def _check_kind(self, kind: str) -> None:

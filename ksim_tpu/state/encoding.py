@@ -229,9 +229,10 @@ def encode_affinity(
     T = _vpad(len(vocab.term_list))
     Q0 = len(vocab.req_list)
     reqs_token = tuple(vocab.reqs)
+    reqs_tok = objcache.intern_token(reqs_token)
 
     def node_row(node: JSON) -> np.ndarray:
-        key = ("affnode", objcache.ref_id(node), reqs_token)
+        key = ("affnode", objcache.ref_id(node), reqs_tok)
         hit = objcache.get(key)
         if hit is not objcache.MISS:
             return hit
@@ -246,9 +247,18 @@ def encode_affinity(
                 row[qi] = match_node_selector_requirement(req, lbls)
         return objcache.put(key, row)
 
-    node_req_match = np.zeros((n_padded, max(Q, 1)), dtype=bool)
-    for ni, node in enumerate(nodes):
-        node_req_match[ni, :Q0] = node_row(node)
+    def build_node_matrix() -> np.ndarray:
+        m = np.zeros((n_padded, max(Q, 1)), dtype=bool)
+        for ni, node in enumerate(nodes):
+            m[ni, :Q0] = node_row(node)
+        return m
+
+    # Family-cached on (exact node objects, requirement vocab): the
+    # assembled matrix is identical whenever neither changed — every
+    # churn pass without a node event once the term vocab stabilizes.
+    node_req_match = objcache.cached_seq(
+        "enc_aff_nodes", nodes, build_node_matrix, reqs_tok, n_padded
+    )
 
     term_req = np.zeros((max(T, 1), max(Q, 1)), dtype=bool)
     term_size = np.full(max(T, 1), -1, dtype=np.int32)
@@ -316,56 +326,66 @@ class TaintTensors:
 def encode_taints(
     nodes: Sequence[JSON], pods: Sequence[JSON], n_padded: int, p_padded: int
 ) -> TaintTensors:
-    vocab: dict[str, int] = {}
-    taints: list[JSON] = []
-
     from ksim_tpu.state import objcache
 
-    def tid(key: str, t: JSON) -> int:
-        if key not in vocab:
-            vocab[key] = len(taints)
-            taints.append(
-                {"key": t.get("key", ""), "value": t.get("value", ""), "effect": t.get("effect", "")}
-            )
-        return vocab[key]
+    def build_node_side():
+        """The taint vocabulary and every node-derived array — a pure
+        function of the node list (+ n_padded), cached as a family on
+        the exact node objects (objcache.cached_seq): under churn the
+        node list is identical most passes, and this loop over every
+        node was a top featurize cost."""
+        vocab: dict[str, int] = {}
+        taints: list[JSON] = []
 
-    def node_taints(node: JSON) -> list[tuple[str, JSON]]:
-        """[(canonical key, taint)] per node, memoized per object."""
-
-        def build() -> list[tuple[str, JSON]]:
-            return [
-                (
-                    _canon({"key": t.get("key", ""), "value": t.get("value", ""), "effect": t.get("effect", "")}),
-                    t,
+        def tid(key: str, t: JSON) -> int:
+            if key not in vocab:
+                vocab[key] = len(taints)
+                taints.append(
+                    {"key": t.get("key", ""), "value": t.get("value", ""), "effect": t.get("effect", "")}
                 )
-                for t in node.get("spec", {}).get("taints") or []
-            ]
+            return vocab[key]
 
-        return objcache.cached("nodetaints", node, build)
+        def node_taints(node: JSON) -> list[tuple[str, JSON]]:
+            """[(canonical key, taint)] per node, memoized per object."""
 
-    per_node: list[list[int]] = []
-    for node in nodes:
-        per_node.append([tid(k, t) for k, t in node_taints(node)])
+            def build() -> list[tuple[str, JSON]]:
+                return [
+                    (
+                        _canon({"key": t.get("key", ""), "value": t.get("value", ""), "effect": t.get("effect", "")}),
+                        t,
+                    )
+                    for t in node.get("spec", {}).get("taints") or []
+                ]
 
-    W = _vpad(len(taints))
-    order = np.zeros((n_padded, W), dtype=np.int32)
-    for ni, ids in enumerate(per_node):
-        for pos, w in enumerate(ids):
-            if order[ni, w] == 0:
-                order[ni, w] = pos + 1
-    forbidding = np.zeros(W, dtype=bool)
-    prefer = np.zeros(W, dtype=bool)
-    for w, t in enumerate(taints):
-        forbidding[w] = t["effect"] in FORBIDDING_EFFECTS
-        prefer[w] = t["effect"] == "PreferNoSchedule"
+            return objcache.cached("nodetaints", node, build)
 
+        per_node: list[list[int]] = []
+        for node in nodes:
+            per_node.append([tid(k, t) for k, t in node_taints(node)])
+
+        W = _vpad(len(taints))
+        order = np.zeros((n_padded, W), dtype=np.int32)
+        for ni, ids in enumerate(per_node):
+            for pos, w in enumerate(ids):
+                if order[ni, w] == 0:
+                    order[ni, w] = pos + 1
+        forbidding = np.zeros(W, dtype=bool)
+        prefer = np.zeros(W, dtype=bool)
+        for w, t in enumerate(taints):
+            forbidding[w] = t["effect"] in FORBIDDING_EFFECTS
+            prefer[w] = t["effect"] == "PreferNoSchedule"
+        return taints, order, forbidding, prefer, tuple(vocab), W
+
+    taints, order, forbidding, prefer, taints_token, W = objcache.cached_seq(
+        "enc_taints_nodes", nodes, build_node_side, n_padded
+    )
     W0 = len(taints)
-    taints_token = tuple(vocab)
+    taints_tok = objcache.intern_token(taints_token)
 
     def tol_rows(pod: JSON) -> tuple[np.ndarray, np.ndarray]:
         """(tolerated, tolerated_prefer) rows over the taint vocab,
         memoized per (pod object, vocab)."""
-        key = ("taintrow", objcache.ref_id(pod), taints_token)
+        key = ("taintrow", objcache.ref_id(pod), taints_tok)
         hit = objcache.get(key)
         if hit is not objcache.MISS:
             return hit
@@ -655,26 +675,37 @@ def encode_topology_spread(
         per_pod_cons.append(cons)
 
     TK = max(len(tk_vocab), 1)
-    node_dom = np.full((n_padded, TK), -1, dtype=np.int32)
-    node_ldom = np.full((n_padded, TK), -1, dtype=np.int32)
-    tk_sizes = [1] * TK
-    tk_singleton = [True] * TK
-    per_key_loc: list[dict[str, int]] = [{} for _ in range(TK)]
-    per_key_cnt: list[dict[int, int]] = [{} for _ in range(TK)]
-    for ni, node in enumerate(nodes):
-        lbls = labels_of(node)
-        for k, ki in tk_vocab.items():
-            if k in lbls:
-                dk = (ki, lbls[k])
-                if dk not in dom_vocab:
-                    dom_vocab[dk] = len(dom_vocab)
-                node_dom[ni, ki] = dom_vocab[dk]
-                li = per_key_loc[ki].setdefault(lbls[k], len(per_key_loc[ki]))
-                node_ldom[ni, ki] = li
-                per_key_cnt[ki][li] = per_key_cnt[ki].get(li, 0) + 1
-    for ki in range(TK):
-        tk_sizes[ki] = max(len(per_key_loc[ki]), 1)
-        tk_singleton[ki] = all(c <= 1 for c in per_key_cnt[ki].values())
+
+    def build_node_domains():
+        """Node-domain tables — a pure function of (node list, topology
+        -key vocab); ``dom_vocab`` is call-local here (unlike interpod's
+        persistent one), so the whole output is cacheable as a family on
+        the exact node objects + key token."""
+        node_dom = np.full((n_padded, TK), -1, dtype=np.int32)
+        node_ldom = np.full((n_padded, TK), -1, dtype=np.int32)
+        tk_sizes = [1] * TK
+        tk_singleton = [True] * TK
+        per_key_loc: list[dict[str, int]] = [{} for _ in range(TK)]
+        per_key_cnt: list[dict[int, int]] = [{} for _ in range(TK)]
+        for ni, node in enumerate(nodes):
+            lbls = labels_of(node)
+            for k, ki in tk_vocab.items():
+                if k in lbls:
+                    dk = (ki, lbls[k])
+                    if dk not in dom_vocab:
+                        dom_vocab[dk] = len(dom_vocab)
+                    node_dom[ni, ki] = dom_vocab[dk]
+                    li = per_key_loc[ki].setdefault(lbls[k], len(per_key_loc[ki]))
+                    node_ldom[ni, ki] = li
+                    per_key_cnt[ki][li] = per_key_cnt[ki].get(li, 0) + 1
+        for ki in range(TK):
+            tk_sizes[ki] = max(len(per_key_loc[ki]), 1)
+            tk_singleton[ki] = all(c <= 1 for c in per_key_cnt[ki].values())
+        return node_dom, node_ldom, tk_sizes, tk_singleton, max(len(dom_vocab), 1)
+
+    node_dom, node_ldom, tk_sizes, tk_singleton, n_domains = objcache.cached_seq(
+        "enc_spread_nodes", nodes, build_node_domains, tuple(tk_vocab), n_padded
+    )
 
     S = _vpad(len(sel_list))
     S0 = len(sel_list)
@@ -682,9 +713,10 @@ def encode_topology_spread(
     # vocab) — the vocab stabilizes under churn, so unchanged pods cost
     # one lookup per pass.
     sels_token = tuple(sel_vocab)
+    sels_tok = objcache.intern_token(sels_token)
 
     def sel_row(pod: JSON) -> np.ndarray:
-        key = ("spreadrow", objcache.ref_id(pod), sels_token)
+        key = ("spreadrow", objcache.ref_id(pod), sels_tok)
         hit = objcache.get(key)
         if hit is not objcache.MISS:
             return hit
@@ -720,7 +752,7 @@ def encode_topology_spread(
     init_counts = sync_family(
         agg,
         "spread_init",
-        (sels_token, S, S0, n_padded),
+        (sels_tok, S, S0, n_padded),
         bound_map,
         changed_slots,
         make_arrays=lambda: np.zeros((n_padded, S), dtype=np.int32),
@@ -760,7 +792,7 @@ def encode_topology_spread(
                 has_score[j] = True
 
     return SpreadTensors(
-        n_domains=max(len(dom_vocab), 1),
+        n_domains=n_domains,
         tk_sizes=tuple(tk_sizes),
         tk_singleton=tuple(tk_singleton),
         node_dom=node_dom,

@@ -220,18 +220,28 @@ def encode_image_locality(
     from ksim_tpu.state.featurizer import vocab_pad
 
     i = vocab_pad(len(vocab))
-    node_has = np.zeros((n_padded, i), dtype=bool)
-    size = np.zeros(i, dtype=np.float64)
-    num_nodes = np.zeros(i, dtype=np.int32)
-    for ni, node in enumerate(nodes):
-        for img in node.get("status", {}).get("images") or []:
-            sz = float(img.get("sizeBytes") or 0)
-            for nm in img.get("names") or []:
-                vi = vocab.get(normalized_image_name(nm))
-                if vi is not None and not node_has[ni, vi]:
-                    node_has[ni, vi] = True
-                    num_nodes[vi] += 1
-                    size[vi] = max(size[vi], sz)
+
+    def build_node_side():
+        node_has = np.zeros((n_padded, i), dtype=bool)
+        size = np.zeros(i, dtype=np.float64)
+        num_nodes = np.zeros(i, dtype=np.int32)
+        for ni, node in enumerate(nodes):
+            for img in node.get("status", {}).get("images") or []:
+                sz = float(img.get("sizeBytes") or 0)
+                for nm in img.get("names") or []:
+                    vi = vocab.get(normalized_image_name(nm))
+                    if vi is not None and not node_has[ni, vi]:
+                        node_has[ni, vi] = True
+                        num_nodes[vi] += 1
+                        size[vi] = max(size[vi], sz)
+        return node_has, size, num_nodes
+
+    # Family-cached on (exact node objects, image vocab): identical
+    # whenever neither changed — every churn pass without a node event
+    # once the image vocabulary stabilizes.
+    node_has, size, num_nodes = objcache.cached_seq(
+        "enc_img_nodes", nodes, build_node_side, tuple(vocab), n_padded
+    )
 
     pod_image_count = np.zeros((p_padded, i), dtype=np.int32)
     for j, imgs in enumerate(pod_imgs):

@@ -208,6 +208,7 @@ class Featurizer:
         pods: Sequence[JSON],
         *,
         queue_pods: Sequence[JSON] = (),
+        bound_pods: "Sequence[JSON] | None" = None,
         namespaces: Sequence[JSON] = (),
         pvs: Sequence[JSON] = (),
         pvcs: Sequence[JSON] = (),
@@ -215,6 +216,10 @@ class Featurizer:
     ) -> FeaturizedSnapshot:
         """``pods`` are existing cluster pods (bound ones charge their node);
         ``queue_pods`` are the pods to schedule (the pod axis P);
+        ``bound_pods``, when given, are the node-bound pods (spec.nodeName
+        set; callers with an indexed store pass
+        ``store.pods_with_node()`` to skip the O(all pods) split —
+        phase filtering still happens here);
         ``namespaces`` feed namespaceSelector matching (InterPodAffinity);
         ``pvs``/``pvcs``/``storage_classes`` feed the volume plugins."""
         from ksim_tpu.state import objcache
@@ -228,9 +233,10 @@ class Featurizer:
         sched_pods = list(queue_pods) if queue_pods else [
             p for p in pods if not pod_is_scheduled(p)
         ]
+        bound_src = pods if bound_pods is None else bound_pods
         bound_pods = [
             p
-            for p in pods
+            for p in bound_src
             if pod_is_scheduled(p)
             and (p.get("status", {}).get("phase") not in ("Succeeded", "Failed"))
         ]
@@ -348,18 +354,26 @@ class Featurizer:
         N, P = len(nodes), len(sched_pods)
         NP, PP = bucket_size(N, self._node_bucket_min), bucket_size(P, self._pod_bucket_min)
 
-        alloc = np.zeros((NP, R), dtype=np.int32)
-        allowed_pods = np.zeros(NP, dtype=np.int32)
-        unsched = np.zeros(NP, dtype=bool)
-        nvalid = np.zeros(NP, dtype=bool)
-        node_names = [name_of(n) for n in nodes]
-        node_index = self._slots.slot_of
+        def build_node_arrays():
+            alloc = np.zeros((NP, R), dtype=np.int32)
+            allowed_pods = np.zeros(NP, dtype=np.int32)
+            unsched = np.zeros(NP, dtype=bool)
+            nvalid = np.zeros(NP, dtype=bool)
+            node_names = [name_of(n) for n in nodes]
+            for i, n in enumerate(nodes):
+                alloc[i] = lower(node_alloc[i])
+                allowed_pods[i] = node_alloc[i].get(PODS, 0)
+                unsched[i] = node_unschedulable(n)
+                nvalid[i] = True
+            return alloc, allowed_pods, unsched, nvalid, node_names
 
-        for i, n in enumerate(nodes):
-            alloc[i] = lower(node_alloc[i])
-            allowed_pods[i] = node_alloc[i].get(PODS, 0)
-            unsched[i] = node_unschedulable(n)
-            nvalid[i] = True
+        # Family-cached on the exact node objects + unit scaling: under
+        # churn the node list and units are stable most passes, so the
+        # 2k-iteration lowering loop collapses to one dict hit.
+        alloc, allowed_pods, unsched, nvalid, node_names = objcache.cached_seq(
+            "feat_nodes", nodes, build_node_arrays, units_token, NP
+        )
+        node_index = self._slots.slot_of
 
         # Per-node request sums from bound pods, maintained by delta.
         # Masters accumulate in int64: per-value bounds don't bound the

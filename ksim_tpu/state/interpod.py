@@ -273,6 +273,10 @@ def encode_inter_pod(
             agg.pop(k, None)
         vocab = agg.setdefault("ip_vocab", _Vocab())
         dom_vocab = agg.setdefault("ip_doms", {})
+        # New vocabulary lineage: keys derived from dom_vocab content
+        # (the cached node-domain tables below) must not alias entries
+        # from the pre-reset lineage.
+        agg["ip_doms_gen"] = agg.get("ip_doms_gen", 0) + 1
 
     ns_labels = {name_of(ns): dict(labels_of(ns)) for ns in namespaces}
 
@@ -315,21 +319,39 @@ def encode_inter_pod(
 
     # Topology domains from node labels (domain ids persist append-only,
     # so bound-pod contribution records stay valid across passes).
-    node_dom = np.full((n_padded, TK), -1, dtype=np.int32)
-    for ni, node in enumerate(nodes):
-        lbls = labels_of(node)
-        for k, ki in vocab.tk_ids.items():
-            if k in lbls:
-                dk = (ki, lbls[k])
-                if dk not in dom_vocab:
-                    dom_vocab[dk] = len(dom_vocab)
-                node_dom[ni, ki] = dom_vocab[dk]
+    from ksim_tpu.state import objcache
 
-    n_domains = max(len(dom_vocab), 1)
-    D = vocab_pad(n_domains + 1)  # +1 keeps a write-only junk row
-    dom_tk = np.full(D, -1, dtype=np.int32)
-    for (ki, _val), d in dom_vocab.items():
-        dom_tk[d] = ki
+    def build_node_domains():
+        node_dom = np.full((n_padded, TK), -1, dtype=np.int32)
+        for ni, node in enumerate(nodes):
+            lbls = labels_of(node)
+            for k, ki in vocab.tk_ids.items():
+                if k in lbls:
+                    dk = (ki, lbls[k])
+                    if dk not in dom_vocab:
+                        dom_vocab[dk] = len(dom_vocab)
+                    node_dom[ni, ki] = dom_vocab[dk]
+        n_domains = max(len(dom_vocab), 1)
+        D = vocab_pad(n_domains + 1)  # +1 keeps a write-only junk row
+        dom_tk = np.full(D, -1, dtype=np.int32)
+        for (ki, _val), d in dom_vocab.items():
+            dom_tk[d] = ki
+        return node_dom, n_domains, D, dom_tk
+
+    # Family-cached on the exact node objects + tk vocab.  ``dom_vocab``
+    # is persistent and append-only within a lineage (ip_doms_gen bumps
+    # at the reset valve), so (lineage, size) pins its exact content: a
+    # hit guarantees the same ids and dom_tk as at build time, and that
+    # the build would register nothing new for these nodes.
+    node_dom, n_domains, D, dom_tk = objcache.cached_seq(
+        "enc_ip_nodes",
+        nodes,
+        build_node_domains,
+        tuple(vocab.tk_ids),
+        agg.get("ip_doms_gen", 0),
+        len(dom_vocab),
+        n_padded,
+    )
 
     node_index = slot_of if slot_of is not None else {
         name_of(n): i for i, n in enumerate(nodes)
@@ -339,11 +361,9 @@ def encode_inter_pod(
     # Per-pod context-match rows, memoized on (pod object, final ctx
     # vocab, namespace labels): with a persistent vocab the token is
     # stable, so steady state is one dict lookup per pod.
-    from ksim_tpu.state import objcache
-
     U0 = len(vocab.ctxs)
-    vocab_token = tuple(vocab.ctx_ids)
-    ns_token = _canon(ns_labels)
+    vocab_token = objcache.intern_token(tuple(vocab.ctx_ids))
+    ns_token = objcache.intern_token(_canon(ns_labels))
 
     def match_row(pod: JSON) -> np.ndarray:
         key = ("iprow", objcache.ref_id(pod), vocab_token, ns_token)

@@ -33,6 +33,7 @@ everything.
 
 from __future__ import annotations
 
+import collections
 from typing import Any, Callable
 
 _MISS = object()
@@ -119,13 +120,87 @@ def cached(slot: str, obj: Any, fn: Callable[[], Any], *extra: Any) -> Any:
     return put(key, fn())
 
 
+# Family-cache table, SEPARATE from _DATA: entries hold multi-MB arrays
+# and pin a whole node list each, so the per-object memo's ~512k-entry
+# sweep threshold would never trigger — a bounded LRU of a few dozen is
+# the right shape (7 families x a handful of live token/node-list
+# variants; anything older is dead after the next node event anyway).
+_SEQ: "collections.OrderedDict[Any, Any]" = collections.OrderedDict()
+_SEQ_LIMIT = 64
+
+
+def cached_seq(slot: str, objs: Any, fn: Callable[[], Any], *extra: Any) -> Any:
+    """Memoize ``fn()`` under (slot, tuple-of-ids(objs), *extra) — the
+    family form of ``cached`` for whole-sequence builds (an encoder's
+    node-side tables: identical whenever the exact same node objects and
+    vocabulary token recur, which under churn is every pass without a
+    node event).
+
+    Unlike ``cached``, the entry pins its key objects ITSELF: the stored
+    value carries strong references to every object in ``objs``, so none
+    of their ids can be recycled while the entry lives.  (The ``key[1]``
+    pin convention doesn't extend to id-tuples — a sweep would unpin
+    the members and a recycled id could alias a different object into a
+    stale hit.)  Eviction is LRU over a small dedicated table."""
+    seq = tuple(objs)
+    key = (slot, tuple(map(id, seq)), *extra)
+    hit = _SEQ.get(key)
+    if hit is not None:
+        _SEQ.move_to_end(key)
+        return hit[0]
+    value = fn()
+    _SEQ[key] = (value, seq)
+    if len(_SEQ) > _SEQ_LIMIT:
+        _SEQ.popitem(last=False)
+    return value
+
+
+# Token interning: per-pod memo keys embed vocabulary tokens (tuples of
+# canonical strings, often hundreds of entries).  Hashing such a tuple
+# on EVERY lookup is O(vocab) per pod per family; interning maps it to a
+# small int once per pass so the per-pod keys hash in O(1).
+_INTERN: dict[Any, int] = {}
+_INTERN_NEXT = 0
+
+
+def intern_token(token: Any) -> int:
+    """Small stable int for a hashable token (hashed once, here).
+
+    Reset valve: if an adversarial stream mints unbounded distinct
+    tokens, the WHOLE memo resets with the intern table.  Ints come from
+    a MONOTONIC counter (never restarted): callers capture interned ints
+    in locals and may write memo entries with them after the valve
+    fires, so a restarted numbering could hand a later token an int an
+    in-flight key still embeds — aliasing a fresh lookup into a stale
+    entry."""
+    global _INTERN_NEXT
+    i = _INTERN.get(token)
+    if i is None:
+        if len(_INTERN) > (1 << 16):
+            _DATA.clear()
+            _REFS.clear()
+            _INTERN.clear()
+        i = _INTERN_NEXT
+        _INTERN_NEXT += 1
+        _INTERN[token] = i
+    return i
+
+
 def clear() -> None:
     global _GEN, _limit
     _DATA.clear()
     _REFS.clear()
+    _INTERN.clear()
+    _SEQ.clear()
     _GEN = 0
     _limit = None
 
 
 def stats() -> dict[str, int]:
-    return {"entries": len(_DATA), "refs": len(_REFS), "generation": _GEN}
+    return {
+        "entries": len(_DATA),
+        "refs": len(_REFS),
+        "generation": _GEN,
+        "seq_entries": len(_SEQ),
+        "interned": len(_INTERN),
+    }

@@ -166,6 +166,19 @@ def _node_has_attach_pools(node: JSON) -> bool:
     return objcache.cached("attach_pools", node, build)
 
 
+def _any_node_has_attach_pools(nodes) -> bool:
+    """Family-memoized over the exact node list: the volumes fast path
+    asks this every pass, and walking 2k per-node memos was a measurable
+    slice of churn featurize time."""
+    from ksim_tpu.state import objcache
+
+    return objcache.cached_seq(
+        "any_attach_pools",
+        nodes,
+        lambda: any(_node_has_attach_pools(n) for n in nodes),
+    )
+
+
 # Trivial no-volume tensors per (n_padded, p_padded): identical arrays
 # across passes (stable host buffers; nothing to rebuild).
 _TRIVIAL: dict = {}
@@ -295,7 +308,7 @@ def encode_volumes(
             if bound_volume_free is not None
             else not any(_pod_has_volumes(p) for p in bound_pods)
         )
-        and not any(_node_has_attach_pools(n) for n in nodes)
+        and not _any_node_has_attach_pools(nodes)
     ):
         return _trivial_volume_tensors(n_padded, p_padded)
 

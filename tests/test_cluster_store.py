@@ -173,3 +173,70 @@ def test_watch_resume_rejects_foreign_resume_points():
     ev = stream.next(timeout=1)
     assert ev is not None and ev.obj["metadata"]["name"] == "p2"
     stream.close()
+
+
+def test_pod_node_name_partition_tracks_every_write_path():
+    """The nodeName partition (pods_with_node / pods_without_node) must
+    mirror the store through create, bind (patch), update, rewrap,
+    delete, and restore — the scheduler reads one side instead of
+    walking all pods every pass."""
+    store = ClusterStore()
+    store.create("nodes", make_node("n1"))
+    store.create("pods", make_pod("a"))
+    store.create("pods", make_pod("b", node_name="n1"))
+
+    def names(side):
+        return sorted(p["metadata"]["name"] for p in side)
+
+    assert names(store.pods_without_node()) == ["a"]
+    assert names(store.pods_with_node()) == ["b"]
+
+    # Bind via patch: a moves sides.
+    store.patch("pods", "a", "default", lambda o: o["spec"].__setitem__("nodeName", "n1"))
+    assert names(store.pods_without_node()) == []
+    assert names(store.pods_with_node()) == ["a", "b"]
+
+    # Unbind via update (drain): b moves back.
+    b = store.get("pods", "b", "default")
+    b["spec"].pop("nodeName")
+    store.update("pods", b)
+    assert names(store.pods_without_node()) == ["b"]
+
+    # Rewrap (the bind path's write primitive).
+    store.rewrap(
+        "pods", "b", "default",
+        lambda cur: dict(
+            cur,
+            spec=dict(cur["spec"], nodeName="n1"),
+            metadata=dict(cur["metadata"]),
+        ),
+    )
+    assert names(store.pods_without_node()) == []
+
+    # Delete drops the entry from its side.
+    store.delete("pods", "a", "default")
+    assert names(store.pods_with_node()) == ["b"]
+
+    # Restore rebuilds the partition from the dump.
+    dump = store.dump()
+    store.create("pods", make_pod("c"))
+    store.restore(dump)
+    assert names(store.pods_with_node()) == ["b"]
+    assert names(store.pods_without_node()) == []
+
+    # Phase is deliberately NOT part of the partition: a Succeeded pod
+    # with a nodeName stays on the with-node side (the requeue path must
+    # still see it, matching the full-walk semantics).
+    store.create("pods", make_pod("s", node_name="n1", phase="Succeeded"))
+    assert "s" in names(store.pods_with_node())
+
+
+def test_pods_without_node_is_name_sorted():
+    """The without-node side is the scheduling queue's stable pre-order:
+    it must come back (name, key)-sorted like list("pods")."""
+    store = ClusterStore()
+    for nm in ("zz", "aa", "mm"):
+        store.create("pods", make_pod(nm))
+    assert [p["metadata"]["name"] for p in store.pods_without_node()] == [
+        "aa", "mm", "zz",
+    ]

@@ -85,3 +85,26 @@ def test_bucket_size_three_quarter_step():
     assert bucket_size(2048) == 2048
     assert bucket_size(4096) == 4096
     assert bucket_size(1000) == 1024
+
+
+def test_featurize_with_bound_pods_param_matches_split():
+    """featurize(bound_pods=...) — the indexed-store fast path — must
+    produce the same tensors as the O(all pods) split it replaces,
+    including the phase filter it still applies."""
+    import numpy as np
+
+    from tests.helpers import make_node, make_pod
+
+    nodes = [make_node(f"n{i}") for i in range(4)]
+    bound = [make_pod(f"b{i}", node_name=f"n{i % 4}") for i in range(6)]
+    done = [make_pod("done", node_name="n0", phase="Succeeded")]
+    queue = [make_pod(f"q{i}") for i in range(3)]
+    pods = bound + done + queue
+
+    f1 = Featurizer().featurize(nodes, pods, queue_pods=queue)
+    f2 = Featurizer().featurize(
+        nodes, (), queue_pods=queue, bound_pods=bound + done
+    )
+    np.testing.assert_array_equal(f1.nodes.requested, f2.nodes.requested)
+    np.testing.assert_array_equal(f1.nodes.pod_count, f2.nodes.pod_count)
+    np.testing.assert_array_equal(f1.pods.requests, f2.pods.requests)
