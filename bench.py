@@ -518,32 +518,54 @@ def main() -> None:
 
     common = ["--seed", str(args.seed), "--repeats", str(args.repeats)]
 
-    def check_mid_run_fallback() -> bool:
+    def check_mid_run_fallback() -> str:
         """After a failed stage on the default (TPU) backend, re-probe it;
         a chip that died MID-run (the BENCH_r01 kernel-fault mode) would
         otherwise burn every later stage's full timeout.  On a dead
         re-probe the remaining stages switch to the sanitized CPU
-        environment so a recorded number still exists.  Returns True only
-        on the fresh transition (the caller's cue to retry the failed
-        stage once on CPU)."""
+        environment so a recorded number still exists.
+
+        Returns "transitioned" on that fresh TPU->CPU switch (the
+        caller's cue to retry the failed stage once on CPU), "alive"
+        when the re-probe CONFIRMED the backend is healthy (the caller
+        may treat the failure as transient), and "unprobed" when no
+        probe ran (already on fallback, or not enough budget for a
+        meaningful probe — backend init can take up to PROBE_TIMEOUT,
+        and a clamped 5s probe would declare a healthy chip dead)."""
         nonlocal env, fallback
-        if fallback:
-            return False
-        if orch.remaining() < 75:
-            # Not enough budget for a meaningful probe (backend init can
-            # take up to PROBE_TIMEOUT): a clamped 5s probe would declare
-            # a healthy chip dead on a budget-exhaustion timeout.
-            return False
+        if fallback or orch.remaining() < 75:
+            return "unprobed"
         reprobe = orch.run_child("probe", [], env, 60)
         if "error" not in reprobe:
-            return False
+            return "alive"
         print("bench: default backend died mid-run; switching remaining "
               "stages to CPU", file=sys.stderr)
         payload["mid_run_fallback"] = reprobe["error"]
         env = _sanitized_env()
         fallback = True
         payload["fallback_cpu"] = True
-        return True
+        return "transitioned"
+
+    def retry_transient(probe_state: str, result: dict, rerun, label: str) -> dict:
+        """One same-env retry for a stage that died on a CONFIRMED-alive
+        backend: the axon relay is known to drop a remote_compile
+        mid-flight (observed: the 10kx5k rung died exactly this way
+        while the very next standalone run recorded 25M pairs/s).
+        Retries ONLY when the re-probe actually ran and said alive —
+        never against a wedged or unprobed tunnel — and never for
+        timeouts (a too-slow shape stays too slow and would just burn
+        another stage cap)."""
+        if probe_state != "alive" or "timeout" in result.get("error", ""):
+            return result
+        if orch.remaining() < 60:
+            return result
+        print(
+            f"bench: {label} failed transiently on a live backend; "
+            "retrying once",
+            file=sys.stderr,
+        )
+        retry = rerun()
+        return retry if "error" not in retry else result
 
     def run_rung_stage(n_pods: int, n_nodes: int, slice_pods: int = 0) -> None:
         key = f"{n_pods}x{n_nodes}"
@@ -560,15 +582,24 @@ def main() -> None:
         if slice_pods:
             extra += ["--slice-pods", str(slice_pods)]
         result = orch.run_child("rung", extra, env, cap)
-        if "error" in result and check_mid_run_fallback():
-            # Fresh transition only: retry once in the sanitized env —
-            # CPU-sized rungs as-is, bigger shapes sliced (a run that was
-            # ALWAYS on CPU gains nothing from an identical retry).
-            retry_extra = list(extra)
-            if (n_pods, n_nodes) not in CPU_LADDER and not slice_pods:
-                retry_extra += ["--slice-pods", str(CPU_SLICE_PODS)]
-            retry = orch.run_child("rung", retry_extra, env, CPU_RUNG_TIMEOUT)
-            result = retry if "error" not in retry else result
+        if "error" in result:
+            state = check_mid_run_fallback()
+            if state == "transitioned":
+                # Fresh transition only: retry once in the sanitized env —
+                # CPU-sized rungs as-is, bigger shapes sliced (a run that
+                # was ALWAYS on CPU gains nothing from an identical retry).
+                retry_extra = list(extra)
+                if (n_pods, n_nodes) not in CPU_LADDER and not slice_pods:
+                    retry_extra += ["--slice-pods", str(CPU_SLICE_PODS)]
+                retry = orch.run_child("rung", retry_extra, env, CPU_RUNG_TIMEOUT)
+                result = retry if "error" not in retry else result
+            else:
+                result = retry_transient(
+                    state,
+                    result,
+                    lambda: orch.run_child("rung", extra, env, cap),
+                    f"rung {key}",
+                )
         payload["rungs"][key] = result
         orch.flush_partial()
 
@@ -600,15 +631,24 @@ def main() -> None:
             )
 
         result = launch(churn_events, churn_nodes)
-        if "error" in result and check_mid_run_fallback():
-            # Chip died during churn: one CPU retry at the same reduced
-            # size the planned-fallback path uses, so the config-5 record
-            # exists.
-            retry = launch(
-                min(churn_events, CPU_CHURN_CAP[0]),
-                min(churn_nodes, CPU_CHURN_CAP[1]),
-            )
-            result = retry if "error" not in retry else result
+        if "error" in result:
+            state = check_mid_run_fallback()
+            if state == "transitioned":
+                # Chip died during churn: one CPU retry at the same
+                # reduced size the planned-fallback path uses, so the
+                # config-5 record exists.
+                retry = launch(
+                    min(churn_events, CPU_CHURN_CAP[0]),
+                    min(churn_nodes, CPU_CHURN_CAP[1]),
+                )
+                result = retry if "error" not in retry else result
+            else:
+                result = retry_transient(
+                    state,
+                    result,
+                    lambda: launch(churn_events, churn_nodes),
+                    "churn",
+                )
         payload["rungs"]["churn"] = result
         orch.flush_partial()
 
