@@ -570,3 +570,89 @@ SPREAD_MATCH_LABEL_KEYS_EXPECT = {
     "with": {"a1": False, "b1": True},
     "without": {"a1": True, "b1": False},
 }
+
+
+# ---------------------------------------------------------------------------
+# NodeResourcesFit scoring strategy: LeastAllocated with CUSTOM weights
+# (resource_allocation.go score): per resource with allocatable > 0,
+#   nodeScore += leastRequestedScore * weight; weightSum += weight;
+# resources the NODE lacks are skipped entirely (alloc == 0 -> continue,
+# weight NOT counted); final = nodeScore // weightSum (int64 division).
+# Hand-derived, never from the oracle.
+# ---------------------------------------------------------------------------
+
+LEAST_ALLOCATED_WEIGHTED_CASES = [
+    {
+        # cpu (4000-3000)*100//4000 = 25; mem (10000-5000)*100//10000 = 50
+        # weights cpu=3, mem=1: (25*3 + 50*1) // 4 = 125 // 4 = 31
+        "name": "weighted-3-1",
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 3000,
+        "pod_mem": 5000,
+        "weights": (("cpu", 3), ("memory", 1)),
+        "want": 31,
+    },
+    {
+        # The node has NO example.com/gpu allocatable: that resource is
+        # skipped and its weight 5 never enters the weight sum.
+        # cpu (4000-1000)*100//4000 = 75; mem (10000-2000)*100//10000 = 80
+        # (75*1 + 80*1) // 2 = 77   (NOT (75+80)//7 = 22)
+        "name": "missing-resource-weight-excluded",
+        "node_cpu_milli": 4000,
+        "node_mem": 10000,
+        "pod_cpu_milli": 1000,
+        "pod_mem": 2000,
+        "weights": (("cpu", 1), ("memory", 1), ("example.com/gpu", 5)),
+        "want": 77,
+    },
+]
+
+# ---------------------------------------------------------------------------
+# TaintToleration: NoExecute taints filter at SCHEDULING time too
+# (taint_toleration.go Filter uses FindMatchingUntoleratedTaint over
+# NoSchedule AND NoExecute; tolerationSeconds only matters to eviction,
+# never to the scheduling-time match — tolerations.go
+# TolerationsTolerateTaint ignores it).
+# ---------------------------------------------------------------------------
+
+NO_EXECUTE_TAINT = {"key": "maint", "value": "now", "effect": "NoExecute"}
+# Exact upstream reason (taint_toleration.go errReasonNotMatch format).
+NO_EXECUTE_REASON = "node(s) had untolerated taint {maint: now}"
+# A toleration whose tolerationSeconds would evict after 300s still
+# ADMITS the pod at scheduling time.
+NO_EXECUTE_TOLERATION = {
+    "key": "maint",
+    "operator": "Equal",
+    "value": "now",
+    "effect": "NoExecute",
+    "tolerationSeconds": 300,
+}
+
+
+# ---------------------------------------------------------------------------
+# BalancedAllocation over THREE configured resources
+# (balanced_allocation.go balancedResourceScorer with
+#  NodeResourcesBalancedAllocationArgs.resources adding an extended
+#  resource): fractions f_r = requested/allocatable; mean over the
+#  configured set; std = sqrt(sum((f - mean)^2) / len); score =
+#  int((1 - std) * 100) in float64.
+#
+# Hand-derived (all fractions exact in binary):
+#   f_cpu = 3000/4000 = 0.75, f_mem = 5000/10000 = 0.5, f_gpu = 1/4 = 0.25
+#   mean = 0.5; deviations (0.25, 0, -0.25); sum sq = 0.125
+#   std = sqrt(0.125/3) = sqrt(0.04166666666666666...)
+#       = 0.20412414523193148 (float64)
+#   (1 - std) * 100 = 79.58758547680685 -> int -> 79
+# ---------------------------------------------------------------------------
+
+BALANCED_THREE_RESOURCE_CASE = {
+    "node_cpu_milli": 4000,
+    "node_mem": 10000,
+    "node_gpu": 4,
+    "pod_cpu_milli": 3000,
+    "pod_mem": 5000,
+    "pod_gpu": 1,
+    "resources": ("cpu", "memory", "example.com/gpu"),
+    "want": 79,
+}

@@ -482,3 +482,76 @@ def test_default_spread_selector_owner_kinds():
         "spec": {"selector": {"app": "web"}},
     }
     assert default_spread_selector(pod, services=[other]) is None
+
+
+@pytest.mark.parametrize(
+    "case", fx.LEAST_ALLOCATED_WEIGHTED_CASES, ids=lambda c: c["name"]
+)
+def test_least_allocated_weighted_fixture(case):
+    """LeastAllocated with CUSTOM per-resource weights through a real
+    scoringStrategy profile — including a weight on a resource the node
+    lacks, which upstream skips entirely (weight excluded from the
+    sum).  Hand-derived expectations, oracle and kernel both checked."""
+    nodes, pod = _strategy_cluster(case)
+    if any(r not in ("cpu", "memory") for r, _w in case["weights"]):
+        # Force the extended resource INTO the resource axis via a
+        # second node that allocates it: the kernel's per-node
+        # zero-allocatable weight exclusion (has = c > 0) is only a
+        # real branch when the resource exists on the axis — without
+        # this node the featurizer never tracks it and the kernel
+        # check would be vacuously a cpu/memory case.
+        nodes = nodes + [
+            make_node("n-gpu", cpu="1", extra_alloc={"example.com/gpu": "8"})
+        ]
+    infos = oracle.build_node_infos(nodes, [])
+    assert (
+        oracle.least_allocated_score(pod, infos[0], resources=case["weights"])
+        == case["want"]
+    )
+    prof = _strategy_profile(case, "LeastAllocated")
+    _feats, res = _prof_engine(prof, nodes, [], [pod])
+    si = res.plugin_names.index("NodeResourcesFit")
+    assert int(res.scores[0, si, 0]) == case["want"]
+
+
+def test_balanced_allocation_three_resource_fixture():
+    """BalancedAllocationArgs.resources with an extended resource: the
+    std-dev runs over THREE fractions (hand-derived float64 math), not
+    the default cpu/memory pair."""
+    case = fx.BALANCED_THREE_RESOURCE_CASE
+    node = make_node(
+        "n0",
+        cpu=f"{case['node_cpu_milli']}m",
+        memory=str(case["node_mem"]),
+        extra_alloc={"example.com/gpu": str(case["node_gpu"])},
+    )
+    pod = make_pod(
+        "p0",
+        cpu=f"{case['pod_cpu_milli']}m",
+        memory=str(case["pod_mem"]),
+        extra_requests={"example.com/gpu": str(case["pod_gpu"])},
+    )
+    prof = compile_profile(
+        {
+            "pluginConfig": [
+                {
+                    "name": "NodeResourcesBalancedAllocation",
+                    "args": {
+                        "resources": [
+                            {"name": r, "weight": 1} for r in case["resources"]
+                        ]
+                    },
+                }
+            ]
+        }
+    )
+    infos = oracle.build_node_infos([node], [])
+    assert (
+        oracle.balanced_allocation_score(
+            pod, infos[0], resources=case["resources"]
+        )
+        == case["want"]
+    )
+    _feats, res = _prof_engine(prof, [node], [], [pod])
+    si = res.plugin_names.index("NodeResourcesBalancedAllocation")
+    assert int(res.scores[0, si, 0]) == case["want"]

@@ -884,3 +884,47 @@ def test_spread_match_label_keys_fixture():
             topology_spread_constraints=[_policy_spread_con(**over)],
         )
         _assert_spread_violations(nodes, bound, pod, expect)
+
+
+def test_no_execute_taint_filter_fixture():
+    """NoExecute taints reject at SCHEDULING time exactly like
+    NoSchedule (taint_toleration.go Filter), with the upstream reason
+    string; a toleration carrying tolerationSeconds still admits the
+    pod (the seconds only govern eviction).  NoExecute is not
+    PreferNoSchedule, so the score side sees zero soft taints and
+    normalizes every node to 100."""
+    nodes = [
+        make_node("evicting", taints=[dict(fx.NO_EXECUTE_TAINT)]),
+        make_node("clean"),
+    ]
+    plain = make_pod("plain")
+    timed = make_pod("timed", tolerations=[dict(fx.NO_EXECUTE_TOLERATION)])
+    feats, res = _engine_result(nodes, [], [plain, timed])
+    fi = res.filter_plugin_names.index("TaintToleration")
+
+    assert int(res.reason_bits[0, fi, 0]) != 0  # plain vs evicting
+    assert int(res.reason_bits[0, fi, 1]) == 0  # plain vs clean
+    # Exact upstream failure message through the kernel's reason decode.
+    plugin = next(
+        sp.plugin
+        for sp in default_plugins(feats)
+        if sp.plugin.name == "TaintToleration"
+    )
+    assert plugin.decode_reasons(int(res.reason_bits[0, fi, 0])) == [
+        fx.NO_EXECUTE_REASON
+    ]
+
+    # tolerationSeconds does not weaken the scheduling-time match.
+    assert int(res.reason_bits[1, fi, 0]) == 0
+    assert int(res.reason_bits[1, fi, 1]) == 0
+
+    # Score: no PreferNoSchedule taints anywhere -> normalized 100 on
+    # every FEASIBLE cell (DefaultNormalizeScore all-100 branch).
+    # Upstream only defines scores for nodes that passed filtering, so
+    # the filtered (plain, evicting) cell is deliberately unasserted.
+    si = res.plugin_names.index("TaintToleration")
+    weight = 3  # upstream default-profile weight
+    for pi in range(2):
+        for ni in range(2):
+            if int(res.reason_bits[pi, fi, ni]) == 0:
+                assert int(res.final_scores[pi, si, ni]) == 100 * weight
