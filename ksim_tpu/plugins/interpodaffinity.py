@@ -201,14 +201,26 @@ class InterPodAffinity:
             diff = mx - mn
             # Go: fScore = float64(MaxNodeScore) * (float64(s-min) /
             # float64(diff)); int64(fScore) truncates (values >= 0 ->
-            # floor).  Division first.  float64 under x64 (exact vs the
-            # float64 oracle/upstream); float32 on TPU (documented +-1
-            # rounding tolerance at exact-integer ratio boundaries, same
-            # caveat as PodTopologySpread.score).
+            # floor).  The ratio is of int32s, so the floor is computed in
+            # INTEGER space: (100*(s-mn)) // diff is bit-identical to the
+            # float64 result whenever 100*(s-mn) fits int32 (a raw score
+            # span > ~21M — far beyond real clusters) and, unlike a float
+            # division, identical on every XLA backend.  TPU's approximate
+            # float32 divide truncated exact integer ratios one ulp low
+            # (100*3166/3166 -> 99), the root cause of BENCH_r04's 199-pod
+            # f32 churn drift vs CPU.  Out-of-range spans fall back to the
+            # old float path (f64 under x64 — exact; f32 otherwise, with
+            # the documented +-1 boundary tolerance).
+            shifted = scores - mn  # >= 0 on ok nodes (mn is their min)
+            in_range = shifted < big // MAX_NODE_SCORE
+            val_int = (
+                jnp.where(in_range, shifted, 0) * MAX_NODE_SCORE
+            ) // jnp.maximum(diff, 1)
             ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-            ratio = (scores - mn).astype(ftype) / jnp.maximum(diff, 1).astype(ftype)
-            val = jnp.floor(ftype(MAX_NODE_SCORE) * ratio)
-            out = jnp.where(diff > 0, val, 0.0)
+            ratio = shifted.astype(ftype) / jnp.maximum(diff, 1).astype(ftype)
+            val_f = jnp.floor(ftype(MAX_NODE_SCORE) * ratio).astype(jnp.int32)
+            val = jnp.where(in_range, val_int, val_f)
+            out = jnp.where(diff > 0, val, 0)
             return jnp.where(ok, out, 0).astype(jnp.int32)
 
         # All-zero raw scores normalize to all zeros (diff == 0 branch);

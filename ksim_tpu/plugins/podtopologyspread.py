@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ksim_tpu.plugins.base import MAX_NODE_SCORE, FilterOutput, NodeStateView, PodView
 from ksim_tpu.plugins.nodeaffinity import required_affinity_match
@@ -337,12 +338,40 @@ class PodTopologySpread:
             )
 
             ft = _ftype()
-            tp_weight = jnp.log(dom_num.astype(ft) + 2.0)  # [MC]
+            if jax.config.jax_enable_x64:
+                # Exact mode: f64 log, bit-exact vs the oracle (verified
+                # on real TPU by tests/tpu_parity_main.py).
+                tp_weight = jnp.log(dom_num.astype(ft) + 2.0)  # [MC]
+            else:
+                # f32 fast mode: platform-deterministic by construction.
+                # Backend log implementations differ in ulps (an f32
+                # log on TPU vs CPU flipped this round() for raw 1244
+                # vs 1243 — the 50k churn drift after the IPA fix), so
+                # the weight comes from a trace-time table of
+                # float32(log(k+2)) over the integer domain counts
+                # (dom_num <= padded N), computed in f64 on the host —
+                # a compiled constant, identical on every backend.
+                n_nodes = aux["spread"]["node_ldom"].shape[0]
+                table = jnp.asarray(
+                    np.log(np.arange(n_nodes + 1, dtype=np.float64) + 2.0).astype(
+                        np.float32
+                    )
+                )
+                tp_weight = table[jnp.clip(dom_num, 0, n_nodes)]  # [MC]
             contrib = seg_at.astype(ft) * tp_weight[None, :] + (
                 con["max_skew"].astype(ft)[None, :] - 1.0
             )
             gate = active[None, :] & filtered[:, None]
-            total = jnp.sum(jnp.where(gate, contrib, 0.0), axis=1)
+            vals = jnp.where(gate, contrib, 0.0)
+            if jax.config.jax_enable_x64:
+                total = jnp.sum(vals, axis=1)
+            else:
+                # Fixed-order unrolled MC reduce: IEEE f32 multiply/add
+                # are correctly rounded everywhere, but reduce
+                # association order is backend-chosen.
+                total = vals[:, 0]
+                for k in range(1, vals.shape[1]):
+                    total = total + vals[:, k]
             return jnp.round(total).astype(jnp.int32)
 
         # Upstream's PreScore Skip: no ScheduleAnyway constraints ->

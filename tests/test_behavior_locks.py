@@ -1,0 +1,57 @@
+"""The repo's churn behavior locks, asserted in-suite (round-5 verdict #1).
+
+The flagship replay's counts (seed 0, 2000 nodes — repo CLAUDE.md) were
+previously enforced only by bench discipline: a parity regression (the
+class the locks exist to catch) would fail only if someone re-ran the
+bench and eyeballed the counts.  BENCH_r04.json proved the gap — its TPU
+churn recorded 52582/42840 against the 52781/42829 lock and nothing
+noticed, because the f32 fast mode diverged ACROSS PLATFORMS (TPU's
+approximate f32 divide truncated exact integer ratios one ulp low in
+InterPodAffinity's normalize, and backend f32 log ulps flipped
+PodTopologySpread's round()).  Both kernels are now platform-
+deterministic by construction (integer normalize floor; trace-time log
+table + fixed-order reduce), so ONE set of counts is the contract on
+every backend, in both modes — these tests pin the 6k prefix (~15 s,
+the 50k run is bench-tier) exactly as the bench runs it
+(ScenarioRunner(max_pods_per_pass=1024, pod_bucket_min=128),
+ops_per_step=100; bench.py child_churn).
+
+Reference intent: replay parity is the product metric — recorded
+results as ground truth (storereflector.go:78-146).
+"""
+
+import jax
+import pytest
+
+from ksim_tpu.scenario import ScenarioRunner, churn_scenario
+
+# seed 0, 2000 nodes, 6000 events -> applied events include the step
+# padding the generator emits (6430), and the scheduling outcomes are
+# the locked prefix of the 50k flagship replay (50k locks: 52781/42829).
+LOCK_SCHEDULED = 2524
+LOCK_UNSCHEDULABLE = 471
+LOCK_EVENTS = 6430
+
+
+def _run_locked_churn() -> tuple[int, int, int]:
+    runner = ScenarioRunner(max_pods_per_pass=1024, pod_bucket_min=128)
+    res = runner.run(
+        churn_scenario(0, n_nodes=2000, n_events=6000, ops_per_step=100)
+    )
+    return res.pods_scheduled, res.unschedulable_attempts, res.events_applied
+
+
+@pytest.mark.parametrize("x64", [False, True], ids=["f32-fast", "exact-x64"])
+def test_churn_lock_6k_seed0(x64):
+    """Both modes land on identical counts (exact mode has always been
+    platform-identical; f32 now is too — drift here means a scoring-path
+    behavior change that MUST be deliberate and re-baselined, see
+    docs/churn_floor.md)."""
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", x64)
+    try:
+        scheduled, unschedulable, events = _run_locked_churn()
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+    assert events == LOCK_EVENTS
+    assert (scheduled, unschedulable) == (LOCK_SCHEDULED, LOCK_UNSCHEDULABLE)
