@@ -97,11 +97,25 @@ class ScenarioRunner:
 
     # -- one operation ------------------------------------------------------
 
+    @staticmethod
+    def _own(obj: JSON) -> JSON:
+        """Hand an operation's object to the store without a deepcopy
+        (two per create were ~11% of the 50k churn replay).  Only the
+        top level and metadata are copied: the store writes rv/uid/
+        namespace into metadata, and a same-ops-list replay must not see
+        the previous run's values.  Nested structures are shared — safe
+        under the store's replace-on-write contract (nothing mutates
+        them in place)."""
+        out = dict(obj)
+        md = out.get("metadata")
+        out["metadata"] = dict(md) if isinstance(md, dict) else {}
+        return out
+
     def _apply(self, op: Operation) -> None:
         if op.op == "create":
-            self.store.create(op.kind, op.obj)
+            self.store.create(op.kind, self._own(op.obj), copy_obj=False)
         elif op.op == "update":
-            self.store.update(op.kind, op.obj)
+            self.store.update(op.kind, self._own(op.obj), copy_obj=False)
         elif op.op == "patch":
             # KEP-140 PatchOperation: RFC 7386 merge patch (scenario/spec.py).
             # Object identity is immutable under patch, like the apiserver:
@@ -125,7 +139,9 @@ class ScenarioRunner:
                 obj.clear()
                 obj.update(merged)
 
-            self.store.patch(op.kind, op.name, op.namespace, apply_merge)
+            self.store.patch(
+                op.kind, op.name, op.namespace, apply_merge, copy_ret=False
+            )
         elif op.op == "delete":
             if op.kind == "nodes" and self._requeue:
                 # Deferred: run() re-queues all drained nodes' pods in ONE
@@ -146,18 +162,18 @@ class ScenarioRunner:
             obj["spec"].pop("nodeName", None)
             obj.get("status", {}).pop("phase", None)
 
-        # The store's nodeName partition bounds the walk to bound pods
-        # (the original full-list walk matched only those anyway); the
+        # The store's nodeName bucket index bounds the walk to pods ON
+        # the drained nodes (the earlier bound-side walk still scanned
+        # every bound pod per drain — ~10s of the 50k replay); the
         # matches sort by (name, "ns/name") — exactly list("pods")'s
         # (name, key) order — so patches apply (and consume
         # resourceVersions) in the same order the full walk produced.
         hit = [
             (name_of(p), f"{namespace_of(p) or 'default'}/{name_of(p)}", namespace_of(p))
-            for p in self.store.pods_with_node()
-            if p.get("spec", {}).get("nodeName") in node_names
+            for p in self.store.pods_on_nodes(node_names)
         ]
         for name, _key, ns in sorted(hit):
-            self.store.patch("pods", name, ns, clear)
+            self.store.patch("pods", name, ns, clear, copy_ret=False)
 
     # -- replay -------------------------------------------------------------
 

