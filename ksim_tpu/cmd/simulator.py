@@ -82,6 +82,20 @@ def start_simulator(argv: list[str] | None = None) -> int:
         else:
             syncer = Syncer(sync_source, di.store).run()
 
+    writeback = None
+    if kube_source is not None and syncer is not None:
+        # Continuous sync only: one-shot import leaves a frozen snapshot,
+        # and binding a live cluster from stale state would race every
+        # real controller on it.
+        from ksim_tpu.syncer.writeback import LiveWriteBack, writeback_enabled
+
+        if writeback_enabled():
+            # Opt-in live scheduling: push binds + result annotations back
+            # to the real cluster (the reference's debuggable-scheduler
+            # promise, docs/debuggable-scheduler.md:64).
+            writeback = LiveWriteBack(kube_source, di.store).start()
+            logger.info("live write-back enabled (KSIM_ALLOW_LIVE_WRITEBACK=1)")
+
     if args.profile_dir:
         di.scheduler_service.start_profiling(args.profile_dir)
     di.scheduler_service.start()
@@ -106,6 +120,8 @@ def start_simulator(argv: list[str] | None = None) -> int:
     finally:
         server.shutdown_server()
         di.scheduler_service.stop_profiling()
+        if writeback is not None:
+            writeback.stop()
         if syncer is not None:
             syncer.stop()
         if kube_source is not None:

@@ -53,6 +53,16 @@ from ksim_tpu.state.selectors import match_label_selector
 
 logger = logging.getLogger(__name__)
 
+
+class KubeApiError(SimulatorError):
+    """A kube-apiserver request failure; ``code`` is the HTTP status
+    (0 for transport errors) so callers can branch on 404/409."""
+
+    def __init__(self, message: str, *, code: int = 0) -> None:
+        super().__init__(message)
+        self.code = code
+
+
 # kind -> (API path prefix, List kind name).  All lists are cluster-wide
 # (the reference's dynamic informer factory watches every namespace).
 _API_PATHS: dict[str, str] = {
@@ -374,6 +384,92 @@ class KubeApiSource:
                 raise SimulatorError(f"GET {path}: HTTP {e.code}: {body[:200]}") from None
             except (urllib.error.URLError, OSError, ssl.SSLError) as e:
                 raise SimulatorError(f"GET {path}: {e}") from None
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: JSON | None = None,
+        *,
+        content_type: str = "application/json",
+        timeout: float | None = None,
+    ) -> JSON:
+        """One non-streaming request with the same auth-refresh/401-retry
+        protocol as ``_open``.  Raises KubeApiError carrying the HTTP
+        status so callers can branch on 404/409."""
+        url = self._server + path
+        data = None if body is None else json.dumps(body).encode()
+        self._maybe_refresh_auth()
+        for attempt in (0, 1):
+            headers = dict(self._headers)
+            if data is not None:
+                headers["Content-Type"] = content_type
+            req = urllib.request.Request(url, data=data, headers=headers, method=method)
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=timeout or self._timeout, context=self._ssl
+                ) as resp:
+                    raw = resp.read()
+                    return json.loads(raw) if raw else {}
+            except urllib.error.HTTPError as e:
+                if e.code == 401 and attempt == 0 and self._headers_refresh is not None:
+                    self._maybe_refresh_auth(force=True)
+                    continue
+                detail = e.read(4096).decode(errors="replace")
+                raise KubeApiError(
+                    f"{method} {path}: HTTP {e.code}: {detail[:200]}", code=e.code
+                ) from None
+            except (urllib.error.URLError, OSError, ssl.SSLError) as e:
+                raise KubeApiError(f"{method} {path}: {e}") from None
+
+    # -- write verbs (live scheduling write-back) ----------------------------
+    #
+    # The reference's debuggable scheduler binds REAL pods through its
+    # clientset and its store reflector writes the result annotations back
+    # onto them with get-latest + update + conflict retry
+    # (reference simulator/pkg/debuggablescheduler/debuggable_scheduler.go:
+    # 157-173, scheduler/storereflector/storereflector.go:78-146).
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        """POST the binding subresource — exactly what upstream's
+        DefaultBinder does.  An already-bound pod answers 409; callers
+        treat that as someone-else-bound."""
+        ns = namespace or "default"
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{ns}/pods/{name}/binding",
+            {
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {"name": name, "namespace": ns},
+                "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
+            },
+        )
+
+    def patch_pod_annotations(
+        self, namespace: str, name: str, annotations: dict[str, str], *, attempts: int = 4
+    ) -> None:
+        """Merge-patch result annotations onto a live pod.  RFC 7386
+        merges ``metadata.annotations`` key-wise, so only our keys are
+        written — the reference's get+update achieves the same effect
+        with an explicit conflict retry (storereflector.go:116-136);
+        merge patches rarely conflict, but a concurrent full-object
+        writer can still 409, hence the bounded retry."""
+        ns = namespace or "default"
+        body = {"metadata": {"annotations": dict(annotations)}}
+        for attempt in range(attempts):
+            try:
+                self._request(
+                    "PATCH",
+                    f"/api/v1/namespaces/{ns}/pods/{name}",
+                    body,
+                    content_type="application/merge-patch+json",
+                )
+                return
+            except KubeApiError as e:
+                if e.code != 409 or attempt == attempts - 1:
+                    raise
+                time.sleep(min(0.1 * 2**attempt, 1.0))
 
     # -- SourceCluster -------------------------------------------------------
 

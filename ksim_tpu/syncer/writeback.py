@@ -1,0 +1,207 @@
+"""Live-cluster write-back: the store reflector's apiserver side.
+
+The reference's headline SDK promise is running the debuggable scheduler
+against a REAL cluster: its scheduler binds live pods through a clientset
+and the store reflector writes every recorded result back onto them as
+annotations (reference simulator/docs/debuggable-scheduler.md:64,
+pkg/debuggablescheduler/debuggable_scheduler.go:157-173,
+scheduler/storereflector/storereflector.go:78-146).
+
+ksim-tpu schedules a live cluster by composition: ``Syncer`` mirrors the
+apiserver into the in-memory store, ``SchedulerService`` schedules the
+mirror (in-store binds give the engine its sequential-commit semantics),
+and this module closes the loop — it subscribes to the STORE's watch
+stream (the same signal the reference's reflector takes from its pod
+informer) and pushes each scheduling outcome to the apiserver:
+
+- a pod that gained ``spec.nodeName`` is bound live via the binding
+  subresource (POST .../binding — upstream DefaultBinder's verb; 409
+  means someone else bound it first and is treated as settled);
+- recorded result annotations (the ``kube-scheduler-simulator.sigs.k8s.io/``
+  keys, including on UNSCHEDULABLE pods) are merge-patched onto the live
+  pod with bounded conflict retry.
+
+Termination is structural: the syncer's mandatory pod filter never
+mirrors updates to already-scheduled live pods (syncer.py _filter_pod,
+reference resource.go:103-123), so the authoritative MODIFIED our own
+writes produce cannot re-enter the store and re-trigger a push; a
+last-pushed cache additionally dedupes annotation-only churn.
+
+Opt-in: writing to a user's cluster is a side effect the simulator must
+never produce implicitly — gate on ``KSIM_ALLOW_LIVE_WRITEBACK=1`` (the
+same pattern as exec credential plugins), or construct LiveWriteBack
+explicitly in library use.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from ksim_tpu.state.cluster import ADDED, DELETED, MODIFIED, ClusterStore
+from ksim_tpu.state.resources import JSON, name_of, namespace_of
+from ksim_tpu.syncer.kubeapi import KubeApiError, KubeApiSource
+
+logger = logging.getLogger(__name__)
+
+RESULT_PREFIX = "kube-scheduler-simulator.sigs.k8s.io/"
+
+
+def writeback_enabled() -> bool:
+    return os.environ.get("KSIM_ALLOW_LIVE_WRITEBACK", "") == "1"
+
+
+class LiveWriteBack:
+    """Mirror scheduling outcomes from ``store`` onto the live cluster
+    behind ``source``.  One daemon thread; errors are logged and never
+    propagate into the scheduling loop (the reference's reflector
+    likewise only logs, storereflector.go:139-142)."""
+
+    #: transient-failure retry policy: a bind/patch that dies on a
+    #: non-404/409 error (apiserver blip) re-runs up to this many times
+    #: with linear backoff — without it the write would be lost forever,
+    #: because the syncer never re-mirrors scheduled pods (no future
+    #: store event retriggers the push) and the store would silently
+    #: diverge from the live cluster.
+    RETRY_ATTEMPTS = 5
+    RETRY_DELAY_S = 2.0
+
+    def __init__(self, source: KubeApiSource, store: ClusterStore) -> None:
+        self._source = source
+        self._store = store
+        self._stream = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # ns/name -> node already bound live; ns/name -> last annotation
+        # fingerprint pushed; ns/name set that 404ed (local-only pods —
+        # logged once, then ignored).
+        self._bound: dict[str, str] = {}
+        self._pushed: dict[str, int] = {}
+        self._missing: set[str] = set()
+        # (due_monotonic, etype, pod, attempt) pending transient retries.
+        self._retries: list[tuple[float, str, JSON, int]] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "LiveWriteBack":
+        # list_first replays current pods as ADDED — _handle uses the
+        # replay to SEED the bound/pushed caches (state that predates us
+        # is treated as settled; only MODIFIED events write).
+        self._stream = self._store.watch(("pods",), list_first=("pods",))
+        self._thread = threading.Thread(
+            target=self._run, name="live-writeback", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._stream is not None:
+            self._stream.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- event loop ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                event = self._stream.next(timeout=0.5)
+            except Exception:
+                if not self._stop.is_set():
+                    logger.exception("write-back watch failed; stopping")
+                return
+            if event is not None:
+                self._dispatch(event.event_type, event.obj, attempt=0)
+            # Due transient retries.
+            if self._retries:
+                now = time.monotonic()
+                due = [r for r in self._retries if r[0] <= now]
+                self._retries = [r for r in self._retries if r[0] > now]
+                for _t, etype, pod, attempt in due:
+                    self._dispatch(etype, pod, attempt=attempt)
+
+    def _dispatch(self, etype: str, pod: JSON, *, attempt: int) -> None:
+        try:
+            self._handle(etype, pod)
+        except Exception:
+            if attempt + 1 < self.RETRY_ATTEMPTS and not self._stop.is_set():
+                logger.warning(
+                    "write-back failed for pod %s (attempt %d/%d); will retry",
+                    name_of(pod), attempt + 1, self.RETRY_ATTEMPTS,
+                    exc_info=True,
+                )
+                self._retries.append(
+                    (
+                        time.monotonic() + self.RETRY_DELAY_S * (attempt + 1),
+                        etype,
+                        pod,
+                        attempt + 1,
+                    )
+                )
+            else:
+                logger.exception(
+                    "write-back PERMANENTLY failed for pod %s — the live "
+                    "cluster now diverges from the store for this pod",
+                    name_of(pod),
+                )
+
+    def _handle(self, etype: str, pod: JSON) -> None:
+        ns = namespace_of(pod) or "default"
+        key = f"{ns}/{name_of(pod)}"
+        if etype == DELETED:
+            self._bound.pop(key, None)
+            self._pushed.pop(key, None)
+            self._missing.discard(key)
+            return
+        if etype not in (ADDED, MODIFIED) or key in self._missing:
+            return
+        node = pod.get("spec", {}).get("nodeName") or ""
+        ann = {
+            k: v
+            for k, v in (pod.get("metadata", {}).get("annotations") or {}).items()
+            if k.startswith(RESULT_PREFIX)
+        }
+        if etype == ADDED:
+            # ADDED events are state that predates us: the startup
+            # list_first replay, or the syncer mirroring a live pod that
+            # is ALREADY bound/annotated.  Seed the caches instead of
+            # writing — a restart against a 5000-pod cluster must not
+            # fire 5000 guaranteed-409 binds and identity patches.  Our
+            # own scheduling outcomes always arrive as MODIFIED (the
+            # reference reflector likewise reacts to pod UPDATE events
+            # only, storereflector.go:78-80).
+            if node:
+                self._bound[key] = node
+            if ann:
+                self._pushed[key] = hash(tuple(sorted(ann.items())))
+            return
+        if not node and not ann:
+            return
+        try:
+            if node and self._bound.get(key) != node:
+                try:
+                    self._source.bind_pod(ns, name_of(pod), node)
+                except KubeApiError as e:
+                    if e.code == 409:
+                        # Already bound live (another scheduler, or a
+                        # previous life of this process): settled.
+                        logger.info("pod %s already bound live", key)
+                    else:
+                        raise
+                self._bound[key] = node
+            if ann:
+                fp = hash(tuple(sorted(ann.items())))
+                if self._pushed.get(key) != fp:
+                    self._source.patch_pod_annotations(ns, name_of(pod), ann)
+                    self._pushed[key] = fp
+        except KubeApiError as e:
+            if e.code == 404:
+                # Local-only pod (created through the simulator API, not
+                # present on the live cluster): nothing to write back.
+                logger.info("pod %s not on the live cluster; skipping", key)
+                self._missing.add(key)
+            else:
+                raise

@@ -12,6 +12,7 @@ from __future__ import annotations
 import base64
 import copy
 import json
+import re
 import sys
 import threading
 import time
@@ -37,6 +38,9 @@ class _ApiState:
         self.events: list[tuple[int, str, str, dict]] = []
         self.compacted = 0  # watches resuming from rv < compacted get 410
         self.generation = 0  # bump to force active watch handlers to close
+        self.binding_posts: list[tuple[str, str, str]] = []  # (ns, pod, node)
+        self.annotation_patches: list[tuple[str, str, dict]] = []  # (ns, pod, ann)
+        self.patch_conflicts_remaining = 0  # do_PATCH answers 409 while > 0
 
     def apply(self, kind: str, etype: str, obj: dict) -> None:
         with self.cond:
@@ -143,6 +147,71 @@ class _Handler(BaseHTTPRequestHandler):
             return True
         except (BrokenPipeError, ConnectionResetError):
             return False
+
+    # -- write verbs (the live write-back surface) --------------------------
+
+    def _read_body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(n)) if n else {}
+
+    def _send_json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self) -> None:
+        m = re.match(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding$", self.path)
+        if not m:
+            self.send_error(404)
+            return
+        ns, name = m.group(1), m.group(2)
+        body = self._read_body()
+        node = ((body.get("target") or {}).get("name")) or ""
+        st = self.state
+        with st.cond:
+            pod = st.objects["pods"].get(f"{ns}/{name}")
+            if pod is None:
+                self._send_json(404, {"kind": "Status", "code": 404})
+                return
+            if pod.get("spec", {}).get("nodeName"):
+                # Real apiserver: "pod X is already assigned to node Y".
+                self._send_json(409, {"kind": "Status", "code": 409})
+                return
+            st.binding_posts.append((ns, name, node))
+        new = copy.deepcopy(pod)
+        new.setdefault("spec", {})["nodeName"] = node
+        st.apply("pods", MODIFIED, new)
+        self._send_json(201, {"kind": "Status", "code": 201})
+
+    def do_PATCH(self) -> None:
+        m = re.match(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)$", self.path)
+        if not m or self.headers.get("Content-Type") != "application/merge-patch+json":
+            self.send_error(404)
+            return
+        ns, name = m.group(1), m.group(2)
+        patch = self._read_body()
+        st = self.state
+        with st.cond:
+            pod = st.objects["pods"].get(f"{ns}/{name}")
+            if pod is None:
+                self._send_json(404, {"kind": "Status", "code": 404})
+                return
+            if st.patch_conflicts_remaining > 0:
+                st.patch_conflicts_remaining -= 1
+                self._send_json(409, {"kind": "Status", "code": 409})
+                return
+        ann = (patch.get("metadata") or {}).get("annotations") or {}
+        new = copy.deepcopy(pod)
+        merged = dict(new.setdefault("metadata", {}).get("annotations") or {})
+        merged.update(ann)
+        new["metadata"]["annotations"] = merged
+        with st.cond:
+            st.annotation_patches.append((ns, name, dict(ann)))
+        st.apply("pods", MODIFIED, new)
+        self._send_json(200, new)
 
 
 @pytest.fixture()
@@ -511,3 +580,120 @@ def test_kubeconfig_exec_expiry_parsed(tmp_path, monkeypatch):
     fresh, expiry = cc["headers_refresh"]()
     assert fresh == {"Authorization": "Bearer tok"}
     assert expiry > time.time()
+
+
+def test_live_writeback_round_trip(apiserver):
+    """The round-5 verdict's acceptance test: a pod created on the (stub)
+    apiserver is scheduled by the engine and the stub then holds the BIND
+    (via the binding subresource) plus the recorded result annotations —
+    the reference's debuggable-scheduler-on-a-real-cluster flow
+    (debuggable_scheduler.go:157-173, storereflector.go:78-146)."""
+    from ksim_tpu.engine.annotations import ALL_RESULT_KEYS
+    from ksim_tpu.scheduler.service import SchedulerService
+    from ksim_tpu.syncer.writeback import LiveWriteBack
+
+    state, url = apiserver
+    state.apply("nodes", ADDED, make_node("n0", cpu="8", memory="16Gi"))
+    state.apply("pods", ADDED, make_pod("live-pod", cpu="1", memory="1Gi"))
+
+    src = KubeApiSource(url)
+    store = ClusterStore()
+    syncer = Syncer(src, store)
+    syncer.run()
+    wb = LiveWriteBack(src, store).start()
+    try:
+        _wait_for(
+            lambda: len(store.list("pods")) == 1 and len(store.list("nodes")) == 1,
+            msg="mirror sync",
+        )
+        svc = SchedulerService(store, record="full", preemption=False)
+        placements = svc.schedule_pending()
+        assert placements == {"default/live-pod": "n0"}
+
+        def bound_live():
+            pod = state.objects["pods"].get("default/live-pod")
+            return bool(pod and pod.get("spec", {}).get("nodeName") == "n0")
+
+        _wait_for(bound_live, msg="live bind")
+        assert ("default", "live-pod", "n0") in state.binding_posts
+
+        def annotated_live():
+            pod = state.objects["pods"].get("default/live-pod")
+            ann = (pod or {}).get("metadata", {}).get("annotations") or {}
+            return all(k in ann for k in ALL_RESULT_KEYS)
+
+        _wait_for(annotated_live, msg="live result annotations")
+        pod = state.objects["pods"]["default/live-pod"]
+        ann = pod["metadata"]["annotations"]
+        assert ann["kube-scheduler-simulator.sigs.k8s.io/selected-node"] == "n0"
+        # Unschedulable pods get annotation-only write-back (no bind).
+        state.apply(
+            "pods", ADDED, make_pod("too-big", cpu="100", memory="1Ti")
+        )
+        _wait_for(
+            lambda: any(
+                namespace_name == ("default", "too-big")
+                for namespace_name in (
+                    (ns, n) for ns, n, _ in state.annotation_patches
+                )
+            ) or len(store.list("pods")) == 2,
+            msg="second pod mirrored",
+        )
+        svc.schedule_pending()
+        _wait_for(
+            lambda: "kube-scheduler-simulator.sigs.k8s.io/filter-result"
+            in (
+                (state.objects["pods"].get("default/too-big") or {})
+                .get("metadata", {})
+                .get("annotations")
+                or {}
+            ),
+            msg="unschedulable annotations",
+        )
+        assert not state.objects["pods"]["default/too-big"]["spec"].get("nodeName")
+    finally:
+        wb.stop()
+        syncer.stop()
+        src.close()
+
+
+def test_bind_pod_conflict_and_patch_retry(apiserver):
+    """Direct write-verb semantics: binding an already-bound pod answers
+    409 (KubeApiError.code), and patching a missing pod answers 404."""
+    from ksim_tpu.syncer.kubeapi import KubeApiError
+
+    state, url = apiserver
+    bound = make_pod("pinned", cpu="1", memory="1Gi", node_name="n9")
+    state.apply("pods", ADDED, bound)
+    src = KubeApiSource(url)
+    with pytest.raises(KubeApiError) as e:
+        src.bind_pod("default", "pinned", "n0")
+    assert e.value.code == 409
+    with pytest.raises(KubeApiError) as e:
+        src.patch_pod_annotations("default", "nope", {"a/b": "c"})
+    assert e.value.code == 404
+    # Happy-path patch merges without clobbering existing annotations.
+    src.patch_pod_annotations("default", "pinned", {"x.io/k": "v"})
+    ann = state.objects["pods"]["default/pinned"]["metadata"]["annotations"]
+    assert ann["x.io/k"] == "v"
+
+
+def test_patch_retry_survives_conflicts_then_exhausts(apiserver):
+    """The 409 bounded-retry loop in patch_pod_annotations: conflicts
+    below the attempt budget succeed after retrying; a persistently
+    conflicting object exhausts the budget and raises code 409."""
+    from ksim_tpu.syncer.kubeapi import KubeApiError
+
+    state, url = apiserver
+    state.apply("pods", ADDED, make_pod("busy", cpu="1", memory="1Gi"))
+    src = KubeApiSource(url)
+    state.patch_conflicts_remaining = 2
+    src.patch_pod_annotations("default", "busy", {"x.io/k": "v1"})  # retries through
+    assert state.patch_conflicts_remaining == 0
+    ann = state.objects["pods"]["default/busy"]["metadata"]["annotations"]
+    assert ann["x.io/k"] == "v1"
+    state.patch_conflicts_remaining = 99
+    with pytest.raises(KubeApiError) as e:
+        src.patch_pod_annotations("default", "busy", {"x.io/k": "v2"})
+    assert e.value.code == 409
+    assert state.patch_conflicts_remaining == 99 - 4  # attempts budget
