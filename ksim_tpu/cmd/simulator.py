@@ -83,18 +83,27 @@ def start_simulator(argv: list[str] | None = None) -> int:
             syncer = Syncer(sync_source, di.store).run()
 
     writeback = None
-    if kube_source is not None and syncer is not None:
-        # Continuous sync only: one-shot import leaves a frozen snapshot,
-        # and binding a live cluster from stale state would race every
-        # real controller on it.
-        from ksim_tpu.syncer.writeback import LiveWriteBack, writeback_enabled
+    from ksim_tpu.syncer.writeback import LiveWriteBack, writeback_enabled
 
-        if writeback_enabled():
+    if writeback_enabled():
+        if kube_source is not None and syncer is not None:
             # Opt-in live scheduling: push binds + result annotations back
             # to the real cluster (the reference's debuggable-scheduler
             # promise, docs/debuggable-scheduler.md:64).
             writeback = LiveWriteBack(kube_source, di.store).start()
+            di.scheduler_service.add_eviction_listener(writeback.note_eviction)
             logger.info("live write-back enabled (KSIM_ALLOW_LIVE_WRITEBACK=1)")
+        else:
+            # Continuous sync only: one-shot import leaves a frozen
+            # snapshot, and binding a live cluster from stale state would
+            # race every real controller on it.  Say so loudly — a user
+            # who set the flag would otherwise only learn from the
+            # cluster staying untouched.
+            logger.warning(
+                "KSIM_ALLOW_LIVE_WRITEBACK=1 ignored: write-back needs "
+                "continuous kube sync (resourceSyncEnabled + kubeConfig), "
+                "not one-shot import or a snapshot file"
+            )
 
     if args.profile_dir:
         di.scheduler_service.start_profiling(args.profile_dir)

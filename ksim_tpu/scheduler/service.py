@@ -116,6 +116,12 @@ class SchedulerService:
         shard_mesh=None,
     ) -> None:
         self._store = store
+        # Preemption-eviction observers (add_eviction_listener): notified
+        # with (namespace, name) BEFORE a victim's store delete, so a
+        # live write-back can distinguish engine evictions (which must
+        # propagate to the real cluster) from reset/user deletes (which
+        # must never touch it).
+        self._eviction_listeners: list = []
         # Optional jax.sharding.Mesh: every engine this service builds is
         # laid out over it (node axis over "tp", engine/sharding.py).  The
         # sequential scan wants replicated pod rows — pass a dp=1 mesh
@@ -726,11 +732,28 @@ class SchedulerService:
             if selected is not None:
                 self._run_post_bind(plugins, updated, selected)
             for v in victims:
-                try:
-                    self._store.delete("pods", name_of(v), namespace_of(v))
-                except Exception:
-                    logger.exception("failed to evict victim %s", name_of(v))
+                self._evict_victim(v)
             placements[f"{namespace_of(pod)}/{name_of(pod)}"] = selected
+
+    def add_eviction_listener(self, fn) -> None:
+        """Register a (namespace, name) callback fired before each
+        preemption victim's store delete (see __init__ note)."""
+        self._eviction_listeners.append(fn)
+
+    def _evict_victim(self, v: JSON) -> None:
+        """Preemption eviction (the debuggable scheduler deletes victims
+        via the apiserver; KWOK terminates immediately).  Listeners run
+        FIRST so the store's DELETED event already carries its eviction
+        provenance when observers see it."""
+        for fn in self._eviction_listeners:
+            try:
+                fn(namespace_of(v) or "default", name_of(v))
+            except Exception:
+                logger.exception("eviction listener failed")
+        try:
+            self._store.delete("pods", name_of(v), namespace_of(v))
+        except Exception:
+            logger.exception("failed to evict victim %s", name_of(v))
 
     def _bind_results(self, queue, feats, plugins, res, placements, prof=None) -> None:
         render_ctx = RenderCtx(feats, plugins) if self._record == "full" else None
@@ -859,10 +882,7 @@ class SchedulerService:
             # the apiserver; KWOK terminates immediately).  The DELETED
             # events trigger the next pass, which schedules the preemptor.
             for v in victims:
-                try:
-                    self._store.delete("pods", name_of(v), namespace_of(v))
-                except Exception:
-                    logger.exception("failed to evict victim %s", name_of(v))
+                self._evict_victim(v)
             placements[f"{namespace_of(pod)}/{name_of(pod)}"] = node_name
 
     # -- host extension points (PreEnqueue/PostFilter/PreBind/Bind/PostBind) -

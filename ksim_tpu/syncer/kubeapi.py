@@ -430,6 +430,19 @@ class KubeApiSource:
     # (reference simulator/pkg/debuggablescheduler/debuggable_scheduler.go:
     # 157-173, scheduler/storereflector/storereflector.go:78-146).
 
+    def get_pod(self, namespace: str, name: str) -> JSON:
+        """The live pod object — used to reconcile a 409 on bind (learn
+        which node another scheduler actually chose)."""
+        ns = namespace or "default"
+        return self._request("GET", f"/api/v1/namespaces/{ns}/pods/{name}")
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        """DELETE a live pod — the write-back's eviction verb for
+        preemption victims (upstream preemption evicts via the pod
+        DELETE/eviction API)."""
+        ns = namespace or "default"
+        self._request("DELETE", f"/api/v1/namespaces/{ns}/pods/{name}")
+
     def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
         """POST the binding subresource — exactly what upstream's
         DefaultBinder does.  An already-bound pod answers 409; callers

@@ -80,8 +80,20 @@ class LiveWriteBack:
         self._bound: dict[str, str] = {}
         self._pushed: dict[str, int] = {}
         self._missing: set[str] = set()
+        # ns/name keys whose store delete is a PREEMPTION EVICTION
+        # (note_eviction, fed by SchedulerService.add_eviction_listener).
+        # Only these propagate as live deletes: a reset or a user delete
+        # through the simulator API must never remove real workloads.
+        # Keys stay until the live delete succeeds, so a transient
+        # failure's retry still knows to evict.
+        self._evictions: set[str] = set()
         # (due_monotonic, etype, pod, attempt) pending transient retries.
         self._retries: list[tuple[float, str, JSON, int]] = []
+
+    def note_eviction(self, namespace: str, name: str) -> None:
+        """Mark the next store delete of this pod as a preemption
+        eviction (wire via SchedulerService.add_eviction_listener)."""
+        self._evictions.add(f"{namespace or 'default'}/{name}")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -124,6 +136,19 @@ class LiveWriteBack:
                     self._dispatch(etype, pod, attempt=attempt)
 
     def _dispatch(self, etype: str, pod: JSON, *, attempt: int) -> None:
+        if attempt > 0 and etype != DELETED:
+            # Retry with the pod's CURRENT store state, not the snapshot
+            # captured at failure time — a newer pass may have pushed
+            # fresher annotations in between, and replaying the stale
+            # snapshot would overwrite them live and poison _pushed.
+            from ksim_tpu.errors import SimulatorError
+
+            try:
+                pod = self._store.get(
+                    "pods", name_of(pod), namespace_of(pod) or "default"
+                )
+            except SimulatorError:
+                return  # gone from the store: nothing left to push
         try:
             self._handle(etype, pod)
         except Exception:
@@ -155,6 +180,21 @@ class LiveWriteBack:
             self._bound.pop(key, None)
             self._pushed.pop(key, None)
             self._missing.discard(key)
+            if key in self._evictions:
+                # A preemption victim (note_eviction provenance) must be
+                # evicted live too — without it the node would carry both
+                # the victim and the preemptor (overcommit).  Any OTHER
+                # store delete (reset, user delete through the simulator
+                # API) never touches the real cluster.  The key leaves
+                # the set only on success/404, so a transient failure's
+                # retry still evicts.
+                try:
+                    self._source.delete_pod(ns, name_of(pod))
+                    logger.info("evicted live pod %s (preemption)", key)
+                except KubeApiError as e:
+                    if e.code != 404:
+                        raise
+                self._evictions.discard(key)
             return
         if etype not in (ADDED, MODIFIED) or key in self._missing:
             return
@@ -185,12 +225,23 @@ class LiveWriteBack:
                 try:
                     self._source.bind_pod(ns, name_of(pod), node)
                 except KubeApiError as e:
-                    if e.code == 409:
-                        # Already bound live (another scheduler, or a
-                        # previous life of this process): settled.
-                        logger.info("pod %s already bound live", key)
-                    else:
+                    if e.code != 409:
                         raise
+                    # Another scheduler bound it first (or a previous
+                    # life of this process did).  Learn the REAL node —
+                    # pushing result annotations that name OUR node onto
+                    # a pod running elsewhere would be authoritative-
+                    # looking misinformation.
+                    live = self._source.get_pod(ns, name_of(pod))
+                    real = live.get("spec", {}).get("nodeName") or ""
+                    self._bound[key] = real
+                    if real != node:
+                        logger.warning(
+                            "pod %s bound live to %s, not our %s; "
+                            "skipping result annotations",
+                            key, real or "<none>", node,
+                        )
+                        return
                 self._bound[key] = node
             if ann:
                 fp = hash(tuple(sorted(ann.items())))

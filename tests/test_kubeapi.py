@@ -41,6 +41,7 @@ class _ApiState:
         self.binding_posts: list[tuple[str, str, str]] = []  # (ns, pod, node)
         self.annotation_patches: list[tuple[str, str, dict]] = []  # (ns, pod, ann)
         self.patch_conflicts_remaining = 0  # do_PATCH answers 409 while > 0
+        self.pod_deletes: list[tuple[str, str]] = []  # (ns, pod)
 
     def apply(self, kind: str, etype: str, obj: dict) -> None:
         with self.cond:
@@ -86,6 +87,15 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urllib.parse.urlparse(self.path)
         kind = _PATH_KINDS.get(parsed.path)
         if kind is None:
+            m = re.match(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)$", parsed.path)
+            if m:
+                with self.state.cond:
+                    pod = self.state.objects["pods"].get(f"{m.group(1)}/{m.group(2)}")
+                if pod is None:
+                    self._send_json(404, {"kind": "Status", "code": 404})
+                else:
+                    self._send_json(200, pod)
+                return
             self.send_error(404)
             return
         q = dict(urllib.parse.parse_qsl(parsed.query))
@@ -185,6 +195,22 @@ class _Handler(BaseHTTPRequestHandler):
         new.setdefault("spec", {})["nodeName"] = node
         st.apply("pods", MODIFIED, new)
         self._send_json(201, {"kind": "Status", "code": 201})
+
+    def do_DELETE(self) -> None:
+        m = re.match(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)$", self.path)
+        if not m:
+            self.send_error(404)
+            return
+        ns, name = m.group(1), m.group(2)
+        st = self.state
+        with st.cond:
+            pod = st.objects["pods"].get(f"{ns}/{name}")
+            if pod is None:
+                self._send_json(404, {"kind": "Status", "code": 404})
+                return
+            st.pod_deletes.append((ns, name))
+        st.apply("pods", DELETED, pod)
+        self._send_json(200, {"kind": "Status", "code": 200})
 
     def do_PATCH(self) -> None:
         m = re.match(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)$", self.path)
@@ -697,3 +723,106 @@ def test_patch_retry_survives_conflicts_then_exhausts(apiserver):
         src.patch_pod_annotations("default", "busy", {"x.io/k": "v2"})
     assert e.value.code == 409
     assert state.patch_conflicts_remaining == 99 - 4  # attempts budget
+
+
+def test_writeback_evicts_only_noted_preemption_victims(apiserver):
+    """Live deletes carry eviction provenance: a store delete marked via
+    note_eviction (what SchedulerService.add_eviction_listener feeds)
+    deletes the live pod, while a plain store delete (reset, user delete
+    through the simulator API) must NEVER touch the real cluster
+    (review findings, round 5)."""
+    from ksim_tpu.scheduler.service import SchedulerService
+    from ksim_tpu.syncer.writeback import LiveWriteBack
+
+    state, url = apiserver
+    state.apply("nodes", ADDED, make_node("n0", cpu="8", memory="16Gi"))
+    state.apply("pods", ADDED, make_pod("victim", cpu="1", memory="1Gi"))
+    state.apply("pods", ADDED, make_pod("innocent", cpu="1", memory="1Gi"))
+    src = KubeApiSource(url)
+    store = ClusterStore()
+    syncer = Syncer(src, store)
+    syncer.run()
+    wb = LiveWriteBack(src, store).start()
+    try:
+        _wait_for(lambda: len(store.list("pods")) == 2, msg="mirror")
+        svc = SchedulerService(store, record="selection", preemption=False)
+        svc.add_eviction_listener(wb.note_eviction)
+        placements = svc.schedule_pending()
+        assert placements["default/victim"] == "n0"
+        _wait_for(
+            lambda: ("default", "victim", "n0") in state.binding_posts,
+            msg="live bind",
+        )
+        # Plain store delete (user/reset): live cluster untouched.
+        store.delete("pods", "innocent", "default")
+        time.sleep(0.5)
+        assert ("default", "innocent") not in state.pod_deletes
+        assert "default/innocent" in state.objects["pods"]
+        # Eviction-marked delete (what _evict_victim does): propagates.
+        wb.note_eviction("default", "victim")
+        store.delete("pods", "victim", "default")
+        _wait_for(
+            lambda: ("default", "victim") in state.pod_deletes,
+            msg="live eviction",
+        )
+        assert "default/victim" not in state.objects["pods"]
+    finally:
+        wb.stop()
+        syncer.stop()
+        src.close()
+
+
+def test_service_eviction_listener_fires_on_preemption_path():
+    """_evict_victim notifies listeners before the store delete — the
+    provenance hook cmd/simulator wires into LiveWriteBack."""
+    from ksim_tpu.scheduler.service import SchedulerService
+
+    store = ClusterStore()
+    store.create("pods", make_pod("v1", cpu="1", memory="1Gi", node_name="nX"))
+    svc = SchedulerService(store, record="selection", preemption=False)
+    seen: list[tuple[str, str]] = []
+    svc.add_eviction_listener(lambda ns, n: seen.append((ns, n)))
+    svc._evict_victim(store.get("pods", "v1", "default"))
+    assert seen == [("default", "v1")]
+    with pytest.raises(Exception):
+        store.get("pods", "v1", "default")
+
+
+def test_writeback_409_reconciles_to_real_node(apiserver):
+    """If another scheduler bound the pod first (bind answers 409), the
+    write-back must NOT push result annotations naming OUR node — it
+    re-reads the live pod and skips when the real node differs (review
+    finding, round 5)."""
+    from ksim_tpu.syncer.writeback import LiveWriteBack
+
+    state, url = apiserver
+    # Live pod is ALREADY bound to n3 (by "another scheduler").
+    state.apply("pods", ADDED, make_pod("contested", cpu="1", memory="1Gi",
+                                        node_name="n3"))
+    src = KubeApiSource(url)
+    store = ClusterStore()
+    # Mirror it UNBOUND (as the syncer would have before the other
+    # scheduler's bind, which the filter then never mirrors).
+    store.create("pods", make_pod("contested", cpu="1", memory="1Gi"))
+    wb = LiveWriteBack(src, store).start()
+    try:
+        time.sleep(0.3)  # let the ADDED replay seed (no writes expected)
+        # Our scheduler now "places" it on n0 with result annotations.
+        def bindit(obj):
+            obj["spec"]["nodeName"] = "n0"
+            obj["metadata"].setdefault("annotations", {})[
+                "kube-scheduler-simulator.sigs.k8s.io/selected-node"
+            ] = "n0"
+        store.patch("pods", "contested", "default", bindit)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not wb._bound.get("default/contested"):
+            time.sleep(0.05)
+        assert wb._bound.get("default/contested") == "n3"  # learned the truth
+        live = state.objects["pods"]["default/contested"]
+        ann = live.get("metadata", {}).get("annotations") or {}
+        assert "kube-scheduler-simulator.sigs.k8s.io/selected-node" not in ann
+        assert live["spec"]["nodeName"] == "n3"
+        assert state.annotation_patches == []
+    finally:
+        wb.stop()
+        src.close()
