@@ -186,6 +186,7 @@ def render_pod_results(
     prebind_extra: dict | None = None,
     bind_map: dict | None = None,
     ctx: "RenderCtx | None" = None,
+    visited: "np.ndarray | None" = None,
 ) -> dict[str, str]:
     """The 13 result annotations for queue pod ``pi`` (all keys present,
     empty maps as "{}", mirroring GetStoredResult's unconditional adds).
@@ -202,6 +203,10 @@ def render_pod_results(
     bind-result map when a custom binder handled (or failed) the bind
     (wrappedplugin.go:699-726 AddBindResult records under the actual
     binder's name).
+    ``visited`` (percentageOfNodesToScore emulation, res.visited[pi]):
+    only visited nodes appear in the recorded maps — upstream's
+    NodeToStatusMap and score lists cover the nodes its sampled filter
+    iteration actually touched.
     Pass a shared ``ctx`` when rendering many pods of one pass."""
     if res.reason_bits is None:
         raise ValueError("render_pod_results needs record='full' results")
@@ -223,12 +228,17 @@ def render_pod_results(
         first_fail = np.argmax(failed, axis=0)
     else:
         first_fail = np.zeros(N, dtype=np.int64)
-    feasible_nodes = np.nonzero(~any_fail)[0]
+    vis = None if visited is None else np.asarray(visited)[:N].astype(bool)
+    if vis is None:
+        feasible_nodes = np.nonzero(~any_fail)[0]
+    else:
+        feasible_nodes = np.nonzero(~any_fail & vis)[0]
 
-    # filter-result: every node gets a row; rows are shared strings.
-    # Nodes share a handful of distinct rows (the all-pass row or one per
-    # (first failing plugin, bits) pattern): classify every node to a
-    # pattern code in bulk, render each distinct row once, then join.
+    # filter-result: every (visited) node gets a row; rows are shared
+    # strings.  Nodes share a handful of distinct rows (the all-pass row
+    # or one per (first failing plugin, bits) pattern): classify every
+    # node to a pattern code in bulk, render each distinct row once,
+    # then join.
     so = ctx.sorted_order_arr
     ff_s = first_fail[so].astype(np.int64)
     bits_at_ff = bits_pi[ff_s, so].astype(np.int64)
@@ -241,7 +251,15 @@ def render_pod_results(
         else:
             row_strs.append(ctx.fail_row(int(code >> 32), int(code & 0xFFFFFFFF)))
     prefixes = ctx.node_json_sorted_prefix
-    parts = [prefixes[k] + row_strs[i] for k, i in enumerate(inv)]
+    if vis is None:
+        parts = [prefixes[k] + row_strs[i] for k, i in enumerate(inv)]
+    else:
+        vis_s = vis[so]
+        parts = [
+            prefixes[k] + row_strs[i]
+            for k, i in enumerate(inv)
+            if vis_s[k]
+        ]
     filter_json = "{" + ",".join(parts) + "}"
 
     # Upstream schedulePod returns right after filtering when exactly one

@@ -363,6 +363,10 @@ class CompiledProfile:
     # (name, key fn), and PreEnqueue gates [(name, fn), ...].
     queue_sort_plugin: "tuple[str, Callable] | None" = None
     pre_enqueue_hooks: tuple = ()
+    # KubeSchedulerProfile.percentageOfNodesToScore (v1.30: per-profile
+    # override of the global field; None = inherit, 0 = adaptive).  Used
+    # only by the opt-in sampling emulation (KSIM_PNTS_EMULATION=1).
+    percentage_of_nodes_to_score: int | None = None
     # Plugins added only through a per-point set: name -> points enabled.
     point_only: dict[str, frozenset[str]] = field(default_factory=dict)
     # Featurizer extra encoders shipped by config-loaded plugins
@@ -684,6 +688,11 @@ def compile_profile(
         extra_encoders=loaded_encoders,
         queue_sort_plugin=sorters[0] if sorters else None,
         pre_enqueue_hooks=pre_enqueue_hooks,
+        percentage_of_nodes_to_score=(
+            int(profile_cfg["percentageOfNodesToScore"])
+            if isinstance(profile_cfg.get("percentageOfNodesToScore"), int)
+            else None
+        ),
     )
     prof.spread_defaults()  # validate PodTopologySpreadArgs at compile time
     return prof

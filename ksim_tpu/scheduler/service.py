@@ -196,6 +196,14 @@ class SchedulerService:
         self._poke = threading.Event()
         self._pass_count = 0
         self.metrics = Metrics()
+        # percentageOfNodesToScore emulation (opt-in replay-fidelity
+        # mode, KSIM_PNTS_EMULATION=1): per-profile rotating start index
+        # — upstream's sched.nextStartNodeIndex lives on the scheduler,
+        # one per profile binary.
+        self._pnts_emulation = (
+            os.environ.get("KSIM_PNTS_EMULATION", "") == "1"
+        )
+        self._pnts_start: dict[str, int] = {}
 
     MAX_BACKOFF_PASSES = 16
     # An event-triggered flush caps the remaining wait instead of zeroing
@@ -507,11 +515,19 @@ class SchedulerService:
                     **volume_kw,
                 )
             plugins = tuple(factory(feats))
+            sampling_k = self._sampling_k_for(prof, len(nodes))
             with self.metrics.timer("engine"):
-                eng = Engine(feats, plugins, record=self._record)
+                eng = Engine(
+                    feats, plugins, record=self._record, sampling_k=sampling_k
+                )
                 if self._shard_mesh is not None:
                     eng.shard(self._shard_mesh)
-                res, _ = eng.schedule(pull_state=False)
+                res, _ = eng.schedule(
+                    pull_state=False,
+                    sampling_start=self._pnts_start.get(sched_name, 0),
+                )
+            if sampling_k is not None and res.sampling_next_start is not None:
+                self._pnts_start[sched_name] = res.sampling_next_start
             with self.metrics.timer("bind"):
                 self._bind_results(queue, feats, plugins, res, placements, prof=prof)
         # Bound _own_rvs growth for library use (schedule_pending without
@@ -691,6 +707,7 @@ class SchedulerService:
                 reserve_extra=reserve_extra,
                 prebind_extra=prebind_extra,
                 bind_map=bind_map,
+                visited=None if res.visited is None else res.visited[0],
             )
             anno.update(self._extenders.store.get_stored_result(pod))
             selected_settle = None if reserve_failed else selected
@@ -735,6 +752,33 @@ class SchedulerService:
                 self._evict_victim(v)
             placements[f"{namespace_of(pod)}/{name_of(pod)}"] = selected
 
+    # Upstream sampling constants (schedule_one.go).
+    _MIN_FEASIBLE_NODES_TO_FIND = 100
+    _MIN_FEASIBLE_PERCENTAGE = 5
+
+    def _sampling_k_for(self, prof, n_nodes: int) -> int | None:
+        """numFeasibleNodesToFind (schedule_one.go): None = score all
+        nodes (emulation off, small cluster, or percentage resolves to
+        everything).  A per-profile percentageOfNodesToScore overrides
+        the global field; 0/unset means the adaptive formula
+        50 - n/125, floored at 5%."""
+        if not self._pnts_emulation:
+            return None
+        if n_nodes < self._MIN_FEASIBLE_NODES_TO_FIND:
+            return None
+        pct = None
+        if prof is not None and prof.percentage_of_nodes_to_score is not None:
+            pct = prof.percentage_of_nodes_to_score
+        if pct is None:
+            v = (self._config or {}).get("percentageOfNodesToScore")
+            pct = v if isinstance(v, int) else 0
+        if pct == 0:
+            pct = max(50 - n_nodes // 125, self._MIN_FEASIBLE_PERCENTAGE)
+        if pct >= 100:
+            return None
+        k = max(n_nodes * pct // 100, self._MIN_FEASIBLE_NODES_TO_FIND)
+        return None if k >= n_nodes else k
+
     def add_eviction_listener(self, fn) -> None:
         """Register a (namespace, name) callback fired before each
         preemption victim's store delete (see __init__ note)."""
@@ -743,17 +787,20 @@ class SchedulerService:
     def _evict_victim(self, v: JSON) -> None:
         """Preemption eviction (the debuggable scheduler deletes victims
         via the apiserver; KWOK terminates immediately).  Listeners run
-        FIRST so the store's DELETED event already carries its eviction
-        provenance when observers see it."""
+        only AFTER the store delete succeeded — a mark for a delete that
+        never happened would leak and misclassify a LATER plain delete
+        of a same-named pod as an eviction (the write-back's DELETED
+        handler rechecks once to absorb the mark-after-event race)."""
+        try:
+            self._store.delete("pods", name_of(v), namespace_of(v))
+        except Exception:
+            logger.exception("failed to evict victim %s", name_of(v))
+            return
         for fn in self._eviction_listeners:
             try:
                 fn(namespace_of(v) or "default", name_of(v))
             except Exception:
                 logger.exception("eviction listener failed")
-        try:
-            self._store.delete("pods", name_of(v), namespace_of(v))
-        except Exception:
-            logger.exception("failed to evict victim %s", name_of(v))
 
     def _bind_results(self, queue, feats, plugins, res, placements, prof=None) -> None:
         render_ctx = RenderCtx(feats, plugins) if self._record == "full" else None
@@ -819,6 +866,7 @@ class SchedulerService:
                     prebind_extra=prebind_extra,
                     bind_map=bind_map,
                     ctx=render_ctx,
+                    visited=None if res.visited is None else res.visited[j],
                 )
                 if self._record == "full"
                 else {}

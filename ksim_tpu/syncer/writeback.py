@@ -87,6 +87,10 @@ class LiveWriteBack:
         # Keys stay until the live delete succeeds, so a transient
         # failure's retry still knows to evict.
         self._evictions: set[str] = set()
+        # ns/name keys another scheduler bound to a DIFFERENT node than
+        # the store says (the 409-reconcile outcome): later MODIFIED
+        # events for them must not re-attempt the guaranteed-409 bind.
+        self._diverged: set[str] = set()
         # (due_monotonic, etype, pod, attempt) pending transient retries.
         self._retries: list[tuple[float, str, JSON, int]] = []
 
@@ -136,6 +140,18 @@ class LiveWriteBack:
                     self._dispatch(etype, pod, attempt=attempt)
 
     def _dispatch(self, etype: str, pod: JSON, *, attempt: int) -> None:
+        if etype == DELETED and attempt == 0:
+            key = f"{namespace_of(pod) or 'default'}/{name_of(pod)}"
+            if key not in self._evictions:
+                # Eviction marks are set right AFTER the store delete
+                # returns, so a DELETED event can race a few µs ahead of
+                # its mark.  One short recheck before treating it as a
+                # plain (never-propagated) delete; a genuinely plain
+                # delete just no-ops twice.
+                self._retries.append(
+                    (time.monotonic() + 0.2, DELETED, pod, 1)
+                )
+                return
         if attempt > 0 and etype != DELETED:
             # Retry with the pod's CURRENT store state, not the snapshot
             # captured at failure time — a newer pass may have pushed
@@ -180,6 +196,7 @@ class LiveWriteBack:
             self._bound.pop(key, None)
             self._pushed.pop(key, None)
             self._missing.discard(key)
+            self._diverged.discard(key)
             if key in self._evictions:
                 # A preemption victim (note_eviction provenance) must be
                 # evicted live too — without it the node would carry both
@@ -221,6 +238,8 @@ class LiveWriteBack:
         if not node and not ann:
             return
         try:
+            if node and key in self._diverged:
+                return  # settled on another scheduler's node; stop pushing
             if node and self._bound.get(key) != node:
                 try:
                     self._source.bind_pod(ns, name_of(pod), node)
@@ -241,6 +260,7 @@ class LiveWriteBack:
                             "skipping result annotations",
                             key, real or "<none>", node,
                         )
+                        self._diverged.add(key)
                         return
                 self._bound[key] = node
             if ann:

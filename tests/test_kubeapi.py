@@ -823,6 +823,17 @@ def test_writeback_409_reconciles_to_real_node(apiserver):
         assert "kube-scheduler-simulator.sigs.k8s.io/selected-node" not in ann
         assert live["spec"]["nodeName"] == "n3"
         assert state.annotation_patches == []
+        # A later MODIFIED for the diverged pod must not re-attempt the
+        # guaranteed-409 bind or push annotations (review finding).
+        assert "default/contested" in wb._diverged
+        store.patch(
+            "pods", "contested", "default",
+            lambda o: o["metadata"]["annotations"].__setitem__(
+                "kube-scheduler-simulator.sigs.k8s.io/selected-node", "n0"
+            ),
+        )
+        time.sleep(0.5)
+        assert state.annotation_patches == []
     finally:
         wb.stop()
         src.close()
