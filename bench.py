@@ -532,7 +532,16 @@ def main() -> None:
             orch.emit()
             return
     payload["platform"] = probe.get("platform")
+    # fallback_cpu records CHIP FAILURE (probe failed, sanitized-env
+    # retry succeeded) — provenance the round notes rely on.  CPU
+    # SIZING additionally applies to an intentionally-CPU environment
+    # (JAX_PLATFORMS=cpu: the probe then SUCCEEDS on cpu and previously
+    # took the TPU-sized ladder into a guaranteed 10kx5k timeout);
+    # that case is recorded as cpu_sized without the failure flag.
     payload["fallback_cpu"] = fallback
+    if probe.get("platform") == "cpu":
+        fallback = True  # local sizing flag from here on
+    payload["cpu_sized"] = fallback
     print(f"bench: backend={probe.get('platform')} "
           f"devices={probe.get('device_count')} fallback={fallback}",
           file=sys.stderr)
@@ -570,6 +579,7 @@ def main() -> None:
         env = _sanitized_env()
         fallback = True
         payload["fallback_cpu"] = True
+        payload["cpu_sized"] = True
         return "transitioned"
 
     def retry_transient(probe_state: str, result: dict, rerun, label: str) -> dict:

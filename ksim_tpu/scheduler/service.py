@@ -117,10 +117,10 @@ class SchedulerService:
     ) -> None:
         self._store = store
         # Preemption-eviction observers (add_eviction_listener): notified
-        # with (namespace, name) BEFORE a victim's store delete, so a
-        # live write-back can distinguish engine evictions (which must
-        # propagate to the real cluster) from reset/user deletes (which
-        # must never touch it).
+        # with (namespace, name) right AFTER a victim's successful store
+        # delete, so a live write-back can distinguish engine evictions
+        # (which must propagate to the real cluster) from reset/user
+        # deletes (which must never touch it).
         self._eviction_listeners: list = []
         # Optional jax.sharding.Mesh: every engine this service builds is
         # laid out over it (node axis over "tp", engine/sharding.py).  The
@@ -500,6 +500,18 @@ class SchedulerService:
                 # filtering and scoring — exact upstream semantics require
                 # pod-at-a-time evaluation (the reference's scheduler is
                 # per-pod anyway; extenders are the slow path by design).
+                if self._pnts_emulation and not getattr(
+                    self, "_pnts_extender_warned", False
+                ):
+                    # Sampling emulation does not apply on this path (it
+                    # lives in the scan program) — say so once instead of
+                    # silently scoring every node under the flag.
+                    self._pnts_extender_warned = True
+                    logger.warning(
+                        "KSIM_PNTS_EMULATION=1 is inert for profiles "
+                        "with extenders (per-pod evaluation path scores "
+                        "all nodes)"
+                    )
                 self._schedule_queue_with_extenders(
                     queue, featurizer, factory, namespaces, volume_kw, placements,
                     prof=prof,
@@ -780,8 +792,9 @@ class SchedulerService:
         return None if k >= n_nodes else k
 
     def add_eviction_listener(self, fn) -> None:
-        """Register a (namespace, name) callback fired before each
-        preemption victim's store delete (see __init__ note)."""
+        """Register a (namespace, name) callback fired right after each
+        preemption victim's SUCCESSFUL store delete (see __init__ note;
+        the victim is already gone from the store when it fires)."""
         self._eviction_listeners.append(fn)
 
     def _evict_victim(self, v: JSON) -> None:

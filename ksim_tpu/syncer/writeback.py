@@ -122,22 +122,35 @@ class LiveWriteBack:
     # -- event loop ----------------------------------------------------------
 
     def _run(self) -> None:
-        while not self._stop.is_set():
-            try:
-                event = self._stream.next(timeout=0.5)
-            except Exception:
-                if not self._stop.is_set():
-                    logger.exception("write-back watch failed; stopping")
-                return
-            if event is not None:
-                self._dispatch(event.event_type, event.obj, attempt=0)
-            # Due transient retries.
-            if self._retries:
-                now = time.monotonic()
-                due = [r for r in self._retries if r[0] <= now]
-                self._retries = [r for r in self._retries if r[0] > now]
-                for _t, etype, pod, attempt in due:
-                    self._dispatch(etype, pod, attempt=attempt)
+        try:
+            while not self._stop.is_set():
+                try:
+                    event = self._stream.next(timeout=0.5)
+                except Exception:
+                    if not self._stop.is_set():
+                        logger.exception("write-back watch failed; stopping")
+                    return
+                if event is not None:
+                    self._dispatch(event.event_type, event.obj, attempt=0)
+                # Due transient retries.
+                if self._retries:
+                    now = time.monotonic()
+                    due = [r for r in self._retries if r[0] <= now]
+                    self._retries = [r for r in self._retries if r[0] > now]
+                    for _t, etype, pod, attempt in due:
+                        self._dispatch(etype, pod, attempt=attempt)
+        finally:
+            # Exit (stop or watch failure) must not strand queued
+            # DELETED rechecks: a marked eviction parked for its 0.2s
+            # recheck would otherwise never delete the live victim
+            # (the overcommit this machinery exists to prevent).
+            pending, self._retries = self._retries, []
+            for _t, etype, pod, _attempt in pending:
+                if etype == DELETED:
+                    # Final attempt semantics: a failure here logs
+                    # PERMANENTLY failed rather than re-queueing.
+                    self._dispatch(etype, pod, attempt=self.RETRY_ATTEMPTS - 1)
+            self._retries = []
 
     def _dispatch(self, etype: str, pod: JSON, *, attempt: int) -> None:
         if etype == DELETED and attempt == 0:
