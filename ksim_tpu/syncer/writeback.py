@@ -140,15 +140,28 @@ class LiveWriteBack:
                     for _t, etype, pod, attempt in due:
                         self._dispatch(etype, pod, attempt=attempt)
         finally:
-            # Exit (stop or watch failure) must not strand queued
-            # DELETED rechecks: a marked eviction parked for its 0.2s
-            # recheck would otherwise never delete the live victim
-            # (the overcommit this machinery exists to prevent).
+            # Exit (stop or watch failure) must not strand eviction
+            # work — a marked eviction would otherwise never delete the
+            # live victim (the overcommit this machinery exists to
+            # prevent).  Two places can hold it: the stream queue
+            # (events enqueued but not yet dispatched; close() discards
+            # them) and the 0.2s DELETED-recheck parking list.  Both
+            # are drained with final-attempt semantics (a failure logs
+            # PERMANENTLY failed rather than re-queueing).
+            while True:
+                try:
+                    event = self._stream.next(timeout=0)
+                except Exception:
+                    break
+                if event is None:
+                    break
+                if event.event_type == DELETED:
+                    self._dispatch(
+                        DELETED, event.obj, attempt=self.RETRY_ATTEMPTS - 1
+                    )
             pending, self._retries = self._retries, []
             for _t, etype, pod, _attempt in pending:
                 if etype == DELETED:
-                    # Final attempt semantics: a failure here logs
-                    # PERMANENTLY failed rather than re-queueing.
                     self._dispatch(etype, pod, attempt=self.RETRY_ATTEMPTS - 1)
             self._retries = []
 

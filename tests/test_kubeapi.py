@@ -837,3 +837,36 @@ def test_writeback_409_reconciles_to_real_node(apiserver):
     finally:
         wb.stop()
         src.close()
+
+
+def test_writeback_stop_drains_pending_eviction_recheck(apiserver):
+    """stop() must not strand a marked eviction parked in the 0.2s
+    DELETED recheck window — the exit drain completes the live delete
+    (review finding, round 5).  Deterministic sequencing: an UNMARKED
+    delete always parks once (the attempt-0 recheck), so waiting for
+    the parked entry before calling note_eviction guarantees the drain
+    path — not the normal path — performs the eviction."""
+    from ksim_tpu.syncer.writeback import LiveWriteBack
+
+    state, url = apiserver
+    state.apply("pods", ADDED, make_pod("victim", cpu="1", memory="1Gi",
+                                        node_name="n0"))
+    src = KubeApiSource(url)
+    store = ClusterStore()
+    store.create("pods", make_pod("victim", cpu="1", memory="1Gi",
+                                  node_name="n0"))
+    wb = LiveWriteBack(src, store).start()
+    try:
+        store.delete("pods", "victim", "default")
+        # The DELETED event (unmarked) parks in the recheck window.
+        _wait_for(lambda: wb._retries, msg="recheck parked")
+        wb.note_eviction("default", "victim")
+        wb.stop()  # drain must run the parked eviction
+        _wait_for(
+            lambda: ("default", "victim") in state.pod_deletes,
+            timeout=5.0,
+            msg="drained live eviction",
+        )
+    finally:
+        wb.stop()
+        src.close()
