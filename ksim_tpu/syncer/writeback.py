@@ -67,6 +67,10 @@ class LiveWriteBack:
     #: diverge from the live cluster.
     RETRY_ATTEMPTS = 5
     RETRY_DELAY_S = 2.0
+    #: parking delay for a DELETED event that arrives before its
+    #: eviction mark (note_eviction runs after the store delete returns,
+    #: so the event can race ahead by a few µs).
+    RECHECK_DELAY_S = 0.2
 
     def __init__(self, source: KubeApiSource, store: ClusterStore) -> None:
         self._source = source
@@ -144,10 +148,17 @@ class LiveWriteBack:
             # work — a marked eviction would otherwise never delete the
             # live victim (the overcommit this machinery exists to
             # prevent).  Two places can hold it: the stream queue
-            # (events enqueued but not yet dispatched; close() discards
-            # them) and the 0.2s DELETED-recheck parking list.  Both
-            # are drained with final-attempt semantics (a failure logs
-            # PERMANENTLY failed rather than re-queueing).
+            # (events enqueued but not yet dispatched; close() only
+            # stops NEW deliveries — already-enqueued events stay
+            # readable, which is what makes this drain possible) and
+            # the DELETED-recheck parking list.  Both drain with
+            # final-attempt semantics (a failure logs PERMANENTLY
+            # failed rather than re-queueing).  Events whose eviction
+            # mark hasn't landed yet (a preemption mid-flight at stop
+            # time: store delete done, note_eviction pending) get one
+            # grace sleep before the final dispatch so the mark can
+            # arrive.
+            work: list[JSON] = []
             while True:
                 try:
                     event = self._stream.next(timeout=0)
@@ -156,13 +167,15 @@ class LiveWriteBack:
                 if event is None:
                     break
                 if event.event_type == DELETED:
-                    self._dispatch(
-                        DELETED, event.obj, attempt=self.RETRY_ATTEMPTS - 1
-                    )
+                    work.append(event.obj)
             pending, self._retries = self._retries, []
-            for _t, etype, pod, _attempt in pending:
-                if etype == DELETED:
-                    self._dispatch(etype, pod, attempt=self.RETRY_ATTEMPTS - 1)
+            work.extend(pod for _t, et, pod, _a in pending if et == DELETED)
+            def _key(p):
+                return f"{namespace_of(p) or 'default'}/{name_of(p)}"
+            if any(_key(p) not in self._evictions for p in work):
+                time.sleep(self.RECHECK_DELAY_S + 0.05)
+            for pod in work:
+                self._dispatch(DELETED, pod, attempt=self.RETRY_ATTEMPTS - 1)
             self._retries = []
 
     def _dispatch(self, etype: str, pod: JSON, *, attempt: int) -> None:
@@ -175,7 +188,7 @@ class LiveWriteBack:
                 # plain (never-propagated) delete; a genuinely plain
                 # delete just no-ops twice.
                 self._retries.append(
-                    (time.monotonic() + 0.2, DELETED, pod, 1)
+                    (time.monotonic() + self.RECHECK_DELAY_S, DELETED, pod, 1)
                 )
                 return
         if attempt > 0 and etype != DELETED:

@@ -839,15 +839,17 @@ def test_writeback_409_reconciles_to_real_node(apiserver):
         src.close()
 
 
-def test_writeback_stop_drains_pending_eviction_recheck(apiserver):
-    """stop() must not strand a marked eviction parked in the 0.2s
-    DELETED recheck window — the exit drain completes the live delete
-    (review finding, round 5).  Deterministic sequencing: an UNMARKED
-    delete always parks once (the attempt-0 recheck), so waiting for
-    the parked entry before calling note_eviction guarantees the drain
-    path — not the normal path — performs the eviction."""
+def test_writeback_stop_drains_pending_eviction_recheck(apiserver, monkeypatch):
+    """stop() must not strand a marked eviction parked in the DELETED
+    recheck window — the exit drain completes the live delete (review
+    finding, round 5).  Deterministic sequencing: an UNMARKED delete
+    always parks once (the attempt-0 recheck), and the recheck delay is
+    raised far beyond the test's runtime so the worker provably cannot
+    consume the parked entry before stop() — only the drain can have
+    performed the eviction."""
     from ksim_tpu.syncer.writeback import LiveWriteBack
 
+    monkeypatch.setattr(LiveWriteBack, "RECHECK_DELAY_S", 30.0)
     state, url = apiserver
     state.apply("pods", ADDED, make_pod("victim", cpu="1", memory="1Gi",
                                         node_name="n0"))
@@ -861,6 +863,8 @@ def test_writeback_stop_drains_pending_eviction_recheck(apiserver):
         # The DELETED event (unmarked) parks in the recheck window.
         _wait_for(lambda: wb._retries, msg="recheck parked")
         wb.note_eviction("default", "victim")
+        # Mark is set, so the drain takes no grace sleep; RECHECK_DELAY
+        # only gates UNMARKED work there.
         wb.stop()  # drain must run the parked eviction
         _wait_for(
             lambda: ("default", "victim") in state.pod_deletes,
