@@ -49,6 +49,10 @@ logger = logging.getLogger(__name__)
 RESULT_PREFIX = "kube-scheduler-simulator.sigs.k8s.io/"
 
 
+def _pod_key(pod: JSON) -> str:
+    return f"{namespace_of(pod) or 'default'}/{name_of(pod)}"
+
+
 def writeback_enabled() -> bool:
     return os.environ.get("KSIM_ALLOW_LIVE_WRITEBACK", "") == "1"
 
@@ -170,17 +174,18 @@ class LiveWriteBack:
                     work.append(event.obj)
             pending, self._retries = self._retries, []
             work.extend(pod for _t, et, pod, _a in pending if et == DELETED)
-            def _key(p):
-                return f"{namespace_of(p) or 'default'}/{name_of(p)}"
-            if any(_key(p) not in self._evictions for p in work):
-                time.sleep(self.RECHECK_DELAY_S + 0.05)
+            if any(_pod_key(p) not in self._evictions for p in work):
+                # Bounded regardless of RECHECK_DELAY_S tuning: the
+                # mark race is microseconds-scale, and stop()'s 5s
+                # thread join must outlive this sleep plus the final
+                # dispatches.
+                time.sleep(min(self.RECHECK_DELAY_S + 0.05, 1.0))
             for pod in work:
                 self._dispatch(DELETED, pod, attempt=self.RETRY_ATTEMPTS - 1)
-            self._retries = []
 
     def _dispatch(self, etype: str, pod: JSON, *, attempt: int) -> None:
         if etype == DELETED and attempt == 0:
-            key = f"{namespace_of(pod) or 'default'}/{name_of(pod)}"
+            key = _pod_key(pod)
             if key not in self._evictions:
                 # Eviction marks are set right AFTER the store delete
                 # returns, so a DELETED event can race a few µs ahead of
@@ -230,7 +235,7 @@ class LiveWriteBack:
 
     def _handle(self, etype: str, pod: JSON) -> None:
         ns = namespace_of(pod) or "default"
-        key = f"{ns}/{name_of(pod)}"
+        key = _pod_key(pod)
         if etype == DELETED:
             self._bound.pop(key, None)
             self._pushed.pop(key, None)

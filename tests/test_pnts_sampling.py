@@ -234,3 +234,22 @@ def test_service_end_to_end_sampling(monkeypatch):
     filt1 = json.loads(pod1["metadata"]["annotations"][FILTER_RESULT_KEY])
     assert "n100" in filt1 and "n119" in filt1 and "n099" not in filt1
     assert svc._pnts_start["default-scheduler"] == 80
+
+
+def test_sampled_schedule_sharded_equals_single_device():
+    """The sampling emulation composes with the tp mesh: the rotating
+    start/n_real scalars replicate and the visited/top_k machinery runs
+    under GSPMD identically to single-device."""
+    from ksim_tpu.engine.sharding import make_mesh
+
+    nodes = [make_node(f"n{i:03d}", unschedulable=i % 7 == 3) for i in range(24)]
+    pods = [make_pod(f"p{i}") for i in range(6)]
+    feats = Featurizer().featurize(nodes, [], queue_pods=pods)
+    plain = Engine(feats, default_plugins(feats), record="full", sampling_k=5)
+    res_plain, _ = plain.schedule(sampling_start=2)
+    sharded = Engine(feats, default_plugins(feats), record="full", sampling_k=5)
+    sharded.shard(make_mesh(8, dp=1))
+    res_shard, _ = sharded.schedule(sampling_start=2)
+    assert np.array_equal(res_plain.selected, res_shard.selected)
+    assert np.array_equal(res_plain.visited, res_shard.visited)
+    assert res_plain.sampling_next_start == res_shard.sampling_next_start
