@@ -230,7 +230,9 @@ def child_rung(
     return rung
 
 
-def child_churn(seed: int, n_nodes: int, n_events: int, exact: bool = False) -> dict:
+def child_churn(
+    seed: int, n_nodes: int, n_events: int, exact: bool = False, device: bool = False
+) -> dict:
     """BASELINE config 5: churn replay — rolling pod arrivals/completions
     + node drain/replace over the full default plugin set, sequential
     scheduling semantics per step.  The full rung runs in float32 fast
@@ -253,7 +255,9 @@ def child_churn(seed: int, n_nodes: int, n_events: int, exact: bool = False) -> 
     # bucket up to 16384, and each new shape is another multi-second XLA
     # compile (upstream schedules one pod per cycle; capping a batch just
     # leaves the rest queued).
-    runner = ScenarioRunner(max_pods_per_pass=1024, pod_bucket_min=128)
+    runner = ScenarioRunner(
+        max_pods_per_pass=1024, pod_bucket_min=128, device_replay=device
+    )
     res = runner.run(
         churn_scenario(seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100)
     )
@@ -267,8 +271,26 @@ def child_churn(seed: int, n_nodes: int, n_events: int, exact: bool = False) -> 
         "exact": bool(exact),
         "platform": jax.devices()[0].platform,
     }
+    if device and runner.replay_driver is not None:
+        # Dispatch evidence: the per-pass path pays one engine round-trip
+        # group (pack + scan + pull) per scheduling pass; the device path
+        # pays one per SEGMENT plus one per fallback step.
+        drv = runner.replay_driver
+        round_trips = drv.device_round_trips + drv.fallback_steps
+        out.update(
+            device=True,
+            device_steps=drv.device_steps,
+            fallback_steps=drv.fallback_steps,
+            device_round_trips=drv.device_round_trips,
+            per_pass_round_trips=len(res.steps),
+            dispatch_reduction=(
+                round(len(res.steps) / round_trips, 1) if round_trips else None
+            ),
+            unsupported=dict(drv.unsupported),
+        )
     print(
-        f"[churn {n_events}ev/{n_nodes}n{' exact' if exact else ''}] "
+        f"[churn {n_events}ev/{n_nodes}n"
+        f"{' exact' if exact else ''}{' device' if device else ''}] "
         f"{res.wall_seconds:.1f}s "
         f"({res.events_per_second:.0f} ev/s, {res.pods_scheduled} scheduled)",
         file=sys.stderr,
@@ -290,7 +312,11 @@ def _child_main(args: argparse.Namespace) -> None:
             )
         elif args.child == "churn":
             out = child_churn(
-                args.seed, args.churn_nodes, args.churn_events, args.churn_exact
+                args.seed,
+                args.churn_nodes,
+                args.churn_events,
+                args.churn_exact,
+                args.churn_device,
             )
         else:  # pragma: no cover
             raise ValueError(f"unknown child mode {args.child!r}")
@@ -489,6 +515,7 @@ def main() -> None:
     ap.add_argument("--churn-events", type=int, default=50_000)
     ap.add_argument("--churn-nodes", type=int, default=2_000)
     ap.add_argument("--churn-exact", action="store_true")
+    ap.add_argument("--churn-device", action="store_true")
     try:
         default_budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     except ValueError:
@@ -688,6 +715,56 @@ def main() -> None:
         payload["rungs"]["churn"] = result
         orch.flush_partial()
 
+    def run_churn_device_stage() -> None:
+        """Device-resident replay rung (engine/replay.py): the K-step
+        segment-scan path over the same churn stream.  Evidence it must
+        record: byte-identical counts through the device path, and the
+        per-replay dispatch reduction vs one round trip per pass (the
+        round-5 TPU latency floor this path exists to remove).  On a CPU
+        fallback the rung runs the 6k prefix — the dispatch ratio and the
+        locked-prefix counts are platform-independent; the wall-clock
+        trajectory is only meaningful on the chip."""
+        if args.skip_churn or args.only:
+            return
+        if orch.remaining() < 90:
+            payload["rungs"]["churn_device"] = {"error": "skipped: budget exhausted"}
+            return
+        events = args.churn_events
+        nodes = args.churn_nodes
+        if fallback:
+            # Same sizing rule as run_churn_stage's fallback, plus the 6k
+            # event cap: counts and the dispatch ratio are platform-
+            # independent, and the device path's padded universe makes
+            # the full 50k replay CPU-hostile.
+            events = min(events, 6_000)
+            nodes = min(nodes, CPU_CHURN_CAP[1])
+
+        def launch() -> dict:
+            return orch.run_child(
+                "churn",
+                [
+                    "--seed", str(args.seed),
+                    "--churn-events", str(events),
+                    "--churn-nodes", str(nodes),
+                    "--churn-device",
+                ],
+                env,
+                CHURN_TIMEOUT,
+            )
+
+        result = launch()
+        if "error" in result:
+            state = check_mid_run_fallback()
+            if state == "transitioned":
+                events = min(events, 6_000)
+                nodes = min(nodes, CPU_CHURN_CAP[1])
+                retry = launch()
+                result = retry if "error" not in retry else result
+            else:
+                result = retry_transient(state, result, launch, "churn_device")
+        payload["rungs"]["churn_device"] = result
+        orch.flush_partial()
+
     def run_churn_exact_stage() -> None:
         """Bounded exact-mode (x64) churn: demonstrates in the driver
         record that the replay counts are mode- and platform-identical
@@ -747,8 +824,9 @@ def main() -> None:
     run_churn_stage()
     for n_pods, n_nodes in ladder[1:]:
         run_rung_stage(n_pods, n_nodes)
-    # Secondary evidence rung, deliberately AFTER the headline ladder:
-    # a wedged exact-mode child must not starve the 10kx5k rung's budget.
+    # Secondary evidence rungs, deliberately AFTER the headline ladder:
+    # a wedged child here must not starve the 10kx5k rung's budget.
+    run_churn_device_stage()
     run_churn_exact_stage()
     if fallback:
         # The north-star shape still gets a measured record on CPU: the

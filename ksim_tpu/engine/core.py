@@ -793,10 +793,17 @@ class Engine:
         sampling (scan-only; batch evaluation has no visit order)."""
         if record not in ("full", "final", "selection"):
             raise ValueError(f"unknown record mode {record!r}")
+        # Validate against the REAL node count, not the padded axis: a K
+        # between count and padding would "find" padding rows that never
+        # pass filters, silently scoring fewer nodes than asked.
         if sampling_k is not None and not (
-            0 < sampling_k <= int(feats.nodes.valid.shape[0])
+            0 < sampling_k <= int(feats.nodes.count)
         ):
-            raise ValueError(f"sampling_k {sampling_k} out of range")
+            raise ValueError(
+                f"sampling_k {sampling_k} out of range: must be in "
+                f"[1, {int(feats.nodes.count)}] (real node count; the "
+                f"padded axis is {int(feats.nodes.valid.shape[0])})"
+            )
         self._feats = feats
         self._prog = _Program(tuple(plugins), record, sampling_k=sampling_k)
         n = feats.nodes

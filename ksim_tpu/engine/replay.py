@@ -1,0 +1,1128 @@
+"""Device-resident churn replay: K scheduling passes per device dispatch.
+
+The per-pass replay path (scenario/runner.py + scheduler/service.py) pays
+one axon-tunnel round trip per scheduling pass — ~80-100 ms of pure
+dispatch latency on the v5e, ~480 times over the 50k churn replay — because
+each pass's placements mutate the host ClusterStore before the next step's
+events can apply (docs/churn_floor.md, "Where the remaining time goes").
+
+This module removes that serialization for the common churn op vocabulary
+(pod create / pod delete / node drain / node replace): a SEGMENT of K
+scenario steps is pre-lowered on the host into padded tensor event
+streams over a pod/node UNIVERSE (every object alive during the segment,
+including ones created mid-segment), and a single compiled program runs
+all K steps — event application, backoff bookkeeping, queue compaction,
+and the sequential-commit scheduling scan — inside one ``lax.scan`` whose
+carry holds the full cluster tensor state.  The host store remains the
+JSON-speaking source of truth: placements stream back once per segment
+and are reconciled into the store step by step (scenario/runner.py).
+
+Parity contract (the behavior locks, repo CLAUDE.md): the device path
+must reproduce the per-pass path's scheduled/unschedulable counts
+byte-identically.  The design choices that guarantee it:
+
+- **Universe row order is queue order.**  Pod rows are pre-sorted by the
+  exact ``queue_sort_key`` (priority desc, creationTimestamp, namespace,
+  name) — static per pod — so per-step queue compaction preserves the
+  per-pass scheduling order without a device sort.
+- **Rank-based selectHost.**  The per-pass path's tie-break is "lowest
+  node index" in the persistent featurizer's slot order, which evolves by
+  NodeSlots swap-remove under churn.  The lowering simulates that exact
+  slot history step by step (``_SlotSim``) and ships a per-step rank
+  tensor; the device selects the max-score feasible node with minimal
+  rank — the same node the per-pass argmax picks.
+- **Integer-space deltas.**  Event application mutates only additive
+  integer state (requested/nonzero/pod-count aggregates, spread selector
+  counts, inter-pod term accumulators, backoff counters), so the
+  f32-fast-mode cross-platform determinism argument of round 5
+  (docs/churn_floor.md "Cross-platform count determinism") carries over
+  unchanged: the scoring kernels see bit-identical inputs.
+- **Local accumulators for InterPodAffinity.**  The per-pass carry is a
+  domain-AGGREGATED view that cannot absorb deletes; the segment carry
+  keeps per-node local term sums and re-derives the domain view each
+  step with fixed segment reductions (``_derive_interpod``) — verified
+  at lowering time against the featurizer's own aggregation.
+
+Anything outside the supported vocabulary (patch/update ops, pods with
+host ports / volumes / scheduling gates, preemption, extenders, multiple
+profiles, node images, inexact unit scaling, ...) makes ``lower()``
+return None and the segment falls back to the per-pass path, so coverage
+can grow incrementally without risking the locks.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from ksim_tpu.state.resources import JSON, name_of, namespace_of
+
+logger = logging.getLogger(__name__)
+
+# Steps batched per device dispatch.  The dispatch-latency win scales
+# with K; lowering/reconcile host work amortizes over it.  8-32 is the
+# useful range (beyond that the universe grows stale and the first
+# fallback forces a re-lower anyway).
+SEGMENT_STEPS = int(os.environ.get("KSIM_REPLAY_K", "16"))
+
+_I32_MIN = np.iinfo(np.int32).min
+_I32_MAX = np.iinfo(np.int32).max
+
+
+def _backoff_constants() -> tuple[int, int]:
+    """(MAX_BACKOFF_PASSES, FLUSH_CAP_PASSES) from the ONE source of
+    truth — the per-pass scheduler (lazy import: ksim_tpu.scheduler
+    imports this package).  Tuning the service constants must retune the
+    device kernel's mirror or the byte-identical count contract breaks."""
+    from ksim_tpu.scheduler.service import SchedulerService
+
+    return SchedulerService.MAX_BACKOFF_PASSES, SchedulerService.FLUSH_CAP_PASSES
+
+
+class ReplayParityError(RuntimeError):
+    """Device-resident replay state diverged from the host store — a bug
+    in the delta application, never a recoverable condition (the store
+    may already hold device-computed placements)."""
+
+
+def _pod_key(pod: JSON) -> str:
+    """The SERVICE's pod key scheme (`namespace/name`, namespace
+    defaulted): op-created objects may lack metadata.namespace until the
+    store defaults it, so every universe/event/backoff key must go
+    through this one normalization."""
+    return f"{namespace_of(pod) or 'default'}/{name_of(pod)}"
+
+
+# ---------------------------------------------------------------------------
+# Canonical slot simulation (the per-pass featurizer's NodeSlots history)
+# ---------------------------------------------------------------------------
+
+
+class _SlotSim:
+    """Name-only replica of boundagg.NodeSlots' swap-remove assignment.
+
+    The per-pass path's node tie-break order is the persistent
+    featurizer's slot order, which depends on the entire churn history
+    (a delete moves the LAST slot's node into the freed slot).  The
+    lowering replays that exact evolution one step ahead of the store to
+    produce the per-step rank tensors."""
+
+    def __init__(self, slot_of: dict[str, int] | None = None, names: list[str] | None = None) -> None:
+        self.slot_of: dict[str, int] = dict(slot_of or {})
+        self.names: list[str] = list(names or [])
+
+    def sync(self, current_names: Sequence[str]) -> None:
+        """Mirror NodeSlots.sync for a post-step node-name set, in the
+        store's name-sorted list order (what featurize receives)."""
+        present = set(current_names)
+        gone = [s for nm, s in self.slot_of.items() if nm not in present]
+        for s in sorted(gone, reverse=True):
+            nm = self.names[s]
+            last = len(self.names) - 1
+            del self.slot_of[nm]
+            if s != last:
+                moved = self.names[last]
+                self.names[s] = moved
+                self.slot_of[moved] = s
+            self.names.pop()
+        for nm in current_names:
+            if nm not in self.slot_of:
+                self.slot_of[nm] = len(self.names)
+                self.names.append(nm)
+
+
+# ---------------------------------------------------------------------------
+# Static program configuration (jit cache key material)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _SegmentStatics:
+    """Hashable statics of one compiled segment program."""
+
+    k: int  # steps per dispatch
+    q: int  # compacted queue width
+    cap: int  # max_pods_per_pass (large sentinel when uncapped)
+    n_tk: int  # inter-pod topology-key vocab width
+    n_dom: int  # inter-pod padded domain count (segment id space)
+
+
+# ---------------------------------------------------------------------------
+# The compiled K-step program
+# ---------------------------------------------------------------------------
+
+
+def _derive_interpod(loc: dict, ipa: dict, st: _SegmentStatics) -> dict:
+    """Local per-node term accumulators -> the domain-aggregated carry
+    view the InterPodAffinity kernels consume (state/interpod.py
+    cnt_node/ecnt_node/ew_node/total semantics):
+
+    ``cnt[n, t] = sum over n' in n's term_tk[t]-domain of loc_cnt[n', t]``
+
+    computed per topology key with one segment reduction (the key vocab
+    is tiny and static, so the per-key results select together), and
+    ``total[t]`` summed over key-carrying nodes only — exactly the
+    encoder's "no topologyPair exists on a keyless node" rule."""
+    import jax
+    import jax.numpy as jnp
+
+    node_dom = ipa["node_dom"]  # i32 [N, TK]
+    term_tk = ipa["term_tk"]  # i32 [T]
+    dom_t = ipa["dom_t"]  # i32 [N, T]
+    out = {}
+    for name, key in (("cnt", "cnt"), ("ecnt", "eat"), ("ew", "vw")):
+        arr = loc[key]  # i32 [N, T]
+        acc = jnp.zeros_like(arr)
+        for k in range(st.n_tk):
+            ids = node_dom[:, k]  # [N], -1 = key absent
+            safe = jnp.where(ids >= 0, ids, st.n_dom)  # junk segment
+            seg = jax.ops.segment_sum(arr, safe, num_segments=st.n_dom + 1)
+            derived = jnp.where(ids[:, None] >= 0, seg[jnp.minimum(safe, st.n_dom)], 0)
+            acc = jnp.where((term_tk == k)[None, :], derived, acc)
+        out[name] = acc
+    out["total"] = jnp.sum(jnp.where(dom_t >= 0, loc["cnt"], 0), axis=0)
+    return out
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
+    """Run K scenario steps on-device.
+
+    const: universe-static arrays — node statics (allocatable /
+        allowed_pods / unschedulable), pod rows (requests / nonzero /
+        tolerates / has_requests / spread-selector and inter-pod term
+        rows), the full plugin aux pytree.
+    ev: per-step event streams, leading axis K — pod/node create/delete
+        index lists (-1 padded), the flush flag, and the canonical rank
+        tensor.
+    state0: the carried cluster tensor state at segment start.
+
+    Returns (final_state, outputs) where outputs stack per-step selected
+    node rows + attempted pod rows and the step aggregates."""
+    import jax
+    import jax.numpy as jnp
+
+    from ksim_tpu.plugins.base import NodeStateView, PodBatch
+    from ksim_tpu.engine.core import SCAN_UNROLL
+
+    max_backoff, flush_cap = _backoff_constants()
+    # _record_attempts' delay is min(2^(attempts_new-1), MAX) — computed
+    # as a shift with the exponent clamped where the cap saturates.
+    shift_cap = max(max_backoff.bit_length() - 1, 0)
+    aux = const["aux"]
+    nstat = const["node"]
+    prow = const["pods"]
+    ipa = aux["interpod"]
+    P = prow["requests"].shape[0]
+    N = nstat["allocatable"].shape[0]
+    sel_rows = aux["spread"]["pod_sel_match"]  # bool [P, S]
+    qm_rows = ipa["pod_term_match"]  # bool [P, T]
+    eat_rows = ipa["pod_eat"]  # i32 [P, T]
+    vw_rows = ipa["pod_vw"]  # i32 [P, T]
+
+    def apply_pod_deletes(s: dict, pdel: jnp.ndarray) -> dict:
+        v = pdel >= 0
+        safe = jnp.clip(pdel, 0, P - 1)
+        bnode = jnp.where(v, s["bound"][safe], -1)  # [Ed]
+        tgt = jnp.where(bnode >= 0, bnode, N)  # OOB rows drop
+        s = dict(s)
+        s["requested"] = s["requested"].at[tgt].add(
+            -prow["requests"][safe], mode="drop"
+        )
+        s["nonzero_requested"] = s["nonzero_requested"].at[tgt].add(
+            -prow["nonzero_requests"][safe], mode="drop"
+        )
+        s["pod_count"] = s["pod_count"].at[tgt].add(-1, mode="drop")
+        s["spread"] = s["spread"].at[tgt].add(
+            -sel_rows[safe].astype(s["spread"].dtype), mode="drop"
+        )
+        s["ip_cnt"] = s["ip_cnt"].at[tgt].add(
+            -qm_rows[safe].astype(s["ip_cnt"].dtype), mode="drop"
+        )
+        s["ip_eat"] = s["ip_eat"].at[tgt].add(-eat_rows[safe], mode="drop")
+        s["ip_vw"] = s["ip_vw"].at[tgt].add(-vw_rows[safe], mode="drop")
+        gone = jnp.where(v, pdel, P)
+        s["alive"] = s["alive"].at[gone].set(False, mode="drop")
+        s["bound"] = s["bound"].at[gone].set(-1, mode="drop")
+        return s
+
+    def apply_node_events(s: dict, ndel, ncre) -> dict:
+        s = dict(s)
+        dmask = (
+            jnp.zeros(N, bool).at[jnp.where(ndel >= 0, ndel, N)].set(True, mode="drop")
+        )
+        s["valid"] = s["valid"] & ~dmask
+        keep = ~dmask
+        s["requested"] = jnp.where(keep[:, None], s["requested"], 0)
+        s["nonzero_requested"] = jnp.where(keep[:, None], s["nonzero_requested"], 0)
+        s["pod_count"] = jnp.where(keep, s["pod_count"], 0)
+        s["spread"] = jnp.where(keep[:, None], s["spread"], 0)
+        s["ip_cnt"] = jnp.where(keep[:, None], s["ip_cnt"], 0)
+        s["ip_eat"] = jnp.where(keep[:, None], s["ip_eat"], 0)
+        s["ip_vw"] = jnp.where(keep[:, None], s["ip_vw"], 0)
+        # Drained nodes' pods re-enter the pending queue (the runner's
+        # requeue_on_node_delete — their backoff state is untouched, the
+        # per-pass entry was popped when they scheduled).
+        requeued = s["alive"] & (s["bound"] >= 0) & dmask[jnp.clip(s["bound"], 0, N - 1)]
+        s["bound"] = jnp.where(requeued, -1, s["bound"])
+        s["valid"] = (
+            s["valid"].at[jnp.where(ncre >= 0, ncre, N)].set(True, mode="drop")
+        )
+        return s
+
+    def step(carry, ev_k):
+        s = dict(carry)
+        s = apply_pod_deletes(s, ev_k["pod_delete"])
+        s = apply_node_events(s, ev_k["node_delete"], ev_k["node_create"])
+        s["alive"] = (
+            s["alive"]
+            .at[jnp.where(ev_k["pod_create"] >= 0, ev_k["pod_create"], P)]
+            .set(True, mode="drop")
+        )
+        # flush_backoff (service semantics): existing entries' remaining
+        # wait capped at min(attempts-1, FLUSH_CAP) from the pre-pass
+        # count.
+        has_entry = s["attempts"] > 0
+        flushed = jnp.minimum(
+            s["retry_at"],
+            s["pass_count"] + jnp.minimum(s["attempts"] - 1, flush_cap),
+        )
+        s["retry_at"] = jnp.where(
+            ev_k["flush"] & has_entry, flushed, s["retry_at"]
+        )
+        any_valid = jnp.any(s["valid"])
+        pc = s["pass_count"] + any_valid.astype(jnp.int32)
+        s["pass_count"] = pc
+
+        # Queue build: pending, not backed off, in universe (= queue
+        # sort) order, first min(eligible, cap) attempted.
+        in_backoff = has_entry & (s["retry_at"] >= pc)
+        elig = s["alive"] & (s["bound"] < 0) & ~in_backoff
+        pos = jnp.cumsum(elig.astype(jnp.int32)) - 1
+        att = elig & (pos < min(st.cap, st.q)) & any_valid
+        idx_q = (
+            jnp.full(st.q, P, jnp.int32)
+            .at[jnp.where(att, pos, st.q)]
+            .set(jnp.arange(P, dtype=jnp.int32), mode="drop")
+        )
+        clamped = jnp.minimum(idx_q, P - 1)
+        pods_q = PodBatch(
+            requests=prow["requests"][clamped],
+            nonzero_requests=prow["nonzero_requests"][clamped],
+            valid=idx_q < P,
+            tolerates_unschedulable=prow["tolerates_unschedulable"][clamped],
+            has_requests=prow["has_requests"][clamped],
+            index=clamped,
+        )
+
+        node_state = NodeStateView(
+            allocatable=nstat["allocatable"],
+            allowed_pods=nstat["allowed_pods"],
+            valid=s["valid"],
+            unschedulable=nstat["unschedulable"],
+            requested=s["requested"],
+            nonzero_requested=s["nonzero_requested"],
+            pod_count=s["pod_count"],
+        )
+        carries = prog.init_carries(aux)
+        carries["PodTopologySpread"] = s["spread"]
+        carries["InterPodAffinity"] = _derive_interpod(
+            {"cnt": s["ip_cnt"], "eat": s["ip_eat"], "vw": s["ip_vw"]}, ipa, st
+        )
+        rank = ev_k["rank"]  # i32 [N], canonical slot, big when dead
+
+        def pod_body(pcarry, pb):
+            nstate, pcarries = pcarry
+            from ksim_tpu.plugins.base import PodView
+
+            pod = PodView(
+                requests=pb.requests,
+                nonzero_requests=pb.nonzero_requests,
+                tolerates_unschedulable=pb.tolerates_unschedulable,
+                has_requests=pb.has_requests,
+                index=pb.index,
+            )
+            ok, _bits, _raw, _final, total = prog._eval_one(
+                nstate, pod, aux, pcarries
+            )
+            # selectHost with the canonical-slot tie-break: max summed
+            # score, minimal rank — the node the per-pass argmax (lowest
+            # slot index) picks.
+            feasible = jnp.any(ok)
+            masked = jnp.where(ok, total, _I32_MIN)
+            cand = ok & (masked == jnp.max(masked))
+            best = jnp.argmin(jnp.where(cand, rank, _I32_MAX)).astype(jnp.int32)
+            best = jnp.where(feasible & pb.valid, best, -1)
+            nstate = nstate.commit(best, pb.requests, pb.nonzero_requests)
+            pcarries = prog._commit_carries(pcarries, pod, best, aux)
+            return (nstate, pcarries), best
+
+        (node_state, carries), sel = jax.lax.scan(
+            pod_body, (node_state, carries), pods_q, unroll=SCAN_UNROLL
+        )
+        s["requested"] = node_state.requested
+        s["nonzero_requested"] = node_state.nonzero_requested
+        s["pod_count"] = node_state.pod_count
+        # The committed spread carry is node-local — carry it forward.
+        s["spread"] = carries["PodTopologySpread"]
+
+        bound_mask = (idx_q < P) & (sel >= 0)
+        bind_node = jnp.where(bound_mask, sel, N)
+        s["ip_cnt"] = s["ip_cnt"].at[bind_node].add(
+            qm_rows[clamped].astype(s["ip_cnt"].dtype), mode="drop"
+        )
+        s["ip_eat"] = s["ip_eat"].at[bind_node].add(eat_rows[clamped], mode="drop")
+        s["ip_vw"] = s["ip_vw"].at[bind_node].add(vw_rows[clamped], mode="drop")
+        s["bound"] = s["bound"].at[jnp.where(bound_mask, idx_q, P)].set(
+            sel, mode="drop"
+        )
+        # Backoff bookkeeping (_record_attempts): success pops the entry,
+        # failure doubles the delay (capped).
+        fail_mask = (idx_q < P) & (sel < 0)
+        a_prev = s["attempts"][clamped]
+        delay = jnp.minimum(1 << jnp.minimum(a_prev, shift_cap), max_backoff)
+        succ_idx = jnp.where(bound_mask, idx_q, P)
+        fail_idx = jnp.where(fail_mask, idx_q, P)
+        s["attempts"] = (
+            s["attempts"]
+            .at[succ_idx].set(0, mode="drop")
+            .at[fail_idx].set(a_prev + 1, mode="drop")
+        )
+        s["retry_at"] = (
+            s["retry_at"]
+            .at[succ_idx].set(0, mode="drop")
+            .at[fail_idx].set(pc + delay, mode="drop")
+        )
+        out = {
+            "sel": sel,
+            "idx": idx_q,
+            "scheduled": jnp.sum(bound_mask.astype(jnp.int32)),
+            "unschedulable": jnp.sum(fail_mask.astype(jnp.int32)),
+            # Zero when the pass never ran (no valid nodes: the per-pass
+            # path returns before even building the queue) — this is what
+            # the featurize-schedule validation and slot advancing key on.
+            "eligible": jnp.where(
+                any_valid, jnp.sum(elig.astype(jnp.int32)), 0
+            ),
+            "pass_count": pc,
+            "pending_after": jnp.sum(
+                (s["alive"] & (s["bound"] < 0)).astype(jnp.int32)
+            ),
+        }
+        return s, out
+
+    final_state, outs = jax.lax.scan(step, dict(state0), ev)
+    return final_state, outs
+
+
+# ---------------------------------------------------------------------------
+# Host driver: segment lowering, dispatch, reconcile
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StepOutcome:
+    """One device-computed scheduling pass, ready for store reconcile."""
+
+    scheduled: int
+    unschedulable: int
+    pending_after: int
+    eligible: int  # queue size before the cap (0 = the pass never featurized)
+    # (namespace, name, node_name) in queue (commit) order.
+    binds: list[tuple[str, str, str]] = field(default_factory=list)
+
+
+@dataclass
+class SegmentOutcome:
+    steps: list[StepOutcome]
+    pass_count: int
+    # namespace/name -> (attempts, retry_at) for the service backoff sync.
+    backoff: dict[str, tuple[int, int]]
+    # Device end-of-segment views for the store parity check.
+    bound_view: dict[str, str]  # pod key -> node name
+    pending_view: set[str]  # pod keys
+
+
+def _cleaned_pending(pod: JSON) -> JSON:
+    """The pod as the per-pass path would featurize it when PENDING
+    (node-drain requeue shape: spec.nodeName and status.phase cleared) —
+    identity-cached per source object so the featurizer's per-pod memo
+    rows survive across segments."""
+    from ksim_tpu.state import objcache
+
+    def build() -> JSON:
+        spec = dict(pod.get("spec") or {})
+        spec.pop("nodeName", None)
+        status = dict(pod.get("status") or {})
+        status.pop("phase", None)
+        return dict(pod, spec=spec, status=status)
+
+    if not pod.get("spec", {}).get("nodeName") and not pod.get("status", {}).get(
+        "phase"
+    ):
+        return pod
+    return objcache.cached("replay_clean", pod, build)
+
+
+class ReplayDriver:
+    """Segment-batched device replay over a ClusterStore + SchedulerService.
+
+    One instance per ScenarioRunner run.  ``try_segment`` lowers K steps
+    against the CURRENT store state and runs them in a single dispatch;
+    ``None`` means the segment is outside the supported vocabulary and
+    the caller must fall back to the per-pass path for those steps."""
+
+    def __init__(
+        self,
+        store,
+        service,
+        *,
+        k: int = SEGMENT_STEPS,
+        requeue_on_node_delete: bool = True,
+    ) -> None:
+        self.store = store
+        self.service = service
+        self.k = max(int(k), 1)
+        # The segment program bakes the runner's drain-requeue semantics
+        # in; a no-requeue runner must take the per-pass path for any
+        # segment containing node deletes.
+        self._requeue = requeue_on_node_delete
+        self._featurizer = None  # persistent device-side featurizer
+        self._sched_name: str | None = None
+        # Evidence counters (the bench rung reports them).
+        self.device_steps = 0
+        self.fallback_steps = 0
+        self.device_round_trips = 0  # one per segment dispatch group
+        self.unsupported: dict[str, int] = {}
+
+    # -- support checks ------------------------------------------------------
+
+    def _reject(self, reason: str) -> None:
+        self.unsupported[reason] = self.unsupported.get(reason, 0) + 1
+
+    def service_supported(self) -> bool:
+        svc = self.service
+        if svc._record != "selection":
+            self._reject("record_mode")
+            return False
+        if svc._preemption:
+            self._reject("preemption")
+            return False
+        if getattr(svc, "_extenders", None):
+            self._reject("extenders")
+            return False
+        if svc._pnts_emulation:
+            self._reject("pnts_emulation")
+            return False
+        if svc._shard_mesh is not None:
+            self._reject("shard_mesh")
+            return False
+        if svc._featurizer_override is not None:
+            self._reject("featurizer_override")
+            return False
+        names = svc._scheduler_names
+        if len(names) != 1:
+            self._reject("multi_profile")
+            return False
+        if svc._plugins_factory is None:
+            prof = svc._profiles.get(names[0])
+            if prof is None:
+                self._reject("no_profile")
+                return False
+            if prof.pre_enqueue_hooks or prof.queue_sort_plugin is not None:
+                self._reject("queue_hooks")
+                return False
+        if svc._waiting:
+            self._reject("permit_waiters")
+            return False
+        self._sched_name = names[0]
+        return True
+
+    _OP_KINDS = frozenset({"pods", "nodes"})
+
+    def ops_supported(self, batches: Sequence[Sequence[Any]]) -> bool:
+        """Cheap op-vocabulary screen (no store access)."""
+        for batch in batches:
+            for op in batch:
+                if op.kind not in self._OP_KINDS or op.op not in ("create", "delete"):
+                    self._reject(f"op:{op.op}/{op.kind}")
+                    return False
+        return True
+
+    @staticmethod
+    def _pod_supported(pod: JSON, sched_names: tuple[str, ...]) -> str | None:
+        """None when the pod fits the tensor vocabulary, else the reason."""
+        from ksim_tpu.scheduler.profile import DEFAULT_SCHEDULER_NAME
+        from ksim_tpu.state.extras import _host_ports
+        from ksim_tpu.state.volumes import _pod_has_volumes
+
+        spec = pod.get("spec", {})
+        if spec.get("schedulingGates"):
+            return "scheduling_gates"
+        name = spec.get("schedulerName") or DEFAULT_SCHEDULER_NAME
+        if name not in sched_names:
+            return "foreign_scheduler"
+        if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+            return "terminal_phase"
+        if pod.get("status", {}).get("nominatedNodeName"):
+            return "nominated_node"
+        if _host_ports(pod):
+            return "host_ports"
+        if _pod_has_volumes(pod):
+            return "volumes"
+        return None
+
+    # -- lowering ------------------------------------------------------------
+
+    def try_segment(self, batches: list[list[Any]]):
+        """Lower + run K steps; returns SegmentOutcome or None (fallback).
+        Must be called BEFORE the steps' ops touch the store."""
+        if not self.ops_supported(batches) or not self.service_supported():
+            return None
+        try:
+            plan = self._lower(batches)
+        except _Unsupported as e:
+            self._reject(str(e))
+            return None
+        if plan is None:
+            return None
+        return self._run(plan)
+
+    def _service_featurizer(self):
+        """The canonical per-pass featurizer (created exactly as the
+        service would, so a later fallback pass sees the same instance
+        and — critically — the same NodeSlots history)."""
+        svc = self.service
+        name = self._sched_name
+        feat = svc._featurizers.get(name)
+        if feat is None:
+            from ksim_tpu.state.featurizer import Featurizer
+
+            if svc._plugins_factory is not None:
+                feat = Featurizer(pod_bucket_min=svc._pod_bucket_min)
+            else:
+                feat = svc._profiles[name].featurizer(
+                    pod_bucket_min=svc._pod_bucket_min
+                )
+            svc._featurizers[name] = feat
+        return feat
+
+    def _lower(self, batches: list[list[Any]]):
+        from ksim_tpu.engine.core import _Program
+        from ksim_tpu.scheduler.service import queue_sort_key
+        from ksim_tpu.state.featurizer import bucket_size
+        from ksim_tpu.state.priorities import build_priority_resolver
+
+        svc = self.service
+        store = self.store
+        for kind in ("persistentvolumes", "persistentvolumeclaims", "storageclasses"):
+            if store.list(kind, copy_objs=False):
+                raise _Unsupported("volume_objects")
+
+        cur_pods = store.list("pods", copy_objs=False)
+        cur_nodes = store.list("nodes", copy_objs=False)
+        node_names = {name_of(n) for n in cur_nodes}
+        sched_names = svc._scheduler_names
+
+        # Net per-step object events (create+delete of the same object
+        # within one step cancels — the pass never sees it).
+        pod_objs: dict[str, JSON] = {_pod_key(p): p for p in cur_pods}
+        known_pods = set(pod_objs)
+        # Names ever used within the segment (including deleted ones): a
+        # recreated name would collapse two distinct objects onto one
+        # universe row / node slot, so it falls back instead.
+        seen_pod_keys = set(known_pods)
+        created_pods: list[JSON] = []
+        step_pod_creates: list[list[str]] = []
+        step_pod_deletes: list[list[str]] = []
+        step_node_creates: list[list[str]] = []
+        step_node_deletes: list[list[str]] = []
+        step_flush: list[bool] = []
+        created_nodes: list[JSON] = []
+        live_node_names = set(node_names)
+        seen_node_names = set(node_names)
+        for batch in batches:
+            pc, pd, nc, nd = [], [], [], []
+            for op in batch:
+                if op.kind == "pods":
+                    if op.op == "create":
+                        key = _pod_key(op.obj)
+                        if key in seen_pod_keys:
+                            raise _Unsupported("pod_name_reuse")
+                        if key in svc._backoff:
+                            # A stale backoff entry for a DEAD same-name
+                            # pod: the per-pass path would let the new
+                            # pod inherit it (_in_backoff is key-based),
+                            # which the fresh universe row cannot model.
+                            raise _Unsupported("backoff_name_reuse")
+                        if op.obj.get("spec", {}).get("nodeName") or op.obj.get(
+                            "status", {}
+                        ).get("phase"):
+                            raise _Unsupported("create_bound_pod")
+                        seen_pod_keys.add(key)
+                        known_pods.add(key)
+                        pod_objs[key] = op.obj
+                        created_pods.append(op.obj)
+                        pc.append(key)
+                    else:
+                        key = f"{op.namespace or 'default'}/{op.name}"
+                        if key not in known_pods:
+                            raise _Unsupported("delete_unknown_pod")
+                        if key in pc:
+                            pc.remove(key)  # same-step create+delete: net no-op
+                        else:
+                            pd.append(key)
+                        known_pods.discard(key)
+                elif op.kind == "nodes":
+                    if op.op == "create":
+                        nm = name_of(op.obj)
+                        if nm in seen_node_names:
+                            raise _Unsupported("node_name_reuse")
+                        seen_node_names.add(nm)
+                        live_node_names.add(nm)
+                        created_nodes.append(op.obj)
+                        nc.append(nm)
+                    else:
+                        if not self._requeue:
+                            raise _Unsupported("drain_without_requeue")
+                        if op.name not in live_node_names:
+                            raise _Unsupported("delete_unknown_node")
+                        if op.name in nc:
+                            nc.remove(op.name)
+                        else:
+                            nd.append(op.name)
+                        live_node_names.discard(op.name)
+            step_pod_creates.append(pc)
+            step_pod_deletes.append(pd)
+            step_node_creates.append(nc)
+            step_node_deletes.append(nd)
+            step_flush.append(
+                any(
+                    op.kind == "nodes" or (op.op == "delete" and op.kind == "pods")
+                    for op in batch
+                )
+            )
+
+        for n in list(cur_nodes) + created_nodes:
+            if n.get("status", {}).get("images"):
+                raise _Unsupported("node_images")
+
+        # Universe pods, globally sorted by the exact per-pass queue key
+        # (static per pod), so slot order IS queue order every step.
+        priority_of = build_priority_resolver(
+            store.list("priorityclasses", copy_objs=False)
+        )
+        universe_pods = list(cur_pods) + created_pods
+        for p in universe_pods:
+            reason = self._pod_supported(p, sched_names)
+            if reason is not None:
+                raise _Unsupported(reason)
+        universe_pods.sort(key=lambda p: queue_sort_key(p, priority_of))
+        row_of = {_pod_key(p): j for j, p in enumerate(universe_pods)}
+        universe_keys = [_pod_key(p) for p in universe_pods]
+        if len(row_of) != len(universe_pods):
+            raise _Unsupported("duplicate_pod_keys")
+
+        # Featurize the universe once (persistent device featurizer:
+        # per-pod rows memoize, bound aggregates update by delta).
+        if self._featurizer is None:
+            if svc._plugins_factory is not None:
+                from ksim_tpu.state.featurizer import Featurizer
+
+                self._featurizer = Featurizer()
+            else:
+                self._featurizer = svc._profiles[self._sched_name].featurizer()
+        universe_nodes = list(cur_nodes) + created_nodes
+        clean_pods = [_cleaned_pending(p) for p in universe_pods]
+        bound_pods = store.pods_with_node()
+        feats = self._featurizer.featurize(
+            universe_nodes,
+            (),
+            queue_pods=clean_pods,
+            bound_pods=bound_pods,
+            namespaces=store.list("namespaces", copy_objs=False),
+        )
+        if not feats.exact:
+            raise _Unsupported("inexact_units")
+        slot_of = dict(self._featurizer._slots.slot_of)
+
+        factory = (
+            svc._plugins_factory
+            if svc._plugins_factory is not None
+            else svc._profiles[self._sched_name].plugins
+        )
+        plugins = tuple(factory(feats))
+        for sp in plugins:
+            if sp.extender is not None:
+                raise _Unsupported("plugin_extender")
+            for attr in (
+                "reserve",
+                "unreserve",
+                "permit",
+                "pre_bind",
+                "bind",
+                "post_bind",
+                "post_filter",
+            ):
+                if hasattr(sp.plugin, attr):
+                    raise _Unsupported(f"host_hook:{attr}")
+        prog = _Program(plugins, "selection")
+
+        N = feats.nodes.padded
+        P = feats.pods.requests.shape[0]
+        K = len(batches)
+        ipa = feats.aux["interpod"]
+        spread = feats.aux["spread"]
+
+        # Initial dynamic state.
+        valid0 = np.zeros(N, bool)
+        for n in cur_nodes:
+            valid0[slot_of[name_of(n)]] = True
+        alive0 = np.zeros(P, bool)
+        bound0 = np.full(P, -1, np.int32)
+        cur_keys = {_pod_key(p) for p in cur_pods}
+        for p in cur_pods:
+            j = row_of[_pod_key(p)]
+            alive0[j] = True
+            nn = p.get("spec", {}).get("nodeName")
+            if nn:
+                ns = slot_of.get(nn)
+                if ns is None:
+                    raise _Unsupported("bound_to_unknown_node")
+                bound0[j] = ns
+        attempts0 = np.zeros(P, np.int32)
+        retry0 = np.zeros(P, np.int32)
+        for key, (a, r) in svc._backoff.items():
+            j = row_of.get(key)
+            if j is not None and key in cur_keys:
+                attempts0[j] = a
+                retry0[j] = r
+
+        # Inter-pod local per-node accumulators from the bound population
+        # (the linear pre-aggregation the segment re-derives each step).
+        T = ipa.pod_term_match.shape[1]
+        ip_cnt0 = np.zeros((N, T), np.int32)
+        ip_eat0 = np.zeros((N, T), np.int32)
+        ip_vw0 = np.zeros((N, T), np.int32)
+        b_rows = [row_of[_pod_key(p)] for p in bound_pods]
+        b_slots = [int(bound0[j]) for j in b_rows]
+        if b_rows:
+            rows = np.asarray(b_rows)
+            slots = np.asarray(b_slots)
+            np.add.at(ip_cnt0, slots, ipa.pod_term_match[rows].astype(np.int32))
+            np.add.at(ip_eat0, slots, ipa.pod_eat[rows])
+            np.add.at(ip_vw0, slots, ipa.pod_vw[rows])
+        n_dom = int(ipa.n_domains)
+        from ksim_tpu.state.featurizer import vocab_pad
+
+        n_dom_pad = vocab_pad(n_dom + 1)
+        if not self._check_interpod_locals(
+            ipa, ip_cnt0, ip_eat0, ip_vw0, n_dom_pad
+        ):
+            self._reject("interpod_local_mismatch")
+            return None
+
+        # Per-step event index tensors (-1 padded) + canonical ranks.
+        # Widths bucket like every other axis: an exact-max width would
+        # hand the jit cache a fresh shape (= a multi-second compile)
+        # nearly every segment.
+        def pad(lists: list[list[int]]) -> np.ndarray:
+            width = vocab_pad(max((len(x) for x in lists), default=1))
+            out = np.full((K, width), -1, np.int32)
+            for k, xs in enumerate(lists):
+                out[k, : len(xs)] = xs
+            return out
+
+        pod_create = pad([[row_of[k] for k in xs] for xs in step_pod_creates])
+        pod_delete = pad([[row_of[k] for k in xs] for xs in step_pod_deletes])
+        node_create = pad([[slot_of[n] for n in xs] for xs in step_node_creates])
+        node_delete = pad([[slot_of[n] for n in xs] for xs in step_node_deletes])
+
+        # The canonical featurizer advances its slot assignment ONLY on
+        # passes that featurize — an empty eligible queue skips the sync
+        # entirely (_schedule_pending_locked's `if not queue: continue`).
+        # Queue emptiness depends on scheduling outcomes, so the lowering
+        # PREDICTS it (a step with pod creates always has an eligible
+        # queue: fresh pods carry no backoff) and the run validates the
+        # prediction against the device-computed eligible counts,
+        # discarding the segment on any mismatch (store untouched).
+        pred_featurizes = [len(xs) > 0 for xs in step_pod_creates]
+        sim_feat = self._service_featurizer()
+        # No getattr default: if NodeSlots' internals ever change shape,
+        # this must fail loudly — a silently empty seed would produce
+        # wrong rank tensors and break the count locks undetected.
+        sim = _SlotSim(sim_feat._slots.slot_of, sim_feat._slots._names)
+        live = set(node_names)
+        ranks = np.full((K, N), _I32_MAX, np.int32)
+        for k in range(K):
+            live -= set(step_node_deletes[k])
+            live |= set(step_node_creates[k])
+            if pred_featurizes[k]:
+                sim.sync(sorted(live))
+            for nm, slot in sim.slot_of.items():
+                ranks[k, slot_of[nm]] = slot
+
+        # Queue width: pending(now) + creates + requeue-able is an exact
+        # upper bound on the pending population at any step, so eligible
+        # can never exceed it (overflow-free by construction).
+        pending_now = int(np.sum(alive0 & (bound0 < 0)))
+        drained = set().union(*step_node_deletes) if step_node_deletes else set()
+        drained_bound = sum(
+            1
+            for p in bound_pods
+            if p.get("spec", {}).get("nodeName") in drained
+        )
+        hard_bound = pending_now + sum(len(x) for x in step_pod_creates) + drained_bound
+        cap = svc._max_pods_per_pass or (1 << 30)
+        q = bucket_size(max(min(cap, hard_bound), 1))
+
+        statics = _SegmentStatics(
+            k=K, q=q, cap=cap, n_tk=ipa.node_dom.shape[1], n_dom=n_dom_pad
+        )
+        const = {
+            "node": dict(
+                allocatable=feats.nodes.allocatable,
+                allowed_pods=feats.nodes.allowed_pods,
+                unschedulable=feats.nodes.unschedulable,
+            ),
+            "pods": dict(
+                requests=feats.pods.requests,
+                nonzero_requests=feats.pods.nonzero_requests,
+                tolerates_unschedulable=feats.pods.tolerates_unschedulable,
+                has_requests=feats.pods.has_requests,
+            ),
+            "aux": None,  # filled with the packed aux pytree below
+        }
+        ev = {
+            "rank": ranks,
+            "flush": np.asarray(step_flush, bool),
+            "pod_create": pod_create,
+            "pod_delete": pod_delete,
+            "node_create": node_create,
+            "node_delete": node_delete,
+        }
+        state0 = {
+            "valid": valid0,
+            "requested": feats.nodes.requested,
+            "nonzero_requested": feats.nodes.nonzero_requested,
+            "pod_count": feats.nodes.pod_count,
+            "alive": alive0,
+            "bound": bound0,
+            "attempts": attempts0,
+            "retry_at": retry0,
+            "spread": spread.init_counts,
+            "ip_cnt": ip_cnt0,
+            "ip_eat": ip_eat0,
+            "ip_vw": ip_vw0,
+            "pass_count": np.asarray(svc._pass_count, np.int32),
+        }
+        return _SegmentPlan(
+            statics=statics,
+            prog=prog,
+            const=const,
+            aux=feats.aux,
+            ev=ev,
+            state0=state0,
+            universe_keys=universe_keys,
+            universe_row_of=row_of,
+            node_names=list(feats.nodes.names),
+            n_steps=K,
+            pred_featurizes=pred_featurizes,
+            initial_pass_count=int(svc._pass_count),
+        )
+
+    @staticmethod
+    def _check_interpod_locals(ipa, cnt, eat, vw, n_dom_pad: int) -> bool:
+        """Verify the local accumulators re-derive the featurizer's own
+        domain-aggregated carry init (numpy mirror of _derive_interpod) —
+        the lowering-time guard against delta/aggregation skew."""
+        node_dom = ipa.node_dom  # [N, TK]
+        term_tk = ipa.term_tk  # [T]
+        dom_t = ipa.dom_t
+        expect = {"cnt": ipa.cnt_node, "ecnt": ipa.ecnt_node, "ew": ipa.ew_node}
+        got = {}
+        for name, arr in (("cnt", cnt), ("ecnt", eat), ("ew", vw)):
+            acc = np.zeros_like(arr)
+            for k in range(node_dom.shape[1]):
+                ids = node_dom[:, k]
+                safe = np.where(ids >= 0, ids, n_dom_pad)
+                seg = np.zeros((n_dom_pad + 1, arr.shape[1]), arr.dtype)
+                np.add.at(seg, safe, arr)
+                derived = np.where(ids[:, None] >= 0, seg[safe], 0)
+                acc = np.where((term_tk == k)[None, :], derived, acc)
+            got[name] = acc
+        total = np.sum(np.where(dom_t >= 0, cnt, 0), axis=0, dtype=np.int64)
+        ok = all(np.array_equal(got[k], expect[k]) for k in expect) and np.array_equal(
+            total.astype(np.int32), ipa.total
+        )
+        if not ok:
+            logger.warning(
+                "device replay: inter-pod local accumulators disagree with "
+                "the featurizer's aggregation; falling back to per-pass"
+            )
+        return ok
+
+    # -- dispatch + decode ---------------------------------------------------
+
+    def _run(self, plan: "_SegmentPlan") -> SegmentOutcome:
+        from ksim_tpu.engine.core import (
+            _aux_host,
+            _pack_tree_to_device,
+            _pull_tree_to_host,
+        )
+
+        aux_host, _axes = _aux_host(plan.aux)
+        const = dict(plan.const)
+        tree = (const["node"], const["pods"], aux_host, plan.ev, plan.state0)
+        node_dev, pods_dev, aux_dev, ev_dev, state_dev = _pack_tree_to_device(tree)
+        const_dev = {"node": node_dev, "pods": pods_dev, "aux": aux_dev}
+        final_state, outs = _segment_fn(
+            plan.statics, plan.prog, const_dev, ev_dev, state_dev
+        )
+        pulled_state, pulled = _pull_tree_to_host(
+            (
+                {
+                    k: final_state[k]
+                    for k in ("alive", "bound", "attempts", "retry_at", "pass_count")
+                },
+                outs,
+            )
+        )
+        self.device_round_trips += 1
+
+        eligible = np.asarray(pulled["eligible"])
+        for k in range(plan.n_steps):
+            if bool(eligible[k] > 0) != plan.pred_featurizes[k]:
+                # The sync-schedule prediction missed (a create-free step
+                # still had eligible pods, or every eligible pod vanished)
+                # — the shipped rank tensors assumed the wrong slot
+                # history.  The store is untouched: discard and fall back.
+                self._reject("featurize_prediction")
+                return None
+        self.device_steps += plan.n_steps
+
+        sel = np.asarray(pulled["sel"])  # [K, Q]
+        idx = np.asarray(pulled["idx"])  # [K, Q]
+        P = len(plan.universe_keys)
+        steps: list[StepOutcome] = []
+        for k in range(plan.n_steps):
+            binds = []
+            for qq in np.nonzero((idx[k] < P) & (sel[k] >= 0))[0]:
+                key = plan.universe_keys[int(idx[k, qq])]
+                ns, _, nm = key.partition("/")
+                binds.append((ns, nm, plan.node_names[int(sel[k, qq])]))
+            steps.append(
+                StepOutcome(
+                    scheduled=int(pulled["scheduled"][k]),
+                    unschedulable=int(pulled["unschedulable"][k]),
+                    pending_after=int(pulled["pending_after"][k]),
+                    eligible=int(eligible[k]),
+                    binds=binds,
+                )
+            )
+        alive = np.asarray(pulled_state["alive"])[:P]
+        bound = np.asarray(pulled_state["bound"])[:P]
+        attempts = np.asarray(pulled_state["attempts"])[:P]
+        retry = np.asarray(pulled_state["retry_at"])[:P]
+        # Per-pass keeps DEAD pods' backoff entries too (until its
+        # shedding valve prunes them), so export every universe row's
+        # entry — device flushes already updated the dead ones — and
+        # fold in pre-segment entries for keys outside the universe,
+        # applying the same flush cap the per-pass path would have
+        # (one min against the FIRST flush step's pre-pass count is
+        # exactly the running minimum over all of them).
+        backoff = {
+            plan.universe_keys[j]: (int(attempts[j]), int(retry[j]))
+            for j in np.nonzero(attempts > 0)[0]
+        }
+        pcs = np.asarray(pulled["pass_count"])
+        _max_backoff, flush_cap = _backoff_constants()
+        flush = np.asarray(plan.ev["flush"])
+        first_flush_pc = None
+        for k in range(plan.n_steps):
+            if bool(flush[k]):
+                first_flush_pc = int(pcs[k - 1]) if k else plan.initial_pass_count
+                break
+        for key, (a, r) in self.service._backoff.items():
+            if key in backoff or key in plan.universe_row_of:
+                continue
+            if first_flush_pc is not None:
+                r = min(r, first_flush_pc + min(a - 1, flush_cap))
+            backoff[key] = (a, r)
+        bound_view = {
+            plan.universe_keys[j]: plan.node_names[int(bound[j])]
+            for j in np.nonzero(alive & (bound >= 0))[0]
+        }
+        pending_view = {
+            plan.universe_keys[j] for j in np.nonzero(alive & (bound < 0))[0]
+        }
+        return SegmentOutcome(
+            steps=steps,
+            pass_count=int(np.asarray(pulled_state["pass_count"]).ravel()[0]),
+            backoff=backoff,
+            bound_view=bound_view,
+            pending_view=pending_view,
+        )
+
+    # -- reconcile -----------------------------------------------------------
+
+    def advance_service_step(self, outcome: StepOutcome) -> None:
+        """Roll the canonical featurizer's slot history forward one step
+        (called after a device step's ops hit the store) so any LATER
+        fallback pass sees exactly the node order the pure per-pass path
+        would have.  A step whose pass never featurized (empty eligible
+        queue) advances nothing — the per-pass path skips the sync too."""
+        if outcome.eligible <= 0:
+            return
+        feat = self._service_featurizer()
+        feat.advance_slots(self.store.list("nodes", copy_objs=False))
+
+    def finalize_segment(self, seg: SegmentOutcome) -> None:
+        """Sync service bookkeeping to the device outcome and verify the
+        store converged to the device's view of the cluster."""
+        svc = self.service
+        svc._pass_count = seg.pass_count
+        with svc._backoff_lock:
+            svc._backoff = dict(seg.backoff)
+        store_bound = {
+            _pod_key(p): p["spec"]["nodeName"]
+            for p in self.store.pods_with_node()
+        }
+        store_pending = {
+            _pod_key(p) for p in self.store.pods_without_node()
+        }
+        if store_bound != seg.bound_view or store_pending != seg.pending_view:
+            extra = set(store_bound) ^ set(seg.bound_view)
+            raise ReplayParityError(
+                "device-resident replay diverged from the store after "
+                f"reconcile: {len(extra)} pod(s) differ (e.g. "
+                f"{sorted(extra)[:3]}); bound {len(store_bound)} vs "
+                f"{len(seg.bound_view)}, pending {len(store_pending)} vs "
+                f"{len(seg.pending_view)}"
+            )
+
+
+@dataclass
+class _SegmentPlan:
+    statics: _SegmentStatics
+    prog: Any
+    const: dict
+    aux: dict
+    ev: dict
+    state0: dict
+    universe_keys: list[str]
+    universe_row_of: dict[str, int]
+    node_names: list[str]
+    n_steps: int
+    pred_featurizes: list[bool]
+    initial_pass_count: int
+
+
+class _Unsupported(Exception):
+    """Lowering found an op/object outside the tensor vocabulary."""

@@ -79,7 +79,16 @@ class ScenarioRunner:
         requeue_on_node_delete: bool = True,
         max_pods_per_pass: int | None = None,
         pod_bucket_min: int | None = None,
+        device_replay: bool = False,
+        device_segment_steps: int | None = None,
     ) -> None:
+        """``device_replay=True`` routes supported step segments through
+        the device-resident path (engine/replay.py): K steps of event
+        application + scheduling per compiled dispatch, host reconcile at
+        segment boundaries, byte-identical scheduling counts.  Steps
+        containing ops outside the tensor vocabulary (patch/update/done,
+        non-pod/node kinds, pods with host ports or volumes, ...) fall
+        back to this per-pass path automatically."""
         self.store = store if store is not None else ClusterStore()
         self.service = (
             service
@@ -94,6 +103,11 @@ class ScenarioRunner:
         )
         self._requeue = requeue_on_node_delete
         self._drained_nodes: set[str] = set()
+        self._device_replay = device_replay
+        self._device_segment_steps = device_segment_steps
+        # The last run's ReplayDriver (evidence counters: device_steps,
+        # fallback_steps, device_round_trips, unsupported reasons).
+        self.replay_driver = None
 
     # -- one operation ------------------------------------------------------
 
@@ -177,48 +191,116 @@ class ScenarioRunner:
 
     # -- replay -------------------------------------------------------------
 
+    def _apply_batch(self, batch: Sequence[Operation]) -> bool:
+        """Apply one step's operations to the store (+ deferred requeue).
+        Returns whether the step carried a doneOperation."""
+        done = False
+        self._drained_nodes = set()
+        for op in batch:
+            self._apply(op)
+            done = done or op.op == "done"
+        self._requeue_pods_of(self._drained_nodes)
+        return done
+
+    def _run_step(self, step: int, batch: list[Operation], result: ScenarioResult) -> bool:
+        """The per-pass step body: apply ops, flush, one scheduling pass.
+        Returns the done flag."""
+        done = self._apply_batch(batch)
+        result.events_applied += len(batch)
+        # The runner drives the store directly (no watch loop), so it
+        # raises the capacity-freed/topology-changed signal itself:
+        # node ops and pod deletions flush the unschedulable backoff.
+        if any(
+            op.kind in ("nodes", "persistentvolumes",
+                        "persistentvolumeclaims", "storageclasses")
+            or (op.op == "delete" and op.kind == "pods")
+            for op in batch
+        ):
+            self.service.flush_backoff()
+        placements = self.service.schedule_pending()
+        scheduled = sum(1 for v in placements.values() if v is not None)
+        unsched = len(placements) - scheduled
+        result.pods_scheduled += scheduled
+        result.unschedulable_attempts += unsched
+        result.steps.append(
+            StepResult(
+                step=step,
+                ops_applied=len(batch),
+                scheduled=scheduled,
+                unschedulable=unsched,
+                pending_after=self.service.pending_count(),
+            )
+        )
+        return done
+
+    def _reconcile_device_step(
+        self, step: int, batch: list[Operation], outcome, result: ScenarioResult
+    ) -> None:
+        """Replay one device-computed step into the store: the step's ops
+        (+ requeue), then the pass's placements in commit order."""
+        self._apply_batch(batch)
+        result.events_applied += len(batch)
+        for ns, name, node in outcome.binds:
+
+            def bind(obj: JSON) -> None:
+                obj.setdefault("spec", {})["nodeName"] = node
+                obj.setdefault("status", {})["phase"] = "Running"
+                obj.get("status", {}).pop("nominatedNodeName", None)
+
+            self.store.patch("pods", name, ns, bind, copy_ret=False)
+        result.pods_scheduled += outcome.scheduled
+        result.unschedulable_attempts += outcome.unschedulable
+        result.steps.append(
+            StepResult(
+                step=step,
+                ops_applied=len(batch),
+                scheduled=outcome.scheduled,
+                unschedulable=outcome.unschedulable,
+                pending_after=outcome.pending_after,
+            )
+        )
+
     def run(self, ops: Iterable[Operation]) -> ScenarioResult:
         """Apply operations grouped by step; one scheduling pass per step
         (every pending pod is attempted each pass, like the upstream
-        queue's flush on cluster events)."""
+        queue's flush on cluster events).  With ``device_replay`` on,
+        supported K-step segments run as single device dispatches (see
+        engine/replay.py); everything else takes this per-pass loop."""
         result = ScenarioResult()
         t0 = time.perf_counter()
         by_step: dict[int, list[Operation]] = {}
         for op in ops:
             by_step.setdefault(op.step, []).append(op)
-        for step in sorted(by_step):
-            batch = by_step[step]
-            done = False
-            self._drained_nodes: set[str] = set()
-            for op in batch:
-                self._apply(op)
-                done = done or op.op == "done"
-            self._requeue_pods_of(self._drained_nodes)
-            result.events_applied += len(batch)
-            # The runner drives the store directly (no watch loop), so it
-            # raises the capacity-freed/topology-changed signal itself:
-            # node ops and pod deletions flush the unschedulable backoff.
-            if any(
-                op.kind in ("nodes", "persistentvolumes",
-                            "persistentvolumeclaims", "storageclasses")
-                or (op.op == "delete" and op.kind == "pods")
-                for op in batch
-            ):
-                self.service.flush_backoff()
-            placements = self.service.schedule_pending()
-            scheduled = sum(1 for v in placements.values() if v is not None)
-            unsched = len(placements) - scheduled
-            result.pods_scheduled += scheduled
-            result.unschedulable_attempts += unsched
-            result.steps.append(
-                StepResult(
-                    step=step,
-                    ops_applied=len(batch),
-                    scheduled=scheduled,
-                    unschedulable=unsched,
-                    pending_after=self.service.pending_count(),
-                )
+        keys = sorted(by_step)
+        driver = None
+        if self._device_replay:
+            from ksim_tpu.engine.replay import SEGMENT_STEPS, ReplayDriver
+
+            driver = ReplayDriver(
+                self.store,
+                self.service,
+                k=self._device_segment_steps or SEGMENT_STEPS,
+                requeue_on_node_delete=self._requeue,
             )
+            self.replay_driver = driver
+        i = 0
+        while i < len(keys):
+            if driver is not None and i + driver.k <= len(keys):
+                seg_keys = keys[i : i + driver.k]
+                batches = [by_step[s] for s in seg_keys]
+                seg = driver.try_segment(batches)
+                if seg is not None:
+                    for step, batch, outcome in zip(seg_keys, batches, seg.steps):
+                        self._reconcile_device_step(step, batch, outcome, result)
+                        driver.advance_service_step(outcome)
+                    driver.finalize_segment(seg)
+                    i += driver.k
+                    continue
+            step = keys[i]
+            if driver is not None:
+                driver.fallback_steps += 1
+            done = self._run_step(step, by_step[step], result)
+            i += 1
             if done:
                 # KEP-140 DoneOperation: "when finish the step
                 # DoneOperation belongs, this Scenario changes its status

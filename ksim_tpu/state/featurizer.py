@@ -191,6 +191,11 @@ class Featurizer:
         from ksim_tpu.state.boundagg import NodeSlots
 
         self._slots = NodeSlots()
+        # Slot churn applied through advance_slots() between featurize
+        # calls (the device-resident replay rolls node history forward
+        # step by step without featurizing); merged into the next
+        # featurize's changed-slot set so family repair still sees it.
+        self._pending_changed: set[int] = set()
         self._agg: dict[str, Any] = {}
         # Shared per-pass bound-set diff (see boundagg.sync_family): one
         # O(bound) comparison per pass instead of one per family.
@@ -201,6 +206,18 @@ class Featurizer:
         # re-scanning 15k+ bound pods per pass was the single largest
         # steady-state featurize cost.
         self._bound_vol_count = 0
+
+    def advance_slots(self, nodes: Sequence[JSON]) -> None:
+        """Advance the persistent node-slot history WITHOUT featurizing.
+
+        The device-resident replay (engine/replay.py) schedules whole
+        step segments off-host; between those steps this featurizer never
+        runs, but its slot assignment must still follow every node
+        delete/create so a later per-pass fallback sees the exact order
+        the pure per-pass history would have produced.  Changed slots
+        accumulate and merge into the next featurize's repair set."""
+        _ordered, changed = self._slots.sync(list(nodes))
+        self._pending_changed |= changed
 
     def featurize(
         self,
@@ -245,6 +262,9 @@ class Featurizer:
         # incremental aggregates.  For a fresh featurizer this is the
         # caller's order.
         nodes, changed_slots = self._slots.sync(nodes)
+        if self._pending_changed:
+            changed_slots = changed_slots | self._pending_changed
+            self._pending_changed = set()
         bound_map = {id(p): p for p in bound_pods}
         # Publish the shared arrival/departure diff for every family this
         # pass syncs (holding the previous map's pod refs keeps ids from

@@ -436,12 +436,25 @@ class KubeApiSource:
         ns = namespace or "default"
         return self._request("GET", f"/api/v1/namespaces/{ns}/pods/{name}")
 
-    def delete_pod(self, namespace: str, name: str) -> None:
+    def delete_pod(self, namespace: str, name: str, *, uid: str = "") -> None:
         """DELETE a live pod — the write-back's eviction verb for
         preemption victims (upstream preemption evicts via the pod
-        DELETE/eviction API)."""
+        DELETE/eviction API).
+
+        ``uid`` ships as DeleteOptions.preconditions.uid (the reference's
+        reflector guards its deletes the same way, storereflector.go:94-96):
+        a same-name pod RECREATED since the store event then answers 409
+        instead of being deleted — without it, the window between the
+        store delete and this call could kill an innocent new pod."""
         ns = namespace or "default"
-        self._request("DELETE", f"/api/v1/namespaces/{ns}/pods/{name}")
+        body: JSON | None = None
+        if uid:
+            body = {
+                "apiVersion": "v1",
+                "kind": "DeleteOptions",
+                "preconditions": {"uid": uid},
+            }
+        self._request("DELETE", f"/api/v1/namespaces/{ns}/pods/{name}", body)
 
     def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
         """POST the binding subresource — exactly what upstream's

@@ -70,6 +70,17 @@ class SyncerOptions:
     additional_filtering: dict[str, FilteringFunction] = field(default_factory=dict)
 
 
+# Mirrored pods remember their LIVE cluster UID here (the mandatory
+# mutators strip metadata.uid, and the store then assigns its own): the
+# write-back's eviction DELETE sends it as a precondition so a same-name
+# pod recreated live since the mirror is never the one deleted
+# (kubeapi.delete_pod; reference storereflector.go:94-96 — the
+# reference's store keeps the live UID, ours records it out-of-band).
+# Deliberately NOT under the result-annotation prefix: result keys are
+# what the write-back pushes onto live pods.
+SOURCE_UID_ANNOTATION = "ksim-tpu/source-uid"
+
+
 def _strip_metadata(obj: JSON) -> JSON:
     """removeUnnecessaryMetadata (syncer.go:174-181)."""
     obj = dict(obj)
@@ -174,9 +185,15 @@ class Syncer:
         for fn in self._filtering.get(kind, ()):
             if not fn(obj, self._dest, event):
                 return None
+        src_uid = obj.get("metadata", {}).get("uid") if kind == "pods" else None
         obj = _strip_metadata(obj)
         for fn in self._mutating.get(kind, ()):
             obj = fn(obj, self._dest, event)
+        if src_uid:
+            md = obj["metadata"] = dict(obj.get("metadata") or {})
+            md["annotations"] = dict(
+                md.get("annotations") or {}, **{SOURCE_UID_ANNOTATION: src_uid}
+            )
         return obj
 
     def _create(self, kind: str, obj: JSON) -> None:

@@ -53,6 +53,17 @@ def _pod_key(pod: JSON) -> str:
     return f"{namespace_of(pod) or 'default'}/{name_of(pod)}"
 
 
+def _source_uid(pod: JSON) -> str:
+    """The pod's LIVE cluster UID, recorded by the syncer at mirror time
+    (syncer.SOURCE_UID_ANNOTATION — the mandatory mutators strip
+    metadata.uid, so the store's own uid never matches the live one).
+    Empty for store-local pods that never existed live."""
+    from ksim_tpu.syncer.syncer import SOURCE_UID_ANNOTATION
+
+    ann = pod.get("metadata", {}).get("annotations") or {}
+    return ann.get(SOURCE_UID_ANNOTATION) or ""
+
+
 def writeback_enabled() -> bool:
     return os.environ.get("KSIM_ALLOW_LIVE_WRITEBACK", "") == "1"
 
@@ -83,10 +94,12 @@ class LiveWriteBack:
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         # ns/name -> node already bound live; ns/name -> last annotation
-        # fingerprint pushed; ns/name set that 404ed (local-only pods —
-        # logged once, then ignored).
+        # set pushed (the sorted item tuple itself — equality comparison,
+        # no hash fingerprint whose collision would silently skip a
+        # push); ns/name set that 404ed (local-only pods — logged once,
+        # then ignored).
         self._bound: dict[str, str] = {}
-        self._pushed: dict[str, int] = {}
+        self._pushed: dict[str, tuple] = {}
         self._missing: set[str] = set()
         # ns/name keys whose store delete is a PREEMPTION EVICTION
         # (note_eviction, fed by SchedulerService.add_eviction_listener).
@@ -163,6 +176,7 @@ class LiveWriteBack:
             # grace sleep before the final dispatch so the mark can
             # arrive.
             work: list[JSON] = []
+            dropped: list[str] = []
             while True:
                 try:
                     event = self._stream.next(timeout=0)
@@ -172,8 +186,28 @@ class LiveWriteBack:
                     break
                 if event.event_type == DELETED:
                     work.append(event.obj)
+                elif event.event_type == MODIFIED:
+                    dropped.append(f"queued {event.event_type} {_pod_key(event.obj)}")
             pending, self._retries = self._retries, []
             work.extend(pod for _t, et, pod, _a in pending if et == DELETED)
+            dropped.extend(
+                f"pending {et} retry (attempt {a}) {_pod_key(pod)}"
+                for _t, et, pod, a in pending
+                if et != DELETED
+            )
+            if dropped:
+                # Only eviction (DELETED) work drains with final-attempt
+                # semantics; everything else dies with the thread, and the
+                # live cluster silently diverges from the store for those
+                # pods — say which ones, so the operator can reconcile.
+                logger.warning(
+                    "write-back exiting with %d undelivered non-eviction "
+                    "update(s) dropped (store/live divergence for these "
+                    "pods): %s",
+                    len(dropped),
+                    "; ".join(dropped[:20])
+                    + ("; ..." if len(dropped) > 20 else ""),
+                )
             if any(_pod_key(p) not in self._evictions for p in work):
                 # Bounded regardless of RECHECK_DELAY_S tuning: the
                 # mark race is microseconds-scale, and stop()'s 5s
@@ -247,13 +281,24 @@ class LiveWriteBack:
                 # the victim and the preemptor (overcommit).  Any OTHER
                 # store delete (reset, user delete through the simulator
                 # API) never touches the real cluster.  The key leaves
-                # the set only on success/404, so a transient failure's
-                # retry still evicts.
+                # the set only on success/404/409, so a transient
+                # failure's retry still evicts.  The victim's UID from
+                # the store event rides as a delete precondition
+                # (kubeapi.delete_pod): a same-name pod RECREATED live
+                # since this event answers 409 and survives — closing
+                # the delete-the-wrong-pod window the reference guards
+                # with the same precondition (storereflector.go:94-96).
                 try:
-                    self._source.delete_pod(ns, name_of(pod))
+                    self._source.delete_pod(ns, name_of(pod), uid=_source_uid(pod))
                     logger.info("evicted live pod %s (preemption)", key)
                 except KubeApiError as e:
-                    if e.code != 404:
+                    if e.code == 409:
+                        logger.warning(
+                            "live pod %s has a different UID than the "
+                            "evicted victim (recreated since); leaving it",
+                            key,
+                        )
+                    elif e.code != 404:
                         raise
                 self._evictions.discard(key)
             return
@@ -277,7 +322,7 @@ class LiveWriteBack:
             if node:
                 self._bound[key] = node
             if ann:
-                self._pushed[key] = hash(tuple(sorted(ann.items())))
+                self._pushed[key] = tuple(sorted(ann.items()))
             return
         if not node and not ann:
             return
@@ -296,6 +341,21 @@ class LiveWriteBack:
                     # a pod running elsewhere would be authoritative-
                     # looking misinformation.
                     live = self._source.get_pod(ns, name_of(pod))
+                    live_uid = live.get("metadata", {}).get("uid") or ""
+                    our_uid = _source_uid(pod)
+                    if live_uid and our_uid and live_uid != our_uid:
+                        # Same name, DIFFERENT pod: the live one was
+                        # recreated since our store mirrored it.  Its
+                        # node is meaningless for us, and writing our
+                        # result annotations onto it would label a
+                        # stranger — stop pushing for this key.
+                        logger.warning(
+                            "live pod %s has UID %s, store has %s "
+                            "(recreated); skipping write-back",
+                            key, live_uid, our_uid,
+                        )
+                        self._diverged.add(key)
+                        return
                     real = live.get("spec", {}).get("nodeName") or ""
                     self._bound[key] = real
                     if real != node:
@@ -308,7 +368,7 @@ class LiveWriteBack:
                         return
                 self._bound[key] = node
             if ann:
-                fp = hash(tuple(sorted(ann.items())))
+                fp = tuple(sorted(ann.items()))
                 if self._pushed.get(key) != fp:
                     self._source.patch_pod_annotations(ns, name_of(pod), ann)
                     self._pushed[key] = fp
