@@ -2,10 +2,16 @@
 
 PY ?= python
 
-.PHONY: test test-tpu bench bench-tpu perf-table serve lint
+.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check
 
 test:
 	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
+
+# One-command behavior-lock verification: the FULL 50k churn stream
+# through both the per-pass and device-resident paths, asserting the
+# 52781/42829 counts stepwise (repo CLAUDE.md).  ~10 min on CPU.
+lock-check:
+	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_device_vs_per_pass -q -rs -m slow
 
 test-tpu:
 	$(PY) -m pytest tests/test_tpu_parity.py -q -rs

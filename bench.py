@@ -231,7 +231,13 @@ def child_rung(
 
 
 def child_churn(
-    seed: int, n_nodes: int, n_events: int, exact: bool = False, device: bool = False
+    seed: int,
+    n_nodes: int,
+    n_events: int,
+    exact: bool = False,
+    device: bool = False,
+    preempt: bool = False,
+    record_full: bool = False,
 ) -> dict:
     """BASELINE config 5: churn replay — rolling pod arrivals/completions
     + node drain/replace over the full default plugin set, sequential
@@ -256,7 +262,11 @@ def child_churn(
     # compile (upstream schedules one pod per cycle; capping a batch just
     # leaves the rest queued).
     runner = ScenarioRunner(
-        max_pods_per_pass=1024, pod_bucket_min=128, device_replay=device
+        max_pods_per_pass=1024,
+        pod_bucket_min=128,
+        device_replay=device,
+        preemption=preempt,
+        record="full" if record_full else "selection",
     )
     res = runner.run(
         churn_scenario(seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100)
@@ -269,18 +279,25 @@ def child_churn(
         "unschedulable_attempts": res.unschedulable_attempts,
         "steps": len(res.steps),
         "exact": bool(exact),
+        "preemption": bool(preempt),
+        "record": "full" if record_full else "selection",
         "platform": jax.devices()[0].platform,
     }
     if device and runner.replay_driver is not None:
         # Dispatch evidence: the per-pass path pays one engine round-trip
         # group (pack + scan + pull) per scheduling pass; the device path
-        # pays one per SEGMENT plus one per fallback step.
+        # pays one per SEGMENT plus one per fallback step.  The fallback
+        # histogram (SegmentLowerer reject reasons) and the on-device
+        # step fraction track tensor-vocabulary coverage across rounds.
         drv = runner.replay_driver
         round_trips = drv.device_round_trips + drv.fallback_steps
         out.update(
             device=True,
             device_steps=drv.device_steps,
             fallback_steps=drv.fallback_steps,
+            device_step_fraction=(
+                round(drv.device_steps / len(res.steps), 4) if res.steps else None
+            ),
             device_round_trips=drv.device_round_trips,
             per_pass_round_trips=len(res.steps),
             dispatch_reduction=(
@@ -290,7 +307,8 @@ def child_churn(
         )
     print(
         f"[churn {n_events}ev/{n_nodes}n"
-        f"{' exact' if exact else ''}{' device' if device else ''}] "
+        f"{' exact' if exact else ''}{' device' if device else ''}"
+        f"{' preempt' if preempt else ''}{' full' if record_full else ''}] "
         f"{res.wall_seconds:.1f}s "
         f"({res.events_per_second:.0f} ev/s, {res.pods_scheduled} scheduled)",
         file=sys.stderr,
@@ -317,6 +335,8 @@ def _child_main(args: argparse.Namespace) -> None:
                 args.churn_events,
                 args.churn_exact,
                 args.churn_device,
+                args.churn_preempt,
+                args.churn_record_full,
             )
         else:  # pragma: no cover
             raise ValueError(f"unknown child mode {args.child!r}")
@@ -516,6 +536,8 @@ def main() -> None:
     ap.add_argument("--churn-nodes", type=int, default=2_000)
     ap.add_argument("--churn-exact", action="store_true")
     ap.add_argument("--churn-device", action="store_true")
+    ap.add_argument("--churn-preempt", action="store_true")
+    ap.add_argument("--churn-record-full", action="store_true")
     try:
         default_budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     except ValueError:
@@ -715,55 +737,87 @@ def main() -> None:
         payload["rungs"]["churn"] = result
         orch.flush_partial()
 
+    def run_secondary_churn_rung(
+        rung_name: str,
+        child_args,
+        timeout: float,
+        min_budget: float = 90,
+    ) -> None:
+        """Shared scaffolding of the secondary churn rungs: the budget
+        guard, the child launch, and the mid-run-fallback protocol (a
+        chip that died mid-run gets ONE resized retry; a transient relay
+        drop on a confirmed-alive backend gets the one-shot
+        retry_transient) — one copy, three rungs.  ``child_args(resized)``
+        builds the child argv; ``resized=True`` after a mid-run chip
+        transition (the rung should re-cap to its CPU sizing)."""
+        if args.skip_churn or args.only:
+            return
+        if orch.remaining() < min_budget:
+            payload["rungs"][rung_name] = {"error": "skipped: budget exhausted"}
+            return
+
+        def launch(resized: bool) -> dict:
+            return orch.run_child("churn", child_args(resized), env, timeout)
+
+        result = launch(fallback)
+        if "error" in result:
+            state = check_mid_run_fallback()
+            if state == "transitioned":
+                retry = launch(True)
+                result = retry if "error" not in retry else result
+            else:
+                result = retry_transient(
+                    state, result, lambda: launch(fallback), rung_name
+                )
+        payload["rungs"][rung_name] = result
+        orch.flush_partial()
+
+    def churn_device_args(resized: bool, extra: "list[str]" = ()) -> list:
+        """Device-rung child argv.  On CPU (or after a mid-run chip
+        death) cap to the 6k prefix: counts and the dispatch ratio are
+        platform-independent, and the device path's padded universe
+        makes the full 50k replay CPU-hostile.  Preemption ON since
+        round 7: a no-op for this stream's outcomes (no priority
+        strata), but it exercises the on-device victim search's
+        no-candidate path and proves the former blanket "preemption"
+        fallback (PR 1: every step rejected) is gone — the locked
+        counts must hold unchanged."""
+        events, nodes = args.churn_events, args.churn_nodes
+        if resized:
+            events = min(events, 6_000)
+            nodes = min(nodes, CPU_CHURN_CAP[1])
+        return [
+            "--seed", str(args.seed),
+            "--churn-events", str(events),
+            "--churn-nodes", str(nodes),
+            "--churn-device",
+            "--churn-preempt",
+            *extra,
+        ]
+
     def run_churn_device_stage() -> None:
         """Device-resident replay rung (engine/replay.py): the K-step
         segment-scan path over the same churn stream.  Evidence it must
         record: byte-identical counts through the device path, and the
         per-replay dispatch reduction vs one round trip per pass (the
-        round-5 TPU latency floor this path exists to remove).  On a CPU
-        fallback the rung runs the 6k prefix — the dispatch ratio and the
-        locked-prefix counts are platform-independent; the wall-clock
-        trajectory is only meaningful on the chip."""
-        if args.skip_churn or args.only:
-            return
-        if orch.remaining() < 90:
-            payload["rungs"]["churn_device"] = {"error": "skipped: budget exhausted"}
-            return
-        events = args.churn_events
-        nodes = args.churn_nodes
-        if fallback:
-            # Same sizing rule as run_churn_stage's fallback, plus the 6k
-            # event cap: counts and the dispatch ratio are platform-
-            # independent, and the device path's padded universe makes
-            # the full 50k replay CPU-hostile.
-            events = min(events, 6_000)
-            nodes = min(nodes, CPU_CHURN_CAP[1])
+        round-5 TPU latency floor this path exists to remove)."""
+        run_secondary_churn_rung(
+            "churn_device", churn_device_args, CHURN_TIMEOUT
+        )
 
-        def launch() -> dict:
-            return orch.run_child(
-                "churn",
-                [
-                    "--seed", str(args.seed),
-                    "--churn-events", str(events),
-                    "--churn-nodes", str(nodes),
-                    "--churn-device",
-                ],
-                env,
-                CHURN_TIMEOUT,
-            )
-
-        result = launch()
-        if "error" in result:
-            state = check_mid_run_fallback()
-            if state == "transitioned":
-                events = min(events, 6_000)
-                nodes = min(nodes, CPU_CHURN_CAP[1])
-                retry = launch()
-                result = retry if "error" not in retry else result
-            else:
-                result = retry_transient(state, result, launch, "churn_device")
-        payload["rungs"]["churn_device"] = result
-        orch.flush_partial()
+    def run_churn_device_full_stage() -> None:
+        """Bounded record="full" device rung (6k prefix): evidence that
+        full-record segments stream their result tensors out of the
+        segment scan instead of falling back per-pass (the other
+        round-7 fallback-class removal), with the locked prefix counts
+        and the fallback histogram in the record.  Bounded: full-record
+        annotation decode is O(N) per attempt by design — the 50k run
+        is a product workload, not a bench rung."""
+        run_secondary_churn_rung(
+            "churn_device_full",
+            lambda resized: churn_device_args(True, ["--churn-record-full"]),
+            CHURN_TIMEOUT,
+        )
 
     def run_churn_exact_stage() -> None:
         """Bounded exact-mode (x64) churn: demonstrates in the driver
@@ -771,8 +825,6 @@ def main() -> None:
         (the round-4 gap — BENCH_r04's f32 TPU churn silently recorded
         counts off the behavior lock).  6k events reproduce the locked
         prefix (2524/471) in ~30 s CPU / ~90 s TPU."""
-        if args.skip_churn or args.only:
-            return
         main = payload["rungs"].get("churn") or {}
         if main.get("exact"):
             return  # the main churn rung already ran (and recorded) exact
@@ -780,39 +832,17 @@ def main() -> None:
         # TIME OUT (x64 emulation compounds ~10x over ~500 passes vs
         # CHURN_TIMEOUT) — in that case the main rung holds an error
         # record and this bounded stage still supplies exact counts.
-        if orch.remaining() < 120:
-            payload["rungs"]["churn_exact_6k"] = {
-                "error": "skipped: budget exhausted"
-            }
-            return
-
-        def launch() -> dict:
-            return orch.run_child(
-                "churn",
-                [
-                    "--seed", str(args.seed),
-                    "--churn-events", "6000",
-                    "--churn-nodes", str(min(args.churn_nodes, CPU_CHURN_CAP[1])),
-                    "--churn-exact",
-                ],
-                env,
-                CHURN_EXACT_TIMEOUT,
-            )
-
-        result = launch()
-        if "error" in result:
-            # Same mid-run protocol as the other stages: a chip that died
-            # HERE must not burn the next rung's full timeout, and a
-            # transient relay drop on a confirmed-alive backend gets the
-            # one-shot retry.
-            state = check_mid_run_fallback()
-            if state == "transitioned":
-                retry = launch()
-                result = retry if "error" not in retry else result
-            else:
-                result = retry_transient(state, result, launch, "churn_exact_6k")
-        payload["rungs"]["churn_exact_6k"] = result
-        orch.flush_partial()
+        run_secondary_churn_rung(
+            "churn_exact_6k",
+            lambda resized: [
+                "--seed", str(args.seed),
+                "--churn-events", "6000",
+                "--churn-nodes", str(min(args.churn_nodes, CPU_CHURN_CAP[1])),
+                "--churn-exact",
+            ],
+            CHURN_EXACT_TIMEOUT,
+            min_budget=120,
+        )
 
     # Stage order is a record-priority decision: the smallest rung first
     # (a headline number exists early), then the churn replay (config 5's
@@ -827,6 +857,7 @@ def main() -> None:
     # Secondary evidence rungs, deliberately AFTER the headline ladder:
     # a wedged child here must not starve the 10kx5k rung's budget.
     run_churn_device_stage()
+    run_churn_device_full_stage()
     run_churn_exact_stage()
     if fallback:
         # The north-star shape still gets a measured record on CPU: the

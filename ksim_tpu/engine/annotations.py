@@ -120,10 +120,16 @@ class RenderCtx:
     10k pods x 5k nodes the per-entry dict building + json.dumps of the
     nested maps dominated the product path)."""
 
-    def __init__(self, feats: FeaturizedSnapshot, plugins: Sequence[ScoredPlugin]) -> None:
+    def __init__(self, feats, plugins: Sequence[ScoredPlugin]) -> None:
+        """``feats`` is a FeaturizedSnapshot, or a plain sequence of
+        node names — the device-replay decode (engine/replay.py) renders
+        per-step annotations over a step's live-node subset without a
+        featurized snapshot in hand."""
         import numpy as np
 
-        self.node_names = feats.nodes.names
+        self.node_names = (
+            list(feats) if isinstance(feats, (list, tuple)) else feats.nodes.names
+        )
         self.filter_plugins = [sp for sp in plugins if sp.filter_enabled]
         self.score_plugins = [sp for sp in plugins if sp.score_enabled]
         names = self.node_names

@@ -43,11 +43,29 @@ byte-identically.  The design choices that guarantee it:
   step with fixed segment reductions (``_derive_interpod``) — verified
   at lowering time against the featurizer's own aggregation.
 
-Anything outside the supported vocabulary (patch/update ops, pods with
-host ports / volumes / scheduling gates, preemption, extenders, multiple
-profiles, node images, inexact unit scaling, ...) makes ``lower()``
-return None and the segment falls back to the per-pass path, so coverage
-can grow incrementally without risking the locks.
+Two former fallback classes are now lowered instead (round 7):
+
+- **DefaultPreemption** runs ON-DEVICE: the per-candidate fit re-check
+  and the MoreImportantPod reprieve loop are masked tensor ops over the
+  universe (bounded candidate scan + ``lax.fori_loop`` reprieve; the
+  pickOneNode narrowing cascade is one lexicographic argmin), against a
+  LIVE mid-pass state that tracks this pass's binds plus earlier
+  victims — exactly the store view the per-pass dry-run reads.  Bounds
+  exceeded -> per-step overflow flag -> segment discarded before any
+  store effect.
+- **record="full"** streams the per-attempt reason-bit / raw / final
+  score tensors out of the scan as stacked segment outputs (shorter
+  fixed K to bound device memory); the host decodes them into the exact
+  per-pass result annotations at segment boundaries.
+
+Segments shorter than the compiled K (stream tails, mid-window
+vocabulary misses) are tail-padded with inactive no-op steps and reuse
+the existing compile.  Anything outside the remaining vocabulary
+(patch/update ops, pods with host ports / volumes / scheduling gates,
+extenders, multiple profiles, node images, inexact unit scaling, ...)
+makes ``lower()`` return None and the segment falls back to the
+per-pass path, so coverage can grow incrementally without risking the
+locks.
 """
 
 from __future__ import annotations
@@ -70,6 +88,21 @@ logger = logging.getLogger(__name__)
 # useful range (beyond that the universe grows stale and the first
 # fallback forces a re-lower anyway).
 SEGMENT_STEPS = int(os.environ.get("KSIM_REPLAY_K", "16"))
+
+# record="full" segments stack per-step [Q, F|S, N] result tensors on
+# device, so they run at a SHORTER fixed K (one extra compiled shape)
+# and are rejected outright when even that would exceed the byte bound
+# below ("full_record_bytes" fallback).
+FULL_SEGMENT_STEPS = int(os.environ.get("KSIM_REPLAY_FULL_K", "4"))
+FULL_RECORD_BYTES = int(os.environ.get("KSIM_REPLAY_FULL_BYTES", str(1 << 30)))
+
+# On-device preemption victim-search bounds (static shapes for the
+# candidate scan and the unrolled reprieve loop).  A step whose search
+# would exceed either bound sets an overflow flag and the whole segment
+# is DISCARDED before any store effect ("preemption_overflow" fallback)
+# — bounded-exact, never approximate.
+PREEMPT_CANDIDATES = int(os.environ.get("KSIM_REPLAY_CMAX", "16"))
+PREEMPT_VICTIMS = int(os.environ.get("KSIM_REPLAY_VMAX", "8"))
 
 _I32_MIN = np.iinfo(np.int32).min
 _I32_MAX = np.iinfo(np.int32).max
@@ -151,6 +184,10 @@ class _SegmentStatics:
     cap: int  # max_pods_per_pass (large sentinel when uncapped)
     n_tk: int  # inter-pod topology-key vocab width
     n_dom: int  # inter-pod padded domain count (segment id space)
+    record: str = "selection"  # "selection" | "full" (streamed results)
+    preempt: bool = False  # on-device DefaultPreemption victim search
+    c_max: int = PREEMPT_CANDIDATES  # candidate-node scan bound
+    v_max: int = PREEMPT_VICTIMS  # victims-per-candidate bound
 
 
 # ---------------------------------------------------------------------------
@@ -197,14 +234,20 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
     const: universe-static arrays — node statics (allocatable /
         allowed_pods / unschedulable), pod rows (requests / nonzero /
         tolerates / has_requests / spread-selector and inter-pod term
-        rows), the full plugin aux pytree.
+        rows; with preemption also priority / importance / start-time
+        ranks), the full plugin aux pytree, and (full-record preemption)
+        the per-plugin reason-bit resolvability table.
     ev: per-step event streams, leading axis K — pod/node create/delete
-        index lists (-1 padded), the flush flag, and the canonical rank
-        tensor.
+        index lists (-1 padded), the flush flag, the canonical rank
+        tensor, the per-step active flag (False = tail padding: the step
+        is a pure no-op), and (preemption) the per-step name-order node
+        ranks + upstream candidate count.
     state0: the carried cluster tensor state at segment start.
 
     Returns (final_state, outputs) where outputs stack per-step selected
-    node rows + attempted pod rows and the step aggregates."""
+    node rows + attempted pod rows and the step aggregates, plus (full
+    record) the per-attempt result tensors and (preemption) nominated
+    nodes / victim rows / the bound-overflow flag."""
     import jax
     import jax.numpy as jnp
 
@@ -225,6 +268,47 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
     qm_rows = ipa["pod_term_match"]  # bool [P, T]
     eat_rows = ipa["pod_eat"]  # i32 [P, T]
     vw_rows = ipa["pod_vw"]  # i32 [P, T]
+    n_filters = sum(1 for sp in prog.plugins if sp.filter_enabled)
+    n_scores = sum(1 for sp in prog.plugins if sp.score_enabled)
+    bits_dtype, final_dtype = prog._result_dtypes()
+    # Effective search bounds: the configured statics clamped to the
+    # padded axes (top_k needs k <= axis; small universes can't overflow
+    # a bound wider than themselves anyway).
+    c_eff = min(st.c_max, N)
+    v_eff = min(st.v_max, P)
+
+    def _victim_deltas(rows, act):
+        """Summed universe-row contributions of ``rows`` where ``act``
+        — the aggregate a victim set adds to (or removal subtracts
+        from) one node's carried state (mirrors apply_pod_deletes)."""
+        w = act[:, None]
+        safe = jnp.clip(rows, 0, P - 1)
+        return dict(
+            req=jnp.sum(jnp.where(w, prow["requests"][safe], 0), axis=0),
+            nz=jnp.sum(jnp.where(w, prow["nonzero_requests"][safe], 0), axis=0),
+            cnt=jnp.sum(act.astype(jnp.int32)),
+            sel=jnp.sum(jnp.where(w, sel_rows[safe].astype(jnp.int32), 0), axis=0),
+            qm=jnp.sum(jnp.where(w, qm_rows[safe].astype(jnp.int32), 0), axis=0),
+            eat=jnp.sum(jnp.where(w, eat_rows[safe], 0), axis=0),
+            vw=jnp.sum(jnp.where(w, vw_rows[safe], 0), axis=0),
+        )
+
+    def _sub_victims(live: dict, node_t, d: dict) -> dict:
+        """live minus a victim-delta dict at node index ``node_t`` (OOB
+        index drops — pass N to no-op)."""
+        live = dict(live)
+        live["requested"] = live["requested"].at[node_t].add(-d["req"], mode="drop")
+        live["nonzero_requested"] = live["nonzero_requested"].at[node_t].add(
+            -d["nz"], mode="drop"
+        )
+        live["pod_count"] = live["pod_count"].at[node_t].add(-d["cnt"], mode="drop")
+        live["spread"] = live["spread"].at[node_t].add(
+            -d["sel"].astype(live["spread"].dtype), mode="drop"
+        )
+        live["ip_cnt"] = live["ip_cnt"].at[node_t].add(-d["qm"], mode="drop")
+        live["ip_eat"] = live["ip_eat"].at[node_t].add(-d["eat"], mode="drop")
+        live["ip_vw"] = live["ip_vw"].at[node_t].add(-d["vw"], mode="drop")
+        return live
 
     def apply_pod_deletes(s: dict, pdel: jnp.ndarray) -> dict:
         v = pdel >= 0
@@ -276,8 +360,199 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
         )
         return s
 
+    def _preempt_search(s, live, pod, bits_mat, rank_names, want_k, lower):
+        """DefaultPreemption's victim search for one unschedulable pod,
+        against the LIVE mid-pass state (earlier binds + earlier
+        victims), as bounded tensor ops:
+
+        - candidate nodes = nodes holding >= 1 lower-priority victim,
+          resolvable per the reason-bit table (full-record mode only —
+          the per-pass path has no bits in selection mode), examined in
+          live name order like upstream's node loop (first c_max;
+          overflow discards the segment);
+        - per candidate, the fit re-check runs the profile's compiled
+          filter chain over a hypothetical state with the victims'
+          aggregates subtracted (the lowering gates on the filter set
+          matching the oracle fit chain, preemption.py
+          ORACLE_FIT_FILTER_NAMES);
+        - the reprieve loop re-adds victims in MoreImportantPod order
+          (the pre-lowered imp_rank) as a bounded fori_loop (first
+          v_max; overflow discards);
+        - pickOneNodeForPreemption is the lexicographic min over
+          (max victim prio, prio sum, count, -latest earliest-top-start,
+          discovery order) — exactly the host's narrowing cascade.
+
+        Returns (live', nominated_slot, victim_rows, overflow)."""
+        valid_now = s["valid"]
+        if st.record == "full":
+            fail = bits_mat != 0  # [F, N]
+            fail_any = jnp.any(fail, axis=0)
+            first = jnp.argmax(fail, axis=0)
+            bval = jnp.take_along_axis(bits_mat, first[None, :], axis=0)[0]
+            tw = const["resolv"].shape[1]
+            bval = jnp.clip(bval, 0, tw - 1)
+            resolvable = const["resolv"][first, bval] & fail_any
+        else:
+            resolvable = jnp.ones(N, bool)
+        tgtn = jnp.where(lower, live["bound"], N)
+        vcnt = jnp.zeros(N, jnp.int32).at[tgtn].add(1, mode="drop")
+        examine = (vcnt > 0) & valid_now & resolvable
+        over_c = jnp.sum(examine.astype(jnp.int32)) > c_eff
+        keyed = jnp.where(examine, rank_names, _I32_MAX)
+        negk, cand_nodes = jax.lax.top_k(-keyed, c_eff)
+        cand_act = negk > -_I32_MAX
+
+        def eval_fit(node_i, rows, act):
+            """Does the preemptor pass every filter at node_i with the
+            ``act`` rows' aggregates removed?  Full kernel-chain eval
+            (spread/inter-pod are global: their carries re-derive from
+            the modified locals)."""
+            d = _victim_deltas(rows, act)
+            view = NodeStateView(
+                allocatable=nstat["allocatable"],
+                allowed_pods=nstat["allowed_pods"],
+                valid=valid_now,
+                unschedulable=nstat["unschedulable"],
+                requested=live["requested"].at[node_i].add(-d["req"]),
+                nonzero_requested=live["nonzero_requested"].at[node_i].add(-d["nz"]),
+                pod_count=live["pod_count"].at[node_i].add(-d["cnt"]),
+            )
+            carr = prog.init_carries(aux)
+            carr["PodTopologySpread"] = live["spread"].at[node_i].add(
+                -d["sel"].astype(live["spread"].dtype)
+            )
+            carr["InterPodAffinity"] = _derive_interpod(
+                {
+                    "cnt": live["ip_cnt"].at[node_i].add(-d["qm"]),
+                    "eat": live["ip_eat"].at[node_i].add(-d["eat"]),
+                    "vw": live["ip_vw"].at[node_i].add(-d["vw"]),
+                },
+                ipa,
+                st,
+            )
+            okf, _bits = prog._eval_filters(view, pod, aux, carr)
+            return okf[node_i]
+
+        def cand_body(i, acc):
+            is_c, maxp_a, sump_a, cnt_a, est_a, nrank_a, node_a, vic_a, over = acc
+            n_i = cand_nodes[i]
+            act = cand_act[i]
+            on_n = lower & (live["bound"] == n_i)
+            kv = jnp.where(on_n, prow["imp_rank"], _I32_MAX)
+            negv, vrows = jax.lax.top_k(-kv, v_eff)
+            vact = negv > -_I32_MAX
+            over = over | (act & (jnp.sum(on_n.astype(jnp.int32)) > v_eff))
+            fit0 = eval_fit(n_i, vrows, vact)
+
+            def rep_body(v, rc):
+                removed, vic = rc
+                test = removed.at[v].set(False)
+                okv = eval_fit(n_i, vrows, vact & test)
+                back = vact[v] & okv  # reprieved: stays re-added
+                removed = jnp.where(back, test, removed)
+                vic = vic.at[v].set(vact[v] & ~okv)
+                return removed, vic
+
+            _removed, vic = jax.lax.fori_loop(
+                0, v_eff, rep_body, (vact, jnp.zeros(v_eff, bool))
+            )
+            vprio = prow["priority"][vrows]
+            have = jnp.any(vic)
+            maxp = jnp.max(jnp.where(vic, vprio, _I32_MIN))
+            est = jnp.min(
+                jnp.where(vic & (vprio == maxp), prow["start_rank"][vrows], _I32_MAX)
+            )
+            return (
+                is_c.at[i].set(act & fit0),
+                maxp_a.at[i].set(maxp),
+                sump_a.at[i].set(jnp.sum(jnp.where(vic, vprio, 0))),
+                cnt_a.at[i].set(jnp.sum(vic.astype(jnp.int32))),
+                est_a.at[i].set(
+                    jnp.where(have, est, jnp.reshape(const["empty_start_rank"], ()))
+                ),
+                nrank_a.at[i].set(rank_names[n_i]),
+                node_a.at[i].set(n_i),
+                vic_a.at[i].set(jnp.where(vic, vrows, -1)),
+                over,
+            )
+
+        C = c_eff
+        acc0 = (
+            jnp.zeros(C, bool),
+            jnp.zeros(C, jnp.int32),
+            jnp.zeros(C, jnp.int32),
+            jnp.zeros(C, jnp.int32),
+            jnp.zeros(C, jnp.int32),
+            jnp.zeros(C, jnp.int32),
+            jnp.zeros(C, jnp.int32),
+            jnp.full((C, v_eff), -1, jnp.int32),
+            over_c,
+        )
+        is_c, maxp_a, sump_a, cnt_a, est_a, nrank_a, node_a, vic_a, over = (
+            jax.lax.fori_loop(0, C, cand_body, acc0)
+        )
+        # Upstream stops after `want` successful candidates (discovery =
+        # name order); narrowing criteria 1-4 then "first" compose into
+        # one lexicographic argmin.
+        pos = jnp.cumsum(is_c.astype(jnp.int32)) - 1
+        keep = is_c & (pos < want_k)
+        any_c = jnp.any(keep)
+        m = keep
+        for arr, take_min in (
+            (maxp_a, True),
+            (sump_a, True),
+            (cnt_a, True),
+            (est_a, False),
+            (nrank_a, True),
+        ):
+            kv = jnp.where(m, arr, _I32_MAX if take_min else _I32_MIN)
+            tgt = jnp.min(kv) if take_min else jnp.max(kv)
+            m = m & (arr == tgt)
+        chosen = jnp.argmax(m)
+        nom = jnp.where(any_c, node_a[chosen], -1).astype(jnp.int32)
+        vic_rows = jnp.where(any_c, vic_a[chosen], -1)
+        vact2 = vic_rows >= 0
+        d = _victim_deltas(vic_rows, vact2)
+        live = _sub_victims(live, jnp.where(any_c, nom, N), d)
+        gone = jnp.where(vact2, vic_rows, P)
+        live["alive"] = live["alive"].at[gone].set(False, mode="drop")
+        live["bound"] = live["bound"].at[gone].set(-1, mode="drop")
+        live["nominated"] = (
+            live["nominated"].at[jnp.where(any_c, pod.index, P)].set(True, mode="drop")
+        )
+        return live, nom, vic_rows, over
+
     def step(carry, ev_k):
-        s = dict(carry)
+        def run_step(s):
+            return _run_step(s, ev_k)
+
+        def skip_step(s):
+            z = {
+                "sel": jnp.full(st.q, -1, jnp.int32),
+                "idx": jnp.full(st.q, P, jnp.int32),
+                "scheduled": jnp.zeros((), jnp.int32),
+                "unschedulable": jnp.zeros((), jnp.int32),
+                "eligible": jnp.zeros((), jnp.int32),
+                "pass_count": s["pass_count"],
+                "pending_after": jnp.zeros((), jnp.int32),
+            }
+            if st.preempt:
+                z["nom"] = jnp.full(st.q, -1, jnp.int32)
+                z["vic"] = jnp.full((st.q, v_eff), -1, jnp.int32)
+                z["overflow"] = jnp.zeros((), bool)
+            if st.record == "full":
+                z["bits"] = jnp.zeros((st.q, n_filters, N), bits_dtype)
+                z["raw"] = jnp.zeros((st.q, n_scores, N), jnp.int32)
+                z["final"] = jnp.zeros((st.q, n_scores, N), final_dtype)
+            return s, z
+
+        # Tail-padded (inactive) steps are pure no-ops: same compiled K
+        # shape, zero semantic effect.  Scalar-pred cond in a scan is a
+        # real XLA conditional, so padding costs nothing at runtime.
+        return jax.lax.cond(ev_k["active"], run_step, skip_step, dict(carry))
+
+    def _run_step(s, ev_k):
+        s = dict(s)
         s = apply_pod_deletes(s, ev_k["pod_delete"])
         s = apply_node_events(s, ev_k["node_delete"], ev_k["node_create"])
         s["alive"] = (
@@ -336,9 +611,25 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
             {"cnt": s["ip_cnt"], "eat": s["ip_eat"], "vw": s["ip_vw"]}, ipa, st
         )
         rank = ev_k["rank"]  # i32 [N], canonical slot, big when dead
+        if st.preempt:
+            # The mid-pass LIVE view (what the store holds while
+            # _bind_results iterates): this pass's binds so far PLUS
+            # preemption victims removed so far.  The scan's filter/
+            # score state (nstate + pcarries) stays binds-only — the
+            # per-pass engine ran on the pre-pass snapshot.
+            live0 = {
+                k: s[k]
+                for k in (
+                    "alive", "bound", "requested", "nonzero_requested",
+                    "pod_count", "spread", "ip_cnt", "ip_eat", "ip_vw",
+                    "nominated",
+                )
+            }
+        else:
+            live0 = {}
 
         def pod_body(pcarry, pb):
-            nstate, pcarries = pcarry
+            nstate, pcarries, live = pcarry
             from ksim_tpu.plugins.base import PodView
 
             pod = PodView(
@@ -361,60 +652,159 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
             best = jnp.where(feasible & pb.valid, best, -1)
             nstate = nstate.commit(best, pb.requests, pb.nonzero_requests)
             pcarries = prog._commit_carries(pcarries, pod, best, aux)
-            return (nstate, pcarries), best
+            out_pod = {"best": best}
+            if st.record == "full":
+                out_pod["bits"] = (
+                    jnp.stack(_bits) if _bits else jnp.zeros((0, N), jnp.int32)
+                ).astype(bits_dtype)
+                out_pod["raw"] = (
+                    jnp.stack(_raw) if _raw else jnp.zeros((0, N), jnp.int32)
+                )
+                out_pod["final"] = (
+                    jnp.stack(_final) if _final else jnp.zeros((0, N), jnp.int32)
+                ).astype(final_dtype)
+            if st.preempt:
+                j = pb.index
+                tgtb = jnp.where(best >= 0, best, N)
+                bj = jnp.where(best >= 0, j, P)
+                live = dict(live)
+                live["requested"] = live["requested"].at[tgtb].add(
+                    pb.requests, mode="drop"
+                )
+                live["nonzero_requested"] = live["nonzero_requested"].at[tgtb].add(
+                    pb.nonzero_requests, mode="drop"
+                )
+                live["pod_count"] = live["pod_count"].at[tgtb].add(1, mode="drop")
+                live["spread"] = live["spread"].at[tgtb].add(
+                    sel_rows[j].astype(live["spread"].dtype), mode="drop"
+                )
+                live["ip_cnt"] = live["ip_cnt"].at[tgtb].add(
+                    qm_rows[j].astype(live["ip_cnt"].dtype), mode="drop"
+                )
+                live["ip_eat"] = live["ip_eat"].at[tgtb].add(eat_rows[j], mode="drop")
+                live["ip_vw"] = live["ip_vw"].at[tgtb].add(vw_rows[j], mode="drop")
+                live["bound"] = live["bound"].at[bj].set(best, mode="drop")
+                # The apiserver clears nominations on bind.
+                live["nominated"] = live["nominated"].at[bj].set(False, mode="drop")
+                prio_p = prow["priority"][j]
+                lower = (
+                    live["alive"] & (live["bound"] >= 0) & (prow["priority"] < prio_p)
+                )
+                pred = (
+                    pb.valid
+                    & (best < 0)
+                    & prow["preempt_ok"][j]
+                    & jnp.any(lower)
+                )
+                bits_mat = jnp.stack(_bits) if (st.record == "full" and _bits) else None
 
-        (node_state, carries), sel = jax.lax.scan(
-            pod_body, (node_state, carries), pods_q, unroll=SCAN_UNROLL
+                def do_search(op):
+                    lv, lw = op
+                    return _preempt_search(
+                        s, lv, pod, bits_mat, ev_k["name_rank"], ev_k["want"], lw
+                    )
+
+                def no_search(op):
+                    lv, _lw = op
+                    return (
+                        lv,
+                        jnp.int32(-1),
+                        jnp.full(v_eff, -1, jnp.int32),
+                        jnp.zeros((), bool),
+                    )
+
+                live, nom, vicr, over = jax.lax.cond(
+                    pred, do_search, no_search, (live, lower)
+                )
+                out_pod["nom"] = nom
+                out_pod["vic"] = vicr
+                out_pod["over"] = over
+            return (nstate, pcarries, live), out_pod
+
+        (node_state, carries, live), pod_outs = jax.lax.scan(
+            pod_body, (node_state, carries, live0), pods_q, unroll=SCAN_UNROLL
         )
-        s["requested"] = node_state.requested
-        s["nonzero_requested"] = node_state.nonzero_requested
-        s["pod_count"] = node_state.pod_count
-        # The committed spread carry is node-local — carry it forward.
-        s["spread"] = carries["PodTopologySpread"]
-
+        sel = pod_outs["best"]
         bound_mask = (idx_q < P) & (sel >= 0)
-        bind_node = jnp.where(bound_mask, sel, N)
-        s["ip_cnt"] = s["ip_cnt"].at[bind_node].add(
-            qm_rows[clamped].astype(s["ip_cnt"].dtype), mode="drop"
-        )
-        s["ip_eat"] = s["ip_eat"].at[bind_node].add(eat_rows[clamped], mode="drop")
-        s["ip_vw"] = s["ip_vw"].at[bind_node].add(vw_rows[clamped], mode="drop")
-        s["bound"] = s["bound"].at[jnp.where(bound_mask, idx_q, P)].set(
-            sel, mode="drop"
-        )
-        # Backoff bookkeeping (_record_attempts): success pops the entry,
-        # failure doubles the delay (capped).
         fail_mask = (idx_q < P) & (sel < 0)
+        if st.preempt:
+            # live already holds binds + victim removals: it IS the
+            # post-step state.
+            for k in (
+                "alive", "bound", "requested", "nonzero_requested",
+                "pod_count", "spread", "ip_cnt", "ip_eat", "ip_vw",
+                "nominated",
+            ):
+                s[k] = live[k]
+        else:
+            s["requested"] = node_state.requested
+            s["nonzero_requested"] = node_state.nonzero_requested
+            s["pod_count"] = node_state.pod_count
+            # The committed spread carry is node-local — carry it forward.
+            s["spread"] = carries["PodTopologySpread"]
+            bind_node = jnp.where(bound_mask, sel, N)
+            s["ip_cnt"] = s["ip_cnt"].at[bind_node].add(
+                qm_rows[clamped].astype(s["ip_cnt"].dtype), mode="drop"
+            )
+            s["ip_eat"] = s["ip_eat"].at[bind_node].add(eat_rows[clamped], mode="drop")
+            s["ip_vw"] = s["ip_vw"].at[bind_node].add(vw_rows[clamped], mode="drop")
+            s["bound"] = s["bound"].at[jnp.where(bound_mask, idx_q, P)].set(
+                sel, mode="drop"
+            )
+            s["nominated"] = (
+                s["nominated"]
+                .at[jnp.where(bound_mask, idx_q, P)]
+                .set(False, mode="drop")
+            )
+        # Backoff bookkeeping (_record_attempts): success pops the entry,
+        # failure doubles the delay (capped) — UNLESS the pod holds a
+        # nomination (from this pass or an earlier one): a nominated pod
+        # expects to schedule as soon as its victims are gone, so the
+        # per-pass path pops its entry instead of backing it off.
         a_prev = s["attempts"][clamped]
+        nomd = s["nominated"][clamped]
         delay = jnp.minimum(1 << jnp.minimum(a_prev, shift_cap), max_backoff)
         succ_idx = jnp.where(bound_mask, idx_q, P)
-        fail_idx = jnp.where(fail_mask, idx_q, P)
+        pop_idx = jnp.where(fail_mask & nomd, idx_q, P)
+        inc_idx = jnp.where(fail_mask & ~nomd, idx_q, P)
         s["attempts"] = (
             s["attempts"]
             .at[succ_idx].set(0, mode="drop")
-            .at[fail_idx].set(a_prev + 1, mode="drop")
+            .at[pop_idx].set(0, mode="drop")
+            .at[inc_idx].set(a_prev + 1, mode="drop")
         )
         s["retry_at"] = (
             s["retry_at"]
             .at[succ_idx].set(0, mode="drop")
-            .at[fail_idx].set(pc + delay, mode="drop")
+            .at[pop_idx].set(0, mode="drop")
+            .at[inc_idx].set(pc + delay, mode="drop")
         )
         out = {
             "sel": sel,
             "idx": idx_q,
-            "scheduled": jnp.sum(bound_mask.astype(jnp.int32)),
-            "unschedulable": jnp.sum(fail_mask.astype(jnp.int32)),
+            # astype pins the cond-branch dtype (x64 mode promotes sums
+            # of i32 to i64, and the inactive skip branch emits i32).
+            "scheduled": jnp.sum(bound_mask.astype(jnp.int32)).astype(jnp.int32),
+            "unschedulable": jnp.sum(fail_mask.astype(jnp.int32)).astype(jnp.int32),
             # Zero when the pass never ran (no valid nodes: the per-pass
             # path returns before even building the queue) — this is what
             # the featurize-schedule validation and slot advancing key on.
             "eligible": jnp.where(
                 any_valid, jnp.sum(elig.astype(jnp.int32)), 0
-            ),
+            ).astype(jnp.int32),
             "pass_count": pc,
             "pending_after": jnp.sum(
                 (s["alive"] & (s["bound"] < 0)).astype(jnp.int32)
-            ),
+            ).astype(jnp.int32),
         }
+        if st.preempt:
+            out["nom"] = pod_outs["nom"]
+            out["vic"] = pod_outs["vic"]
+            out["overflow"] = jnp.any(pod_outs["over"])
+        if st.record == "full":
+            out["bits"] = pod_outs["bits"]
+            out["raw"] = pod_outs["raw"]
+            out["final"] = pod_outs["final"]
         return s, out
 
     final_state, outs = jax.lax.scan(step, dict(state0), ev)
@@ -427,6 +817,22 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
 
 
 @dataclass
+class AttemptOutcome:
+    """One scheduling attempt within a device step, in commit order —
+    everything the reconcile needs to mirror the per-pass path's store
+    writes for that pod: the bind (or nomination), the preemption
+    victims to evict right after the pod's own write, and the fully
+    rendered record="full" result annotations."""
+
+    namespace: str
+    name: str
+    node: str | None  # bound node (None = unschedulable this pass)
+    nominated: str | None  # newly nominated node (preemption)
+    victims: list[tuple[str, str]]  # (namespace, name) in reprieve order
+    anno: dict | None  # record="full" annotations (None in selection)
+
+
+@dataclass
 class StepOutcome:
     """One device-computed scheduling pass, ready for store reconcile."""
 
@@ -436,6 +842,9 @@ class StepOutcome:
     eligible: int  # queue size before the cap (0 = the pass never featurized)
     # (namespace, name, node_name) in queue (commit) order.
     binds: list[tuple[str, str, str]] = field(default_factory=list)
+    # Per-attempt detail (preemption / full-record segments); None means
+    # the binds list is the whole story (pure selection mode).
+    attempts: "list[AttemptOutcome] | None" = None
 
 
 @dataclass
@@ -495,6 +904,11 @@ class ReplayDriver:
         self._requeue = requeue_on_node_delete
         self._featurizer = None  # persistent device-side featurizer
         self._sched_name: str | None = None
+        self._record_mode = "selection"  # set by service_supported
+        self._preempt_active = False  # set by service_supported
+        # record="full" segments run at a shorter fixed K (their stacked
+        # result tensors multiply device memory by K).
+        self._full_k = max(1, min(self.k, FULL_SEGMENT_STEPS))
         # Evidence counters (the bench rung reports them).
         self.device_steps = 0
         self.fallback_steps = 0
@@ -508,11 +922,8 @@ class ReplayDriver:
 
     def service_supported(self) -> bool:
         svc = self.service
-        if svc._record != "selection":
+        if svc._record not in ("selection", "full"):
             self._reject("record_mode")
-            return False
-        if svc._preemption:
-            self._reject("preemption")
             return False
         if getattr(svc, "_extenders", None):
             self._reject("extenders")
@@ -530,6 +941,7 @@ class ReplayDriver:
         if len(names) != 1:
             self._reject("multi_profile")
             return False
+        prof = None
         if svc._plugins_factory is None:
             prof = svc._profiles.get(names[0])
             if prof is None:
@@ -542,17 +954,27 @@ class ReplayDriver:
             self._reject("permit_waiters")
             return False
         self._sched_name = names[0]
+        self._record_mode = svc._record
+        # Preemption lowers into the segment scan unless the profile
+        # disabled DefaultPreemption (then PostFilter is inert for the
+        # modeled vocabulary — custom post_filter hooks reject below).
+        preempt = bool(svc._preemption)
+        if preempt and prof is not None and "DefaultPreemption" in prof.postfilter_disabled:
+            preempt = False
+        self._preempt_active = preempt
         return True
 
     _OP_KINDS = frozenset({"pods", "nodes"})
 
-    def ops_supported(self, batches: Sequence[Sequence[Any]]) -> bool:
-        """Cheap op-vocabulary screen (no store access)."""
-        for batch in batches:
-            for op in batch:
-                if op.kind not in self._OP_KINDS or op.op not in ("create", "delete"):
+    def _batch_ops_ok(self, batch: Sequence[Any], record: bool) -> bool:
+        """Cheap op-vocabulary screen for ONE step's batch (no store
+        access).  ``record`` counts the reject reason — only the batch
+        that actually forces a fallback (the segment head) should."""
+        for op in batch:
+            if op.kind not in self._OP_KINDS or op.op not in ("create", "delete"):
+                if record:
                     self._reject(f"op:{op.op}/{op.kind}")
-                    return False
+                return False
         return True
 
     @staticmethod
@@ -570,8 +992,6 @@ class ReplayDriver:
             return "foreign_scheduler"
         if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
             return "terminal_phase"
-        if pod.get("status", {}).get("nominatedNodeName"):
-            return "nominated_node"
         if _host_ports(pod):
             return "host_ports"
         if _pod_has_volumes(pod):
@@ -581,12 +1001,24 @@ class ReplayDriver:
     # -- lowering ------------------------------------------------------------
 
     def try_segment(self, batches: list[list[Any]]):
-        """Lower + run K steps; returns SegmentOutcome or None (fallback).
+        """Lower + run up to len(batches) steps; returns SegmentOutcome
+        (whose ``steps`` may be SHORTER than the window: the supported
+        prefix, tail-padded on-device to the compiled K) or None (the
+        FIRST step is unsupported — the caller falls back for it).
         Must be called BEFORE the steps' ops touch the store."""
-        if not self.ops_supported(batches) or not self.service_supported():
+        if not self.service_supported():
             return None
+        m = 0
+        for batch in batches:
+            if not self._batch_ops_ok(batch, record=(m == 0)):
+                break
+            m += 1
+        if m == 0:
+            return None
+        if self._record_mode == "full":
+            m = min(m, self._full_k)
         try:
-            plan = self._lower(batches)
+            plan = self._lower(list(batches[:m]))
         except _Unsupported as e:
             self._reject(str(e))
             return None
@@ -709,6 +1141,20 @@ class ReplayDriver:
                 )
             )
 
+        # Tail padding: segments shorter than the compiled K (the stream
+        # tail, a mid-window vocabulary miss, or full-record's shorter
+        # K) extend with inactive no-op steps so they reuse the existing
+        # compile instead of falling back (ROADMAP open item).
+        m_steps = len(batches)
+        k_pad = self._full_k if self._record_mode == "full" else self.k
+        step_active = [True] * m_steps + [False] * (k_pad - m_steps)
+        for _ in range(k_pad - m_steps):
+            step_pod_creates.append([])
+            step_pod_deletes.append([])
+            step_node_creates.append([])
+            step_node_deletes.append([])
+            step_flush.append(False)
+
         for n in list(cur_nodes) + created_nodes:
             if n.get("status", {}).get("images"):
                 raise _Unsupported("node_images")
@@ -772,11 +1218,31 @@ class ReplayDriver:
             ):
                 if hasattr(sp.plugin, attr):
                     raise _Unsupported(f"host_hook:{attr}")
-        prog = _Program(plugins, "selection")
+        prog = _Program(plugins, self._record_mode)
+
+        if self._preempt_active:
+            from ksim_tpu.scheduler.preemption import (
+                ORACLE_FIT_FILTER_NAMES,
+                VOLUME_FIT_FILTER_NAMES,
+            )
+
+            # The device victim search re-checks fits through the
+            # PROFILE's filter kernels, but the host oracle's fit chain
+            # is FIXED — exactness requires the profile's filter set to
+            # match it (volume filters optional: trivially passing for
+            # this vocabulary, which has no volume objects or pod
+            # volumes).
+            fnames = {sp.plugin.name for sp in plugins if sp.filter_enabled}
+            if not (
+                ORACLE_FIT_FILTER_NAMES
+                <= fnames
+                <= (ORACLE_FIT_FILTER_NAMES | VOLUME_FIT_FILTER_NAMES)
+            ):
+                raise _Unsupported("preemption_filter_set")
 
         N = feats.nodes.padded
         P = feats.pods.requests.shape[0]
-        K = len(batches)
+        K = k_pad
         ipa = feats.aux["interpod"]
         spread = feats.aux["spread"]
 
@@ -860,6 +1326,18 @@ class ReplayDriver:
         sim = _SlotSim(sim_feat._slots.slot_of, sim_feat._slots._names)
         live = set(node_names)
         ranks = np.full((K, N), _I32_MAX, np.int32)
+        # Per-step live-node views: name-order ranks + upstream's
+        # candidate count for the preemption search; the live slot/name
+        # lists (store list order = name order) for full-record decode.
+        name_ranks = np.full((K, N), _I32_MAX, np.int32)
+        want = np.zeros(K, np.int32)
+        step_live_slots: list[np.ndarray] = []
+        step_live_names: list[list[str]] = []
+        step_node_event = [
+            bool(step_node_creates[k] or step_node_deletes[k]) for k in range(K)
+        ]
+        from ksim_tpu.scheduler.preemption import candidate_count
+
         for k in range(K):
             live -= set(step_node_deletes[k])
             live |= set(step_node_creates[k])
@@ -867,6 +1345,18 @@ class ReplayDriver:
                 sim.sync(sorted(live))
             for nm, slot in sim.slot_of.items():
                 ranks[k, slot_of[nm]] = slot
+            if self._preempt_active or self._record_mode == "full":
+                live_sorted = sorted(live)
+                want[k] = candidate_count(len(live_sorted))
+                for r, nm in enumerate(live_sorted):
+                    name_ranks[k, slot_of[nm]] = r
+                if self._record_mode == "full":
+                    # Only the full-record decode consumes the slot/name
+                    # views — don't build them on the selection hot path.
+                    step_live_slots.append(
+                        np.asarray([slot_of[nm] for nm in live_sorted], np.int64)
+                    )
+                    step_live_names.append(live_sorted)
 
         # Queue width: pending(now) + creates + requeue-able is an exact
         # upper bound on the pending population at any step, so eligible
@@ -883,7 +1373,15 @@ class ReplayDriver:
         q = bucket_size(max(min(cap, hard_bound), 1))
 
         statics = _SegmentStatics(
-            k=K, q=q, cap=cap, n_tk=ipa.node_dom.shape[1], n_dom=n_dom_pad
+            k=K,
+            q=q,
+            cap=cap,
+            n_tk=ipa.node_dom.shape[1],
+            n_dom=n_dom_pad,
+            record=self._record_mode,
+            preempt=self._preempt_active,
+            c_max=PREEMPT_CANDIDATES,
+            v_max=PREEMPT_VICTIMS,
         )
         const = {
             "node": dict(
@@ -902,11 +1400,87 @@ class ReplayDriver:
         ev = {
             "rank": ranks,
             "flush": np.asarray(step_flush, bool),
+            "active": np.asarray(step_active, bool),
             "pod_create": pod_create,
             "pod_delete": pod_delete,
             "node_create": node_create,
             "node_delete": node_delete,
         }
+        U = len(universe_pods)
+        nominated0 = np.zeros(P, bool)
+        for p in cur_pods:
+            if p.get("status", {}).get("nominatedNodeName"):
+                nominated0[row_of[_pod_key(p)]] = True
+        if self._record_mode == "full":
+            # Stacked result tensors multiply one pass's [Q, F|S, N]
+            # footprint by K on-device — bound it before dispatch.
+            bits_dt, final_dt = prog._result_dtypes()
+            n_f = sum(1 for sp in plugins if sp.filter_enabled)
+            n_s = sum(1 for sp in plugins if sp.score_enabled)
+            per_cell = (
+                n_f * np.dtype(bits_dt).itemsize
+                + n_s * 4
+                + n_s * np.dtype(final_dt).itemsize
+            )
+            if K * q * N * per_cell > FULL_RECORD_BYTES:
+                raise _Unsupported("full_record_bytes")
+        if self._preempt_active:
+            from ksim_tpu.scheduler.preemption import (
+                more_important_key,
+                pod_eligible_to_preempt,
+                start_time,
+            )
+
+            priority = np.zeros(P, np.int32)
+            imp_rank = np.full(P, _I32_MAX, np.int32)
+            start_rank = np.zeros(P, np.int32)
+            preempt_ok = np.zeros(P, bool)
+            prios = [priority_of(p) for p in universe_pods]
+            priority[:U] = prios
+            for r, j in enumerate(
+                sorted(
+                    range(U),
+                    key=lambda j: more_important_key(universe_pods[j], priority_of),
+                )
+            ):
+                imp_rank[j] = r
+            starts = sorted({start_time(p) for p in universe_pods} | {""})
+            srank = {sv: i for i, sv in enumerate(starts)}
+            for j, p in enumerate(universe_pods):
+                start_rank[j] = srank[start_time(p)]
+                preempt_ok[j] = pod_eligible_to_preempt(p)
+            const["pods"].update(
+                priority=priority,
+                imp_rank=imp_rank,
+                start_rank=start_rank,
+                preempt_ok=preempt_ok,
+            )
+            const["empty_start_rank"] = np.asarray(srank[""], np.int32)
+            ev["name_rank"] = name_ranks
+            ev["want"] = want
+            if self._record_mode == "full":
+                # Per-plugin reason-bit -> "resolvable by preemption"
+                # tables (the traceable form of service._resolvable_mask:
+                # a missing failure_unresolvable rule is conservatively
+                # unresolvable, exactly like the host path).
+                tables = []
+                for sp in plugins:
+                    if not sp.filter_enabled:
+                        continue
+                    w = int(getattr(sp.plugin, "reason_bit_width", 31))
+                    if w > 10:
+                        raise _Unsupported("preemption_bits_width")
+                    rule = getattr(sp.plugin, "failure_unresolvable", None)
+                    t = np.zeros(1 << w, bool)
+                    if rule is not None:
+                        for b in range(1, 1 << w):
+                            t[b] = not rule(b)
+                    tables.append(t)
+                tw = max((len(t) for t in tables), default=1)
+                resolv = np.zeros((max(len(tables), 1), tw), bool)
+                for fi, t in enumerate(tables):
+                    resolv[fi, : len(t)] = t
+                const["resolv"] = resolv
         state0 = {
             "valid": valid0,
             "requested": feats.nodes.requested,
@@ -916,6 +1490,7 @@ class ReplayDriver:
             "bound": bound0,
             "attempts": attempts0,
             "retry_at": retry0,
+            "nominated": nominated0,
             "spread": spread.init_counts,
             "ip_cnt": ip_cnt0,
             "ip_eat": ip_eat0,
@@ -932,9 +1507,12 @@ class ReplayDriver:
             universe_keys=universe_keys,
             universe_row_of=row_of,
             node_names=list(feats.nodes.names),
-            n_steps=K,
+            n_steps=m_steps,
             pred_featurizes=pred_featurizes,
             initial_pass_count=int(svc._pass_count),
+            step_live_slots=step_live_slots,
+            step_live_names=step_live_names,
+            step_node_event=step_node_event,
         )
 
     @staticmethod
@@ -970,6 +1548,70 @@ class ReplayDriver:
 
     # -- dispatch + decode ---------------------------------------------------
 
+    def _step_render_ctx(self, plan: "_SegmentPlan", k: int):
+        """RenderCtx over step k's live node set (rebuilt only when a
+        node event changed the set — the common segment reuses one)."""
+        from ksim_tpu.engine.annotations import RenderCtx
+
+        return RenderCtx(plan.step_live_names[k], plan.prog.plugins)
+
+    def _render_step_annotations(
+        self, plan: "_SegmentPlan", k: int, att, pulled, noms, ctx
+    ) -> list[dict]:
+        """record="full": the 13 result annotations for every attempt of
+        step k, decoded from the streamed result tensors exactly as the
+        per-pass path renders them — same renderer, node axis restricted
+        to the step's live set (dead universe slots never existed for
+        that pass), postfilter map from the on-device preemption
+        outcome."""
+        from ksim_tpu.engine.annotations import render_pod_results
+        from ksim_tpu.engine.core import EngineResult
+        from ksim_tpu.scheduler.preemption import DEFAULT_PREEMPTION, NOMINATED_MESSAGE
+
+        slots = plan.step_live_slots[k]
+        names = plan.step_live_names[k]
+        pos_of = {int(s): i for i, s in enumerate(slots)}
+        sel_k = np.asarray(pulled["sel"][k])[att]
+        bits = np.asarray(pulled["bits"][k])[att][:, :, slots]
+        raw = np.asarray(pulled["raw"][k])[att][:, :, slots]
+        fin = np.asarray(pulled["final"][k])[att][:, :, slots]
+        sel_sub = np.asarray(
+            [pos_of.get(int(s), -1) if s >= 0 else -1 for s in sel_k], np.int64
+        )
+        plugins = plan.prog.plugins
+        res = EngineResult(
+            plugin_names=[sp.plugin.name for sp in plugins if sp.score_enabled],
+            filter_plugin_names=[
+                sp.plugin.name for sp in plugins if sp.filter_enabled
+            ],
+            reason_bits=bits,
+            scores=raw,
+            final_scores=fin,
+            total=None,
+            feasible=sel_sub >= 0,
+            selected=sel_sub,
+        )
+        preempt = plan.statics.preempt
+        out = []
+        for i, qq in enumerate(att):
+            postfilter = None
+            if preempt and sel_sub[i] < 0:
+                # _attempt_preemption's render_postfilter_result: every
+                # live node gets an entry; the nominated one (if any)
+                # names the plugin.
+                postfilter = {nm: {} for nm in names}
+                nsl = int(noms[k, qq])
+                if nsl >= 0:
+                    postfilter[plan.node_names[nsl]] = {
+                        DEFAULT_PREEMPTION: NOMINATED_MESSAGE
+                    }
+            out.append(
+                render_pod_results(
+                    None, plugins, res, i, postfilter=postfilter, ctx=ctx
+                )
+            )
+        return out
+
     def _run(self, plan: "_SegmentPlan") -> SegmentOutcome:
         from ksim_tpu.engine.core import (
             _aux_host,
@@ -979,9 +1621,14 @@ class ReplayDriver:
 
         aux_host, _axes = _aux_host(plan.aux)
         const = dict(plan.const)
-        tree = (const["node"], const["pods"], aux_host, plan.ev, plan.state0)
-        node_dev, pods_dev, aux_dev, ev_dev, state_dev = _pack_tree_to_device(tree)
-        const_dev = {"node": node_dev, "pods": pods_dev, "aux": aux_dev}
+        extra = {
+            k: const[k] for k in ("resolv", "empty_start_rank") if k in const
+        }
+        tree = (const["node"], const["pods"], extra, aux_host, plan.ev, plan.state0)
+        node_dev, pods_dev, extra_dev, aux_dev, ev_dev, state_dev = (
+            _pack_tree_to_device(tree)
+        )
+        const_dev = {"node": node_dev, "pods": pods_dev, "aux": aux_dev, **extra_dev}
         final_state, outs = _segment_fn(
             plan.statics, plan.prog, const_dev, ev_dev, state_dev
         )
@@ -996,6 +1643,7 @@ class ReplayDriver:
         )
         self.device_round_trips += 1
 
+        st = plan.statics
         eligible = np.asarray(pulled["eligible"])
         for k in range(plan.n_steps):
             if bool(eligible[k] > 0) != plan.pred_featurizes[k]:
@@ -1005,18 +1653,69 @@ class ReplayDriver:
                 # history.  The store is untouched: discard and fall back.
                 self._reject("featurize_prediction")
                 return None
+        if st.preempt and bool(
+            np.any(np.asarray(pulled["overflow"])[: plan.n_steps])
+        ):
+            # A victim search exceeded the static candidate/victim
+            # bounds: the computed outcomes past that point assumed a
+            # truncated search.  Store untouched — discard, fall back.
+            self._reject("preemption_overflow")
+            return None
         self.device_steps += plan.n_steps
 
         sel = np.asarray(pulled["sel"])  # [K, Q]
         idx = np.asarray(pulled["idx"])  # [K, Q]
         P = len(plan.universe_keys)
+        detailed = st.preempt or st.record == "full"
+        noms = np.asarray(pulled["nom"]) if st.preempt else None
+        vics = np.asarray(pulled["vic"]) if st.preempt else None
         steps: list[StepOutcome] = []
+        render_ctx = None
         for k in range(plan.n_steps):
+            att = np.nonzero(idx[k] < P)[0]
             binds = []
-            for qq in np.nonzero((idx[k] < P) & (sel[k] >= 0))[0]:
-                key = plan.universe_keys[int(idx[k, qq])]
-                ns, _, nm = key.partition("/")
-                binds.append((ns, nm, plan.node_names[int(sel[k, qq])]))
+            attempts = None
+            if detailed:
+                annos = [None] * len(att)
+                if st.record == "full":
+                    if render_ctx is None or plan.step_node_event[k]:
+                        render_ctx = self._step_render_ctx(plan, k)
+                    annos = self._render_step_annotations(
+                        plan, k, att, pulled, noms, render_ctx
+                    )
+                attempts = []
+                for i, qq in enumerate(att):
+                    key = plan.universe_keys[int(idx[k, qq])]
+                    ns, _, nm = key.partition("/")
+                    sl = int(sel[k, qq])
+                    node = plan.node_names[sl] if sl >= 0 else None
+                    nominated = None
+                    victims: list[tuple[str, str]] = []
+                    if st.preempt:
+                        nsl = int(noms[k, qq])
+                        nominated = plan.node_names[nsl] if nsl >= 0 else None
+                        for vr in vics[k, qq]:
+                            if vr >= 0:
+                                vkey = plan.universe_keys[int(vr)]
+                                vns, _, vnm = vkey.partition("/")
+                                victims.append((vns, vnm))
+                    attempts.append(
+                        AttemptOutcome(
+                            namespace=ns,
+                            name=nm,
+                            node=node,
+                            nominated=nominated,
+                            victims=victims,
+                            anno=annos[i],
+                        )
+                    )
+                    if node is not None:
+                        binds.append((ns, nm, node))
+            else:
+                for qq in np.nonzero((idx[k] < P) & (sel[k] >= 0))[0]:
+                    key = plan.universe_keys[int(idx[k, qq])]
+                    ns, _, nm = key.partition("/")
+                    binds.append((ns, nm, plan.node_names[int(sel[k, qq])]))
             steps.append(
                 StepOutcome(
                     scheduled=int(pulled["scheduled"][k]),
@@ -1024,6 +1723,7 @@ class ReplayDriver:
                     pending_after=int(pulled["pending_after"][k]),
                     eligible=int(eligible[k]),
                     binds=binds,
+                    attempts=attempts,
                 )
             )
         alive = np.asarray(pulled_state["alive"])[:P]
@@ -1041,7 +1741,7 @@ class ReplayDriver:
             plan.universe_keys[j]: (int(attempts[j]), int(retry[j]))
             for j in np.nonzero(attempts > 0)[0]
         }
-        pcs = np.asarray(pulled["pass_count"])
+        pcs = np.asarray(pulled["pass_count"]).reshape(-1)
         _max_backoff, flush_cap = _backoff_constants()
         flush = np.asarray(plan.ev["flush"])
         first_flush_pc = None
@@ -1119,9 +1819,13 @@ class _SegmentPlan:
     universe_keys: list[str]
     universe_row_of: dict[str, int]
     node_names: list[str]
-    n_steps: int
+    n_steps: int  # REAL steps (the compiled K may be tail-padded longer)
     pred_featurizes: list[bool]
     initial_pass_count: int
+    # Per-step live-node decode views (preemption / full-record only).
+    step_live_slots: list = field(default_factory=list)
+    step_live_names: list = field(default_factory=list)
+    step_node_event: list = field(default_factory=list)
 
 
 class _Unsupported(Exception):

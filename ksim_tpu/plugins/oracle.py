@@ -8,6 +8,16 @@ golden-style against these (SURVEY.md section 4: "golden-file parity tests
 
 The oracle operates on NodeInfo dicts built by ``build_node_infos`` —
 the analogue of the upstream scheduler cache NodeInfo.
+
+The oracle is also the parity source of truth for PREEMPTION's fit
+re-checks: scheduler/preemption.py's host victim search runs this
+module's filters directly (``_FitState.fits``), and the device-resident
+victim search (engine/replay.py) re-checks fits through the compiled
+kernels — exactness there rests on the kernel<->oracle parity tests
+plus a lowering gate that the profile's filter set matches the fit
+chain (preemption.ORACLE_FIT_FILTER_NAMES).  Changing any filter's
+semantics here must change the kernel AND the hand-derived fixtures
+under tests/fixtures/ together.
 """
 
 from __future__ import annotations

@@ -76,6 +76,7 @@ class ScenarioRunner:
         service: SchedulerService | None = None,
         *,
         record: str = "selection",
+        preemption: bool = False,
         requeue_on_node_delete: bool = True,
         max_pods_per_pass: int | None = None,
         pod_bucket_min: int | None = None,
@@ -88,7 +89,10 @@ class ScenarioRunner:
         segment boundaries, byte-identical scheduling counts.  Steps
         containing ops outside the tensor vocabulary (patch/update/done,
         non-pod/node kinds, pods with host ports or volumes, ...) fall
-        back to this per-pass path automatically."""
+        back to this per-pass path automatically; DefaultPreemption
+        (``preemption=True``) and ``record="full"`` segments stay
+        on-device since round 7 (on-device victim search + streamed
+        result tensors)."""
         self.store = store if store is not None else ClusterStore()
         self.service = (
             service
@@ -96,7 +100,7 @@ class ScenarioRunner:
             else SchedulerService(
                 self.store,
                 record=record,
-                preemption=False,
+                preemption=preemption,
                 max_pods_per_pass=max_pods_per_pass,
                 pod_bucket_min=pod_bucket_min,
             )
@@ -237,17 +241,52 @@ class ScenarioRunner:
         self, step: int, batch: list[Operation], outcome, result: ScenarioResult
     ) -> None:
         """Replay one device-computed step into the store: the step's ops
-        (+ requeue), then the pass's placements in commit order."""
+        (+ requeue), then the pass's placements in commit order.  With
+        per-attempt detail (preemption / record="full" segments) each
+        attempt's write mirrors the per-pass rebuild — result
+        annotations, bind or nomination — followed by its preemption
+        victims' evictions, in the exact per-pass order."""
         self._apply_batch(batch)
         result.events_applied += len(batch)
-        for ns, name, node in outcome.binds:
+        if outcome.attempts is not None:
+            from ksim_tpu.engine.annotations import apply_results_to_pod
 
-            def bind(obj: JSON) -> None:
-                obj.setdefault("spec", {})["nodeName"] = node
-                obj.setdefault("status", {})["phase"] = "Running"
-                obj.get("status", {}).pop("nominatedNodeName", None)
+            for att in outcome.attempts:
+                if att.anno or att.node or att.nominated:
 
-            self.store.patch("pods", name, ns, bind, copy_ret=False)
+                    def mutate(obj: JSON, att=att) -> None:
+                        if att.anno:
+                            annos = obj.setdefault("metadata", {}).setdefault(
+                                "annotations", {}
+                            )
+                            apply_results_to_pod(annos, att.anno)
+                        if att.node:
+                            obj.setdefault("spec", {})["nodeName"] = att.node
+                            obj.setdefault("status", {})["phase"] = "Running"
+                            obj.get("status", {}).pop("nominatedNodeName", None)
+                        elif att.nominated:
+                            obj.setdefault("status", {})[
+                                "nominatedNodeName"
+                            ] = att.nominated
+
+                    self.store.patch(
+                        "pods", att.name, att.namespace, mutate, copy_ret=False
+                    )
+                # Victim evictions go through the service so eviction
+                # listeners fire exactly as on the per-pass path.
+                for vns, vname in att.victims:
+                    self.service._evict_victim(
+                        {"metadata": {"name": vname, "namespace": vns}}
+                    )
+        else:
+            for ns, name, node in outcome.binds:
+
+                def bind(obj: JSON) -> None:
+                    obj.setdefault("spec", {})["nodeName"] = node
+                    obj.setdefault("status", {})["phase"] = "Running"
+                    obj.get("status", {}).pop("nominatedNodeName", None)
+
+                self.store.patch("pods", name, ns, bind, copy_ret=False)
         result.pods_scheduled += outcome.scheduled
         result.unschedulable_attempts += outcome.unschedulable
         result.steps.append(
@@ -285,7 +324,12 @@ class ScenarioRunner:
             self.replay_driver = driver
         i = 0
         while i < len(keys):
-            if driver is not None and i + driver.k <= len(keys):
+            if driver is not None:
+                # Tails shorter than K no longer fall back: the driver
+                # consumes the supported PREFIX of the window (possibly
+                # shorter than K for full-record segments or mid-window
+                # vocabulary misses) and pads on-device to the compiled
+                # shape.
                 seg_keys = keys[i : i + driver.k]
                 batches = [by_step[s] for s in seg_keys]
                 seg = driver.try_segment(batches)
@@ -294,7 +338,7 @@ class ScenarioRunner:
                         self._reconcile_device_step(step, batch, outcome, result)
                         driver.advance_service_step(outcome)
                     driver.finalize_segment(seg)
-                    i += driver.k
+                    i += len(seg.steps)
                     continue
             step = keys[i]
             if driver is not None:

@@ -1,4 +1,4 @@
-"""DefaultPreemption (PostFilter) — host-side victim search.
+"""DefaultPreemption (PostFilter) — the victim-search semantics.
 
 Upstream kube-scheduler v1.30 ``plugins/defaultpreemption/default_preemption.go``
 and ``framework/preemption/preemption.go``; the reference wraps PostFilter
@@ -7,11 +7,16 @@ node, ``{}`` for every other filtered node (reference
 simulator/scheduler/plugin/wrappedplugin.go:550-577,
 simulator/scheduler/plugin/resultstore/store.go:439-456).
 
-Preemption is control-flow heavy (per-candidate victim search with a
-reprieve loop) and runs only for pods that failed filtering on every
-node, so it stays on the host and uses the exact-parity oracle for fit
-checks (plugins/oracle.py); the batched TPU engine keeps the bulk
-filter/score path.  Simplifications vs upstream, documented: no
+This module is the HOST implementation and the parity source of truth:
+the per-pass scheduling path runs it directly, with the exact-parity
+oracle for fit checks (plugins/oracle.py).  Since round 7 the
+device-resident replay (engine/replay.py) lowers the same search into
+the segment scan — bounded candidate/reprieve loops through the
+compiled filter kernels — gated on the profile's filter set matching
+``ORACLE_FIT_FILTER_NAMES`` below, and verified against this module on
+the hand-derived fixtures (tests/fixtures/preemption_victims.py).
+Changing any semantics here must change the device lowering and the
+fixtures together.  Simplifications vs upstream, documented: no
 PodDisruptionBudgets in the snapshot model (the reference's 7-kind
 snapshot has none either, snapshot/snapshot.go:33-42), so the
 PDB-violation criteria are trivially zero; victim start times fall back
@@ -33,6 +38,39 @@ NOMINATED_MESSAGE = "preemption victim"
 MIN_CANDIDATE_NODES_PERCENTAGE = 10
 MIN_CANDIDATE_NODES_ABSOLUTE = 100
 
+# The filter chain _FitState.fits runs, BY KERNEL NAME.  The device
+# replay's on-device victim search (engine/replay.py) re-checks fits
+# through the profile's compiled filter kernels, which is only exact
+# when the profile's filter set matches this chain — the lowering gates
+# on it.  The volume filters are in fits() too but pass trivially for
+# the device vocabulary (no volume objects / no pod volumes), so their
+# presence in a profile is allowed but not required.
+ORACLE_FIT_FILTER_NAMES = frozenset(
+    {
+        "NodeUnschedulable",
+        "NodeName",
+        "TaintToleration",
+        "NodeAffinity",
+        "NodePorts",
+        "NodeResourcesFit",
+        "PodTopologySpread",
+        "InterPodAffinity",
+    }
+)
+VOLUME_FIT_FILTER_NAMES = frozenset(
+    {"VolumeRestrictions", "NodeVolumeLimits", "VolumeBinding", "VolumeZone"}
+)
+
+
+def candidate_count(n_nodes: int) -> int:
+    """Upstream GetOffsetAndNumCandidates: how many candidate nodes the
+    dry-run collects before stopping (10% of nodes, at least 100,
+    capped at the node count)."""
+    return min(
+        max(n_nodes * MIN_CANDIDATE_NODES_PERCENTAGE // 100, MIN_CANDIDATE_NODES_ABSOLUTE),
+        n_nodes,
+    )
+
 
 def pod_priority(pod: JSON) -> int:
     """Bare spec.priority (callers wanting PriorityClass resolution pass
@@ -46,7 +84,10 @@ def pod_eligible_to_preempt(pod: JSON) -> bool:
     return policy != "Never"
 
 
-def _start_time(pod: JSON) -> str:
+def start_time(pod: JSON) -> str:
+    """Victim start time: status.startTime, falling back to
+    creationTimestamp (module docstring).  Public: the device lowering
+    ranks start strings with this exact function."""
     return (
         pod.get("status", {}).get("startTime")
         or pod.get("metadata", {}).get("creationTimestamp")
@@ -54,10 +95,18 @@ def _start_time(pod: JSON) -> str:
     )
 
 
-def _more_important(p: JSON, priority_of=pod_priority) -> tuple:
+_start_time = start_time  # internal alias (historic name)
+
+
+def more_important_key(p: JSON, priority_of=pod_priority) -> tuple:
     """Sort key for util.MoreImportantPod order: higher priority first,
-    then earlier start time."""
+    then earlier start time (namespace/name breaks exact ties
+    deterministically).  Public: the device lowering pre-ranks the pod
+    universe with this exact key."""
     return (-priority_of(p), _start_time(p), namespace_of(p), name_of(p))
+
+
+_more_important = more_important_key  # internal alias (historic name)
 
 
 def _pods_by_node(pods: Sequence[JSON]) -> dict[str, list[JSON]]:
@@ -273,7 +322,7 @@ def find_preemption(
     if not pod_eligible_to_preempt(pod):
         return PreemptionDecision(nominated_node=None, victims=[])
     n = len(nodes)
-    want = min(max(n * MIN_CANDIDATE_NODES_PERCENTAGE // 100, MIN_CANDIDATE_NODES_ABSOLUTE), n)
+    want = candidate_count(n)
     candidates: list[Candidate] = []
     pods_list = list(cluster_pods)
     for ni in range(n):

@@ -55,3 +55,52 @@ def test_churn_lock_6k_seed0(x64):
         jax.config.update("jax_enable_x64", prev)
     assert events == LOCK_EVENTS
     assert (scheduled, unschedulable) == (LOCK_SCHEDULED, LOCK_UNSCHEDULABLE)
+
+
+# The full 50k flagship locks (repo CLAUDE.md).
+LOCK_50K_SCHEDULED = 52_781
+LOCK_50K_UNSCHEDULABLE = 42_829
+
+
+@pytest.mark.slow
+def test_churn_lock_50k_stepwise_device_vs_per_pass():
+    """The one-command behavior-lock verification (`make lock-check`):
+    replay the FULL 50k stream through the per-pass path AND the
+    device-resident path (preemption enabled — a no-op on this stream,
+    which is exactly what the lock asserts) and require the 52781/42829
+    totals plus stepwise-identical (scheduled, unschedulable, pending)
+    triples between the two paths.  ~10 min CPU; bench-tier before this
+    test existed."""
+    jax.config.update("jax_enable_x64", False)
+
+    def run(device: bool, preemption: bool):
+        runner = ScenarioRunner(
+            max_pods_per_pass=1024,
+            pod_bucket_min=128,
+            preemption=preemption,
+            device_replay=device,
+        )
+        res = runner.run(
+            churn_scenario(0, n_nodes=2000, n_events=50_000, ops_per_step=100)
+        )
+        return runner, res
+
+    _base_r, base = run(device=False, preemption=False)
+    assert (base.pods_scheduled, base.unschedulable_attempts) == (
+        LOCK_50K_SCHEDULED,
+        LOCK_50K_UNSCHEDULABLE,
+    )
+    dev_r, dev = run(device=True, preemption=True)
+    assert (dev.pods_scheduled, dev.unschedulable_attempts) == (
+        LOCK_50K_SCHEDULED,
+        LOCK_50K_UNSCHEDULABLE,
+    )
+    base_sig = [(s.step, s.scheduled, s.unschedulable, s.pending_after) for s in base.steps]
+    dev_sig = [(s.step, s.scheduled, s.unschedulable, s.pending_after) for s in dev.steps]
+    assert dev_sig == base_sig
+    driver = dev_r.replay_driver
+    # Preemption/tail support must keep the stream on-device: PR 1's
+    # baseline with preemption enabled was 0 device steps (the whole
+    # stream rejected), and even without it the tail step fell back.
+    assert driver.fallback_steps == 0, driver.unsupported
+    assert driver.device_steps == len(dev.steps)

@@ -11,7 +11,10 @@ upstream algorithm definition, not from this repo's implementation.
 
 from __future__ import annotations
 
+import pytest
+
 from ksim_tpu.scheduler.preemption import find_preemption
+from tests.fixtures.preemption_victims import CASES
 from tests.helpers import make_node, make_pod
 
 
@@ -24,6 +27,38 @@ def _bound(name, node, cpu, prio, start=None):
 
 def _preemptor(cpu):
     return make_pod("preemptor", cpu=cpu, memory="64Mi", priority=100)
+
+
+def case_objects(case):
+    """Build (nodes, victim_pods, preemptor_pod) JSON for one fixture
+    case — shared with the device-path test (test_replay_device.py)."""
+    nodes = [make_node(nm, cpu=cpu, memory="8Gi") for nm, cpu in case["nodes"]]
+    victims = []
+    for spec in case["victims"]:
+        name, node, cpu, prio, start = spec[:5]
+        created = spec[5] if len(spec) > 5 else "2024-01-01T00:00:00Z"
+        p = make_pod(name, cpu=cpu, memory=None, node_name=node, priority=prio)
+        p["metadata"]["creationTimestamp"] = created
+        p.setdefault("status", {})["phase"] = "Running"
+        if start:
+            p["status"]["startTime"] = start
+        victims.append(p)
+    cpu, prio, policy = case["preemptor"]
+    pre = make_pod("preemptor", cpu=cpu, memory=None, priority=prio)
+    if policy:
+        pre["spec"]["preemptionPolicy"] = policy
+    return nodes, victims, pre
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c["name"] for c in CASES])
+def test_host_oracle_matches_hand_derived_fixture(case):
+    """The host victim search (oracle fit checks) lands on the
+    hand-derived nominated node and the same victims in reprieve
+    order."""
+    nodes, victims, pre = case_objects(case)
+    d = find_preemption(pre, nodes, victims)
+    assert d.nominated_node == case["expected_nominated"]
+    assert [v["metadata"]["name"] for v in d.victims] == case["expected_victims"]
 
 
 def test_lowest_highest_victim_priority_wins():

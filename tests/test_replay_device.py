@@ -21,6 +21,7 @@ import pytest
 
 from ksim_tpu.scenario import ScenarioRunner, churn_scenario
 from ksim_tpu.scenario.runner import Operation
+from tests.fixtures.preemption_victims import CASES as PREEMPTION_CASES
 from tests.helpers import make_node, make_pod
 
 
@@ -186,6 +187,191 @@ def test_device_replay_namespaceless_create_op():
     base, dev, driver = _run_pair(stream, x64=False, k=3)
     assert _steps_sig(dev) == _steps_sig(base)
     assert driver.device_steps == 3
+
+
+# ---------------------------------------------------------------------------
+# Round 7: on-device preemption victim search + record="full" streaming
+# ---------------------------------------------------------------------------
+
+
+def _collect_evictions(runner):
+    order = []
+    runner.service.add_eviction_listener(lambda ns, nm: order.append((ns, nm)))
+    return order
+
+
+@pytest.mark.parametrize(
+    "case", PREEMPTION_CASES, ids=[c["name"] for c in PREEMPTION_CASES]
+)
+def test_device_preemption_matches_fixtures(case):
+    """The ON-DEVICE victim search lands on the hand-derived nominated
+    node and evicts the same victims in the same (reprieve) order as the
+    host oracle — with proof the segment actually ran on-device."""
+    from tests.test_preemption_fixtures import case_objects
+
+    jax.config.update("jax_enable_x64", False)
+    nodes, victims, pre = case_objects(case)
+    from ksim_tpu.state.cluster import ClusterStore
+
+    store = ClusterStore()
+    for n in nodes:
+        store.create("nodes", n)
+    for v in victims:
+        store.create("pods", v)
+    runner = ScenarioRunner(
+        store=store, preemption=True, device_replay=True, device_segment_steps=4
+    )
+    evicted = _collect_evictions(runner)
+    runner.run(iter([Operation(step=1, op="create", kind="pods", obj=pre)]))
+    driver = runner.replay_driver
+    assert driver.device_steps >= 1, driver.unsupported
+    got = store.get("pods", "preemptor")
+    assert (
+        got.get("status", {}).get("nominatedNodeName")
+        == case["expected_nominated"]
+    )
+    assert [nm for _ns, nm in evicted] == case["expected_victims"]
+
+
+def test_device_preemption_churn_matches_per_pass():
+    """A churn stream with priority strata (so preemption really fires
+    mid-segment): per-step counts and the final store byte-identical
+    between the per-pass path and the device path with preemption ON."""
+
+    def stream():
+        # 3 nodes x 4 cpu saturate after 8 x 1.5cpu pods; later
+        # higher-priority arrivals must preempt the prio-0 stratum.
+        for i in range(3):
+            yield Operation(
+                step=0, op="create", kind="nodes",
+                obj=make_node(f"n-{i}", cpu="4", memory="16Gi"),
+            )
+        for step in range(1, 17):
+            prio = [0, 0, 5, 10][step % 4]
+            pod = make_pod(
+                f"p-{step}", cpu="1500m", memory="256Mi", priority=prio
+            )
+            pod["metadata"]["creationTimestamp"] = f"2026-01-{step:02d}T00:00:00Z"
+            yield Operation(step=step, op="create", kind="pods", obj=pod)
+
+    def run(device):
+        runner = ScenarioRunner(
+            preemption=True, device_replay=device, device_segment_steps=4
+        )
+        ev = _collect_evictions(runner)
+        res = runner.run(stream())
+        state = sorted(
+            (
+                p["metadata"]["name"],
+                p.get("spec", {}).get("nodeName"),
+                p.get("status", {}).get("nominatedNodeName"),
+            )
+            for p in runner.store.list("pods")
+        )
+        return runner, res, state, ev
+
+    jax.config.update("jax_enable_x64", False)
+    r_base, base, st_base, ev_base = run(False)
+    r_dev, dev, st_dev, ev_dev = run(True)
+    assert _steps_sig(dev) == _steps_sig(base)
+    assert st_dev == st_base
+    assert ev_dev == ev_base
+    assert ev_base, "stream never triggered preemption — fixture is vacuous"
+    assert r_dev.replay_driver.device_steps >= 8
+    assert "preemption" not in r_dev.replay_driver.unsupported
+
+
+def test_device_full_record_annotations_match():
+    """record="full" streams result tensors out of the segment scan; the
+    host decode must reproduce the per-pass annotations BYTE-identically
+    (filter/score/finalscore maps, history, selected-node)."""
+
+    def stream():
+        return churn_scenario(0, n_nodes=24, n_events=160, ops_per_step=16)
+
+    def annos(store):
+        return {
+            p["metadata"]["name"]: p["metadata"].get("annotations", {})
+            for p in store.list("pods")
+        }
+
+    jax.config.update("jax_enable_x64", False)
+    base_r = ScenarioRunner(record="full", max_pods_per_pass=64, pod_bucket_min=32)
+    base = base_r.run(stream())
+    dev_r = ScenarioRunner(
+        record="full", max_pods_per_pass=64, pod_bucket_min=32,
+        device_replay=True, device_segment_steps=8,
+    )
+    dev = dev_r.run(stream())
+    assert _steps_sig(dev) == _steps_sig(base)
+    assert dev_r.replay_driver.device_steps >= 4, dev_r.replay_driver.unsupported
+    a_base, a_dev = annos(base_r.store), annos(dev_r.store)
+    assert set(a_base) == set(a_dev)
+    for name in a_base:
+        assert a_base[name] == a_dev[name], f"annotations diverged for {name}"
+
+
+def test_device_preemption_with_full_record():
+    """Preemption + record="full" together: the resolvable-candidate
+    mask is derived from the streamed reason bits on-device, and the
+    postfilter-result annotation (every failed node, nominated entry)
+    matches the per-pass render."""
+    import json
+
+    from ksim_tpu.engine.annotations import POST_FILTER_RESULT_KEY
+    from ksim_tpu.state.cluster import ClusterStore
+
+    def build(device):
+        store = ClusterStore()
+        store.create("nodes", make_node("n0", cpu="2", memory="8Gi"))
+        low = make_pod("low0", cpu="1", memory=None, node_name="n0", priority=1)
+        low["metadata"]["creationTimestamp"] = "2024-01-01T00:00:00Z"
+        store.create("pods", low)
+        low2 = make_pod("low1", cpu="1", memory=None, node_name="n0", priority=1)
+        low2["metadata"]["creationTimestamp"] = "2024-01-01T00:00:01Z"
+        store.create("pods", low2)
+        runner = ScenarioRunner(
+            store=store, record="full", preemption=True,
+            device_replay=True if device else False, device_segment_steps=4,
+        )
+        crit = make_pod("crit", cpu="1", memory=None, priority=100)
+        runner.run(iter([Operation(step=1, op="create", kind="pods", obj=crit)]))
+        return runner
+
+    jax.config.update("jax_enable_x64", False)
+    base = build(False)
+    dev = build(True)
+    assert dev.replay_driver.device_steps >= 1, dev.replay_driver.unsupported
+    pb = base.store.get("pods", "crit")
+    pd = dev.store.get("pods", "crit")
+    assert pd["status"].get("nominatedNodeName") == "n0"
+    assert (
+        pb["metadata"]["annotations"] == pd["metadata"]["annotations"]
+    )
+    post = json.loads(pd["metadata"]["annotations"][POST_FILTER_RESULT_KEY])
+    assert post == {"n0": {"DefaultPreemption": "preemption victim"}}
+
+
+def test_tail_segment_padding_keeps_short_streams_on_device():
+    """Streams shorter than K no longer fall back: the tail is padded
+    with inactive no-op steps on-device (ROADMAP open item)."""
+
+    def stream():
+        for i in range(4):
+            yield Operation(
+                step=0, op="create", kind="nodes",
+                obj=make_node(f"n-{i}", cpu="8", memory="16Gi"),
+            )
+        for step in range(1, 6):
+            yield Operation(
+                step=step, op="create", kind="pods",
+                obj=make_pod(f"p-{step}", cpu="500m", memory="512Mi"),
+            )
+
+    base, dev, driver = _run_pair(stream, x64=False, k=8)
+    assert _steps_sig(dev) == _steps_sig(base)
+    assert driver.fallback_steps == 0
+    assert driver.device_steps == 6  # step 0 (bootstrap) + 5 pod steps
 
 
 def test_sampling_k_validated_against_real_node_count():
