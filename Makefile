@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check
+.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults
 
 test:
 	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
@@ -12,6 +12,17 @@ test:
 # 52781/42829 counts stepwise (repo CLAUDE.md).  ~10 min on CPU.
 lock-check:
 	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_device_vs_per_pass -q -rs -m slow
+
+# The fault suite (docs/faults.md) on CPU in the sanitized environment
+# (tests/helpers.sanitized_cpu_env drops the axon sitecustomize that
+# wedges jax init on a dead chip) — runnable under ANY hardware state.
+# -m '' overrides pyproject's default -m 'not slow' so the slow-marked
+# 6k fault schedules run here too (the full five-schedule matrix).
+faults:
+	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
+	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
+	'tests/test_replay_faults.py', 'tests/test_fault_injection.py', \
+	'-q', '-m', ''], env=sanitized_cpu_env()))"
 
 test-tpu:
 	$(PY) -m pytest tests/test_tpu_parity.py -q -rs

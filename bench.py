@@ -102,9 +102,17 @@ def _child_setup() -> None:
 
 
 def child_probe() -> dict:
-    import jax
+    from ksim_tpu.errors import DeviceUnavailableError
 
-    devs = jax.devices()
+    try:
+        import jax
+
+        devs = jax.devices()
+    except Exception as e:
+        # Classify backend-init failures as the sentinel the rest of the
+        # repo uses for a dead/wedged accelerator, so the parent's error
+        # record carries provenance ("DeviceUnavailableError: ...").
+        raise DeviceUnavailableError(f"backend init failed: {e}") from e
     return {"platform": devs[0].platform, "device_count": len(devs)}
 
 
@@ -291,19 +299,23 @@ def child_churn(
         # step fraction track tensor-vocabulary coverage across rounds.
         drv = runner.replay_driver
         round_trips = drv.device_round_trips + drv.fallback_steps
+        # drv.stats() carries the dispatch counters PLUS the round-8
+        # failure-containment evidence: device_errors = dispatches
+        # degraded to the host path, watchdog_timeouts its hung subset,
+        # breaker_tripped = the sticky circuit breaker disabled the
+        # device path mid-run.  All of it flows from the KSIM_FAULTS /
+        # KSIM_REPLAY_* environment, so the stdlib-only parent can arm
+        # chaos runs without importing anything.
         out.update(
             device=True,
-            device_steps=drv.device_steps,
-            fallback_steps=drv.fallback_steps,
             device_step_fraction=(
                 round(drv.device_steps / len(res.steps), 4) if res.steps else None
             ),
-            device_round_trips=drv.device_round_trips,
             per_pass_round_trips=len(res.steps),
             dispatch_reduction=(
                 round(len(res.steps) / round_trips, 1) if round_trips else None
             ),
-            unsupported=dict(drv.unsupported),
+            **drv.stats(),
         )
     print(
         f"[churn {n_events}ev/{n_nodes}n"

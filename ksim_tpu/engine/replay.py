@@ -72,6 +72,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Sequence
@@ -79,6 +80,12 @@ from typing import Any, Sequence
 import jax
 import numpy as np
 
+from ksim_tpu.errors import (
+    DeviceUnavailableError,
+    ReplayFallback,
+    SimulatorError,
+)
+from ksim_tpu.faults import FAULTS
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
 
 logger = logging.getLogger(__name__)
@@ -104,6 +111,26 @@ FULL_RECORD_BYTES = int(os.environ.get("KSIM_REPLAY_FULL_BYTES", str(1 << 30)))
 PREEMPT_CANDIDATES = int(os.environ.get("KSIM_REPLAY_CMAX", "16"))
 PREEMPT_VICTIMS = int(os.environ.get("KSIM_REPLAY_VMAX", "8"))
 
+# Failure containment (docs/churn_floor.md "Failure containment"):
+# each segment dispatch runs on a worker thread bounded by the watchdog
+# (a wedged chip tunnel blocks block_until_ready FOREVER — the exact
+# condition that has repeatedly stalled TPU measurement on this image,
+# repo CLAUDE.md); N CONSECUTIVE device failures trip a sticky
+# circuit breaker that disables the device path for the rest of the
+# run, so a dead backend costs N watchdog timeouts total rather than
+# one per remaining segment.  Read at ReplayDriver construction so
+# tests (and bench children) tune them through the environment.
+WATCHDOG_DEFAULT_S = 300.0  # generous: first dispatch includes XLA compile
+BREAKER_DEFAULT_N = 3
+
+
+def _watchdog_seconds() -> float:
+    return float(os.environ.get("KSIM_REPLAY_WATCHDOG_S", str(WATCHDOG_DEFAULT_S)))
+
+
+def _breaker_threshold() -> int:
+    return int(os.environ.get("KSIM_REPLAY_BREAKER_N", str(BREAKER_DEFAULT_N)))
+
 _I32_MIN = np.iinfo(np.int32).min
 _I32_MAX = np.iinfo(np.int32).max
 
@@ -120,8 +147,13 @@ def _backoff_constants() -> tuple[int, int]:
 
 class ReplayParityError(RuntimeError):
     """Device-resident replay state diverged from the host store — a bug
-    in the delta application, never a recoverable condition (the store
-    may already hold device-computed placements)."""
+    in the delta application.  Deliberately NOT a SimulatorError: the
+    classified fault handlers must re-raise it, never absorb it into a
+    silent per-pass fallback (that would mask a kernel bug behind
+    correct-looking counts).  Since the atomic segment reconcile
+    (round 8) the store it fired against has been ROLLED BACK — the
+    error is loud but no longer leaves device-computed placements
+    behind."""
 
 
 def _pod_key(pod: JSON) -> str:
@@ -914,6 +946,28 @@ class ReplayDriver:
         self.fallback_steps = 0
         self.device_round_trips = 0  # one per segment dispatch group
         self.unsupported: dict[str, int] = {}
+        # Failure-containment state — PER DRIVER, never process-global
+        # (two runners in one process must not trip each other's
+        # breaker).  The bench rung and runner stats surface all of it.
+        self.watchdog_s = _watchdog_seconds()
+        self.breaker_threshold = max(_breaker_threshold(), 1)
+        self.device_errors = 0  # dispatches that degraded to the host path
+        self.watchdog_timeouts = 0  # subset of device_errors
+        self.breaker_tripped = False  # sticky: device path disabled
+        self._consecutive_device_errors = 0
+        self._consecutive_reconcile_faults = 0
+
+    def stats(self) -> dict:
+        """Degradation evidence for runner stats / the bench JSON."""
+        return {
+            "device_steps": self.device_steps,
+            "fallback_steps": self.fallback_steps,
+            "device_round_trips": self.device_round_trips,
+            "device_errors": self.device_errors,
+            "watchdog_timeouts": self.watchdog_timeouts,
+            "breaker_tripped": self.breaker_tripped,
+            "unsupported": dict(self.unsupported),
+        }
 
     # -- support checks ------------------------------------------------------
 
@@ -1005,7 +1059,24 @@ class ReplayDriver:
         (whose ``steps`` may be SHORTER than the window: the supported
         prefix, tail-padded on-device to the compiled K) or None (the
         FIRST step is unsupported — the caller falls back for it).
-        Must be called BEFORE the steps' ops touch the store."""
+        Must be called BEFORE the steps' ops touch the store.
+
+        Failure taxonomy (classified, never a bare catch-all):
+
+        - ``ReplayFallback`` (lowering vocabulary misses, validation
+          discards) -> per-pass fallback under its stable reason;
+        - any other ``SimulatorError`` during LOWERING -> fallback as
+          ``lowering_fault`` (an expected, containable failure);
+        - device/runtime errors or a watchdog timeout during DISPATCH ->
+          ``device_error`` fallback, counted toward the circuit breaker;
+        - everything else (TypeError & friends) is a programming error
+          and RE-RAISES — silent fallback must never mask a bug.
+        """
+        if self.breaker_tripped:
+            # Sticky: after the breaker opens, every window falls back
+            # immediately — no lowering work, no watchdog tax.
+            self._reject("breaker_open")
+            return None
         if not self.service_supported():
             return None
         m = 0
@@ -1018,13 +1089,114 @@ class ReplayDriver:
         if self._record_mode == "full":
             m = min(m, self._full_k)
         try:
+            FAULTS.check("replay.lower")
             plan = self._lower(list(batches[:m]))
-        except _Unsupported as e:
+        except ReplayFallback as e:
             self._reject(str(e))
+            return None
+        except SimulatorError as e:
+            logger.warning(
+                "segment lowering failed (%s: %s); falling back per-pass",
+                type(e).__name__, e,
+            )
+            self._reject("lowering_fault")
             return None
         if plan is None:
             return None
-        return self._run(plan)
+        try:
+            res = self._run_watchdogged(plan)
+        except ReplayParityError:
+            raise  # a kernel bug, not a degradable condition
+        except ReplayFallback as e:
+            self._reject(str(e))
+            return None
+        except (DeviceUnavailableError, SimulatorError, RuntimeError, OSError) as e:
+            return self._note_device_error(e)
+        # The dispatch came back healthy (even if validation discarded
+        # the segment): the backend is alive — reset the breaker window.
+        self._consecutive_device_errors = 0
+        self.device_round_trips += 1
+        if isinstance(res, str):
+            # Post-dispatch validation discard (featurize_prediction /
+            # preemption_overflow): store untouched, fall back.
+            self._reject(res)
+            return None
+        # device_steps is counted by the caller once the segment COMMITS
+        # (a rolled-back reconcile re-runs its steps per-pass — counting
+        # here would double-book them).
+        return res
+
+    def _run_watchdogged(self, plan: "_SegmentPlan"):
+        """Run ``_run`` on a worker thread bounded by the watchdog.
+
+        ``block_until_ready`` against a wedged backend never returns;
+        the join timeout turns that hang into DeviceUnavailableError so
+        the run DEGRADES instead of stalling.  The abandoned worker is a
+        daemon — it cannot be killed, but the breaker counts CUMULATIVE
+        watchdog timeouts (see ``_note_device_error``), so at most
+        ``breaker_threshold`` of them ever exist.  ``_run`` is
+        side-effect-free on the driver (counters are applied by the
+        caller on the MAIN thread), so a late-finishing stray worker
+        cannot corrupt the accounting of the degraded run."""
+        if self.watchdog_s <= 0:
+            return self._run(plan)
+        box: dict[str, Any] = {}
+
+        def work() -> None:
+            try:
+                box["out"] = self._run(plan)
+            except BaseException as e:  # classified by the caller
+                box["err"] = e
+
+        t = threading.Thread(target=work, name="replay-dispatch", daemon=True)
+        t.start()
+        t.join(self.watchdog_s)
+        if t.is_alive():
+            self.watchdog_timeouts += 1
+            raise DeviceUnavailableError(
+                f"segment dispatch exceeded the {self.watchdog_s:.0f}s watchdog"
+            )
+        if "err" in box:
+            raise box["err"]
+        return box["out"]
+
+    def _note_device_error(self, e: BaseException) -> None:
+        """Account one degraded dispatch; trip the breaker on the Nth
+        CONSECUTIVE failure — or the Nth watchdog timeout over the whole
+        run: every timeout abandons a worker thread pinned on its
+        segment plan forever, so cumulative timeouts must trip even when
+        healthy dispatches reset the consecutive window in between
+        (bounding leaked workers at breaker_threshold).  Always returns
+        None (the fallback)."""
+        self.device_errors += 1
+        self._consecutive_device_errors += 1
+        self._reject("device_error")
+        if (
+            not self.breaker_tripped
+            and (
+                self._consecutive_device_errors >= self.breaker_threshold
+                or self.watchdog_timeouts >= self.breaker_threshold
+            )
+        ):
+            self.breaker_tripped = True
+            logger.error(
+                "device replay circuit breaker TRIPPED (%d consecutive "
+                "device failures, %d watchdog timeouts total, threshold %d; "
+                "last: %s: %s); remaining steps run on the per-pass host "
+                "path",
+                self._consecutive_device_errors, self.watchdog_timeouts,
+                self.breaker_threshold, type(e).__name__, e,
+            )
+        else:
+            logger.warning(
+                "segment dispatch failed (%s: %s); the window's head step "
+                "re-runs per-pass, the rest retries on-device "
+                "(%d/%d consecutive failures before the circuit breaker "
+                "opens)",
+                type(e).__name__, e,
+                self._consecutive_device_errors, self.breaker_threshold,
+            )
+        return None
 
     def _service_featurizer(self):
         """The canonical per-pass featurizer (created exactly as the
@@ -1612,13 +1784,21 @@ class ReplayDriver:
             )
         return out
 
-    def _run(self, plan: "_SegmentPlan") -> SegmentOutcome:
+    def _run(self, plan: "_SegmentPlan") -> "SegmentOutcome | str":
+        """Dispatch one lowered segment and decode its outputs.
+
+        Returns the SegmentOutcome, or a DISCARD REASON string when
+        post-dispatch validation rejects the results (store untouched
+        either way).  Runs on the watchdog worker thread: it must not
+        mutate driver state — ``try_segment`` applies all accounting on
+        the main thread after a successful join."""
         from ksim_tpu.engine.core import (
             _aux_host,
             _pack_tree_to_device,
             _pull_tree_to_host,
         )
 
+        FAULTS.check("replay.dispatch")
         aux_host, _axes = _aux_host(plan.aux)
         const = dict(plan.const)
         extra = {
@@ -1641,7 +1821,6 @@ class ReplayDriver:
                 outs,
             )
         )
-        self.device_round_trips += 1
 
         st = plan.statics
         eligible = np.asarray(pulled["eligible"])
@@ -1651,17 +1830,14 @@ class ReplayDriver:
                 # still had eligible pods, or every eligible pod vanished)
                 # — the shipped rank tensors assumed the wrong slot
                 # history.  The store is untouched: discard and fall back.
-                self._reject("featurize_prediction")
-                return None
+                return "featurize_prediction"
         if st.preempt and bool(
             np.any(np.asarray(pulled["overflow"])[: plan.n_steps])
         ):
             # A victim search exceeded the static candidate/victim
             # bounds: the computed outcomes past that point assumed a
             # truncated search.  Store untouched — discard, fall back.
-            self._reject("preemption_overflow")
-            return None
-        self.device_steps += plan.n_steps
+            return "preemption_overflow"
 
         sel = np.asarray(pulled["sel"])  # [K, Q]
         idx = np.asarray(pulled["idx"])  # [K, Q]
@@ -1749,7 +1925,13 @@ class ReplayDriver:
             if bool(flush[k]):
                 first_flush_pc = int(pcs[k - 1]) if k else plan.initial_pass_count
                 break
-        for key, (a, r) in self.service._backoff.items():
+        # Snapshot under the service's lock: _run executes on the
+        # watchdog worker thread, and an ABANDONED worker (timeout)
+        # races the main thread's per-pass fallback mutating _backoff —
+        # an unlocked iteration could die mid-dict-resize.
+        with self.service._backoff_lock:
+            svc_backoff = dict(self.service._backoff)
+        for key, (a, r) in svc_backoff.items():
             if key in backoff or key in plan.universe_row_of:
                 continue
             if first_flush_pc is not None:
@@ -1772,24 +1954,24 @@ class ReplayDriver:
 
     # -- reconcile -----------------------------------------------------------
 
-    def advance_service_step(self, outcome: StepOutcome) -> None:
-        """Roll the canonical featurizer's slot history forward one step
-        (called after a device step's ops hit the store) so any LATER
-        fallback pass sees exactly the node order the pure per-pass path
-        would have.  A step whose pass never featurized (empty eligible
-        queue) advances nothing — the per-pass path skips the sync too."""
-        if outcome.eligible <= 0:
-            return
+    def advance_service_slots(self, step_nodes: "Sequence[Any]") -> None:
+        """Roll the canonical featurizer's slot history forward one
+        entry per reconciled step (``None`` = the pass never featurized:
+        empty eligible queue — the per-pass path skips the sync too), so
+        any LATER fallback pass sees exactly the node order the pure
+        per-pass history would have produced.  Called AFTER the segment
+        transaction commits: the featurizer has no rollback, so staging
+        must never touch it."""
         feat = self._service_featurizer()
-        feat.advance_slots(self.store.list("nodes", copy_objs=False))
+        for nodes in step_nodes:
+            if nodes is not None:
+                feat.advance_slots(nodes)
 
-    def finalize_segment(self, seg: SegmentOutcome) -> None:
-        """Sync service bookkeeping to the device outcome and verify the
-        store converged to the device's view of the cluster."""
-        svc = self.service
-        svc._pass_count = seg.pass_count
-        with svc._backoff_lock:
-            svc._backoff = dict(seg.backoff)
+    def verify_segment(self, seg: SegmentOutcome) -> None:
+        """Verify the staged store converged to the device's view of the
+        cluster.  Runs INSIDE the segment transaction: a mismatch raises
+        ReplayParityError and the transaction rolls every staged write
+        back — loud, but no longer store-poisoning."""
         store_bound = {
             _pod_key(p): p["spec"]["nodeName"]
             for p in self.store.pods_with_node()
@@ -1805,6 +1987,38 @@ class ReplayDriver:
                 f"{sorted(extra)[:3]}); bound {len(store_bound)} vs "
                 f"{len(seg.bound_view)}, pending {len(store_pending)} vs "
                 f"{len(seg.pending_view)}"
+            )
+
+    def sync_service(self, seg: SegmentOutcome) -> None:
+        """Sync service bookkeeping (pass counter, backoff table) to the
+        committed device outcome — post-commit only, like every other
+        non-store effect of a segment."""
+        svc = self.service
+        svc._pass_count = seg.pass_count
+        with svc._backoff_lock:
+            svc._backoff = dict(seg.backoff)
+        # A committed segment proves the whole device->store pipeline is
+        # healthy: reset the reconcile side of the breaker window.
+        self._consecutive_reconcile_faults = 0
+
+    def note_reconcile_fault(self) -> None:
+        """Account one rolled-back segment reconcile (the runner's
+        atomic-commit fallback).  Consecutive rollbacks trip the same
+        sticky breaker as device failures: a persistently failing
+        reconcile would otherwise pay a full lowering + dispatch +
+        rollback for every remaining step with no containment."""
+        self._reject("reconcile_fault")
+        self._consecutive_reconcile_faults += 1
+        if (
+            not self.breaker_tripped
+            and self._consecutive_reconcile_faults >= self.breaker_threshold
+        ):
+            self.breaker_tripped = True
+            logger.error(
+                "device replay circuit breaker TRIPPED after %d consecutive "
+                "segment-reconcile rollbacks (threshold %d); remaining steps "
+                "run on the per-pass host path",
+                self._consecutive_reconcile_faults, self.breaker_threshold,
             )
 
 
@@ -1828,5 +2042,10 @@ class _SegmentPlan:
     step_node_event: list = field(default_factory=list)
 
 
-class _Unsupported(Exception):
-    """Lowering found an op/object outside the tensor vocabulary."""
+class _Unsupported(ReplayFallback):
+    """Lowering found an op/object outside the tensor vocabulary — the
+    replay-local spelling of errors.ReplayFallback (str(e) is the
+    histogram reason, as before)."""
+
+    def __init__(self, reason: str) -> None:
+        super().__init__(reason)

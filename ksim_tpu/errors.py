@@ -20,3 +20,21 @@ class InvalidConfigError(SimulatorError):
 class ExpiredError(SimulatorError):
     """A watch resume point fell out of the event history — the "410
     Gone" etcd compaction analogue; the client must relist."""
+
+
+class DeviceUnavailableError(SimulatorError):
+    """The accelerator backend failed or stopped answering — an XLA
+    runtime error, a wedged chip tunnel, or a dispatch that outlived its
+    watchdog.  Consumers must DEGRADE (host path, circuit breaker)
+    rather than crash: the condition is environmental, not a bug."""
+
+
+class ReplayFallback(SimulatorError):
+    """A replay segment cannot (or must not) run on-device and should
+    take the per-pass host path instead.  ``reason`` is the stable
+    string the fallback histogram buckets on (engine/replay.py
+    ``ReplayDriver.unsupported``)."""
+
+    def __init__(self, reason: str = "replay_fallback") -> None:
+        super().__init__(reason)
+        self.reason = reason

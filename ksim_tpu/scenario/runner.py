@@ -237,17 +237,22 @@ class ScenarioRunner:
         )
         return done
 
-    def _reconcile_device_step(
-        self, step: int, batch: list[Operation], outcome, result: ScenarioResult
+    def _stage_device_step(
+        self,
+        batch: list[Operation],
+        outcome,
+        eviction_sink: list[tuple[str, str]],
     ) -> None:
-        """Replay one device-computed step into the store: the step's ops
+        """Stage one device-computed step's STORE writes: the step's ops
         (+ requeue), then the pass's placements in commit order.  With
         per-attempt detail (preemption / record="full" segments) each
         attempt's write mirrors the per-pass rebuild — result
         annotations, bind or nomination — followed by its preemption
-        victims' evictions, in the exact per-pass order."""
+        victims' evictions, in the exact per-pass order.  Runs inside
+        the segment transaction: store-only, no service/result effects
+        (victim eviction listeners defer into ``eviction_sink`` and fire
+        after commit)."""
         self._apply_batch(batch)
-        result.events_applied += len(batch)
         if outcome.attempts is not None:
             from ksim_tpu.engine.annotations import apply_results_to_pod
 
@@ -272,11 +277,14 @@ class ScenarioRunner:
                     self.store.patch(
                         "pods", att.name, att.namespace, mutate, copy_ret=False
                     )
-                # Victim evictions go through the service so eviction
-                # listeners fire exactly as on the per-pass path.
+                # Victim evictions go through the service so delete
+                # semantics match the per-pass path; listener callbacks
+                # defer to post-commit (a rolled-back segment must never
+                # have announced an eviction that did not happen).
                 for vns, vname in att.victims:
                     self.service._evict_victim(
-                        {"metadata": {"name": vname, "namespace": vns}}
+                        {"metadata": {"name": vname, "namespace": vns}},
+                        listener_sink=eviction_sink,
                     )
         else:
             for ns, name, node in outcome.binds:
@@ -287,6 +295,12 @@ class ScenarioRunner:
                     obj.get("status", {}).pop("nominatedNodeName", None)
 
                 self.store.patch("pods", name, ns, bind, copy_ret=False)
+
+    def _record_device_step(
+        self, step: int, batch: list[Operation], outcome, result: ScenarioResult
+    ) -> None:
+        """Post-commit result accounting for one device step."""
+        result.events_applied += len(batch)
         result.pods_scheduled += outcome.scheduled
         result.unschedulable_attempts += outcome.unschedulable
         result.steps.append(
@@ -298,6 +312,69 @@ class ScenarioRunner:
                 pending_after=outcome.pending_after,
             )
         )
+
+    def _commit_segment(
+        self, seg_keys, batches, seg, driver, result: ScenarioResult
+    ) -> bool:
+        """Reconcile one device-computed segment ALL-OR-NOTHING.
+
+        Every store write of the segment — event ops, requeue patches,
+        bind/nomination/annotation patches, victim evictions — stages
+        inside one store transaction, the device-vs-store parity check
+        runs against the staged state, and only then does the batch
+        commit (watch events deliver at commit, in write order).  The
+        service-side effects with no rollback story — eviction
+        listeners, featurizer slot advances, backoff/pass-count sync,
+        result accumulation — run strictly AFTER the commit.
+
+        An INJECTED fault mid-reconcile (the fault plane's
+        InjectedFault) rolls the whole segment back and returns False:
+        the store is byte-identical to the segment's start and the
+        caller proceeds exactly as if the segment had never lowered —
+        the window's head step runs per-pass, the remaining steps are
+        retried on-device in the next window.  Consecutive rollbacks
+        feed the driver's circuit breaker, so a persistently failing
+        reconcile stops paying lowering + dispatch + rollback per step.
+        Everything else — ReplayParityError, store-integrity errors
+        (NotFound/Conflict are device-decode bugs wearing a
+        SimulatorError class), programming errors — still propagates
+        LOUDLY, but now with the store rolled back rather than
+        half-applied: a kernel bug must never be indistinguishable
+        from an injected chaos fault."""
+        from ksim_tpu.faults import FAULTS, InjectedFault
+
+        evictions: list[tuple[str, str]] = []
+        step_nodes: list = []
+        try:
+            with self.store.transaction():
+                for batch, outcome in zip(batches, seg.steps):
+                    FAULTS.check("replay.reconcile")
+                    self._stage_device_step(batch, outcome, evictions)
+                    # Captured per step for the deferred slot advance:
+                    # live node dicts are frozen (replace-on-write), so
+                    # the references stay valid after commit.
+                    step_nodes.append(
+                        self.store.list("nodes", copy_objs=False)
+                        if outcome.eligible > 0
+                        else None
+                    )
+                driver.verify_segment(seg)
+        except InjectedFault as e:
+            driver.note_reconcile_fault()
+            logger.warning(
+                "device segment reconcile aborted (%s: %s); store rolled "
+                "back — the window's head step re-runs per-pass, the rest "
+                "retries on-device",
+                type(e).__name__, e,
+            )
+            return False
+        self.service._notify_evictions(evictions)
+        driver.advance_service_slots(step_nodes)
+        driver.sync_service(seg)
+        driver.device_steps += len(seg.steps)
+        for step, batch, outcome in zip(seg_keys, batches, seg.steps):
+            self._record_device_step(step, batch, outcome, result)
+        return True
 
     def run(self, ops: Iterable[Operation]) -> ScenarioResult:
         """Apply operations grouped by step; one scheduling pass per step
@@ -333,11 +410,9 @@ class ScenarioRunner:
                 seg_keys = keys[i : i + driver.k]
                 batches = [by_step[s] for s in seg_keys]
                 seg = driver.try_segment(batches)
-                if seg is not None:
-                    for step, batch, outcome in zip(seg_keys, batches, seg.steps):
-                        self._reconcile_device_step(step, batch, outcome, result)
-                        driver.advance_service_step(outcome)
-                    driver.finalize_segment(seg)
+                if seg is not None and self._commit_segment(
+                    seg_keys, batches, seg, driver, result
+                ):
                     i += len(seg.steps)
                     continue
             step = keys[i]

@@ -35,6 +35,7 @@ import copy
 from ksim_tpu.engine import Engine
 from ksim_tpu.engine.annotations import RenderCtx, apply_results_to_pod, render_pod_results
 from ksim_tpu.engine.core import ScoredPlugin
+from ksim_tpu.faults import FAULTS
 from ksim_tpu.scheduler.profile import (
     DEFAULT_SCHEDULER_NAME,
     Builder,
@@ -416,6 +417,11 @@ class SchedulerService:
             return self._schedule_pending_locked()
 
     def _schedule_pending_locked(self) -> dict[str, str | None]:
+        # Fault-plane site: an injected fault aborts the pass BEFORE any
+        # bookkeeping mutates (pass counter, placements) — the watch
+        # loop's containment (its except around schedule_pending) and
+        # the runner's step retry are what a schedule here exercises.
+        FAULTS.check("service.schedule")
         nodes = self._store.list("nodes", copy_objs=False)
         namespaces = self._store.list("namespaces", copy_objs=False)
         volume_kw = dict(
@@ -797,23 +803,41 @@ class SchedulerService:
         the victim is already gone from the store when it fires)."""
         self._eviction_listeners.append(fn)
 
-    def _evict_victim(self, v: JSON) -> None:
+    def _evict_victim(self, v: JSON, *, listener_sink=None) -> None:
         """Preemption eviction (the debuggable scheduler deletes victims
         via the apiserver; KWOK terminates immediately).  Listeners run
         only AFTER the store delete succeeded — a mark for a delete that
         never happened would leak and misclassify a LATER plain delete
         of a same-named pod as an eviction (the write-back's DELETED
-        handler rechecks once to absorb the mark-after-event race)."""
+        handler rechecks once to absorb the mark-after-event race).
+
+        ``listener_sink`` defers the listener callbacks: the successful
+        eviction appends ``(namespace, name)`` there instead of firing,
+        and the caller replays the sink through ``_notify_evictions``
+        once its batch is durable — the device replay's atomic segment
+        reconcile stages evictions inside a store transaction and must
+        not announce one that could still roll back."""
         try:
             self._store.delete("pods", name_of(v), namespace_of(v))
         except Exception:
             logger.exception("failed to evict victim %s", name_of(v))
             return
-        for fn in self._eviction_listeners:
-            try:
-                fn(namespace_of(v) or "default", name_of(v))
-            except Exception:
-                logger.exception("eviction listener failed")
+        ev = (namespace_of(v) or "default", name_of(v))
+        if listener_sink is not None:
+            listener_sink.append(ev)
+            return
+        self._notify_evictions([ev])
+
+    def _notify_evictions(self, evictions) -> None:
+        """Fire eviction listeners for ``(namespace, name)`` tuples in
+        order (each listener isolated — one failing must not starve the
+        rest)."""
+        for ns, nm in evictions:
+            for fn in self._eviction_listeners:
+                try:
+                    fn(ns, nm)
+                except Exception:
+                    logger.exception("eviction listener failed")
 
     def _bind_results(self, queue, feats, plugins, res, placements, prof=None) -> None:
         render_ctx = RenderCtx(feats, plugins) if self._record == "full" else None

@@ -14,11 +14,12 @@ from __future__ import annotations
 
 import bisect
 import collections
+import contextlib
 import copy
 import itertools
 import queue
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator
 
 from ksim_tpu.errors import ConflictError, ExpiredError, NotFoundError
@@ -40,6 +41,25 @@ NAMESPACED_KINDS = frozenset({"pods", "persistentvolumeclaims"})
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+
+
+#: pre-image marker for keys a transaction CREATED (nothing to restore).
+_MISSING = object()
+
+
+@dataclass
+class _Txn:
+    """Open-transaction state: first-touch pre-images + buffered events.
+
+    Pre-images are the LIVE stored dicts (frozen contract: writes
+    replace, never mutate), so recording them is O(1) per touched key —
+    no copies.  Events buffer instead of delivering; commit replays
+    them through the normal notify path, rollback drops them, so a
+    watcher (the scheduler loop, the live write-back) can never observe
+    a state the transaction did not commit."""
+
+    pre: dict = field(default_factory=dict)  # (kind, key) -> obj | _MISSING
+    events: list = field(default_factory=list)
 
 
 @dataclass(frozen=True, slots=True)
@@ -102,6 +122,73 @@ class ClusterStore:
         # replay) against a dict-bucket lookup.
         self._by_node: dict[str, dict[str, JSON]] = {}
         self._node_of: dict[str, str] = {}
+        # Open transaction (``transaction()``); None outside one.
+        self._txn: _Txn | None = None
+
+    # -- transactions -------------------------------------------------------
+
+    @contextlib.contextmanager
+    def transaction(self):
+        """All-or-nothing write batch.
+
+        Holds the store lock for the whole block (readers in OTHER
+        threads wait; the owning thread reads its own staged state
+        through the normal API).  On normal exit, buffered watch events
+        deliver in write order.  On ANY exception, every touched key
+        restores to its pre-transaction object and no event is ever
+        delivered — a watcher cannot observe a half-applied batch.
+        The resourceVersion counter is deliberately not rewound
+        (rv gaps are legal, like etcd revisions).
+
+        Used by the device-replay segment reconcile (scenario/runner.py)
+        so an injected mid-reconcile fault — or a parity-check failure —
+        can never leave a partially applied segment in the store.
+        Nesting is not supported; ``restore`` inside a transaction is
+        refused."""
+        with self._lock:
+            if self._txn is not None:
+                raise RuntimeError("nested store transactions are not supported")
+            txn = _Txn()
+            self._txn = txn
+            try:
+                yield self
+            except BaseException:
+                self._txn = None
+                self._rollback(txn)
+                raise
+            self._txn = None
+            for ev in txn.events:
+                self._deliver(ev)
+
+    def _touch(self, kind: str, key: str) -> None:
+        """Record a key's first-touch pre-image (callers hold the lock
+        and are about to mutate the key)."""
+        txn = self._txn
+        if txn is not None and (kind, key) not in txn.pre:
+            txn.pre[(kind, key)] = self._objects[kind].get(key, _MISSING)
+
+    def _rollback(self, txn: _Txn) -> None:
+        """Restore every touched key to its pre-transaction object and
+        repair the incremental indexes (callers hold the lock).  The
+        (name, key) sort entry is identical for pre/current objects of
+        the same key (the key embeds the name), so membership-only
+        repair is exact."""
+        for (kind, key), pre in txn.pre.items():
+            cur = self._objects[kind].get(key, _MISSING)
+            if cur is pre:
+                continue
+            sk = self._sorted_keys[kind]
+            if cur is not _MISSING:
+                del self._objects[kind][key]
+                entry = (name_of(cur), key)
+                idx = bisect.bisect_left(sk, entry)
+                if idx < len(sk) and sk[idx] == entry:
+                    del sk[idx]
+            if pre is not _MISSING:
+                self._objects[kind][key] = pre
+                bisect.insort(sk, (name_of(pre), key))
+            if kind == "pods":
+                self._index_pod(key, None if pre is _MISSING else pre)
 
     # -- pod node-name index ------------------------------------------------
 
@@ -180,6 +267,7 @@ class ClusterStore:
             key = _key(kind, obj)
             if key in self._objects[kind]:
                 raise ConflictError(f"{kind} {key!r} already exists")
+            self._touch(kind, key)
             md = obj.setdefault("metadata", {})
             if kind in NAMESPACED_KINDS:
                 md.setdefault("namespace", "default")
@@ -240,6 +328,7 @@ class ClusterStore:
                 raise ConflictError(
                     f"{kind} {key!r}: resourceVersion {expect_rv} is stale"
                 )
+            self._touch(kind, key)
             md = obj.setdefault("metadata", {})
             if kind in NAMESPACED_KINDS:
                 md.setdefault("namespace", "default")
@@ -271,6 +360,7 @@ class ClusterStore:
                 raise NotFoundError(f"{kind} {key!r} not found")
             obj = copy.deepcopy(current)
             mutate(obj)
+            self._touch(kind, key)
             obj["metadata"]["resourceVersion"] = str(next(self._rv))
             self._objects[kind][key] = obj
             if kind == "pods":
@@ -301,6 +391,7 @@ class ClusterStore:
             if current is None:
                 raise NotFoundError(f"{kind} {key!r} not found")
             obj = build(current)
+            self._touch(kind, key)
             md = obj["metadata"] = dict(obj.get("metadata") or {})
             md["resourceVersion"] = str(next(self._rv))
             self._objects[kind][key] = obj
@@ -313,6 +404,8 @@ class ClusterStore:
         self._check_kind(kind)
         with self._lock:
             key = _key(kind, name, namespace)
+            if key in self._objects[kind]:
+                self._touch(kind, key)
             obj = self._objects[kind].pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{kind} {key!r} not found")
@@ -419,6 +512,14 @@ class ClusterStore:
             self._watchers = [(w, ks) for (w, ks) in self._watchers if w is not q]
 
     def _notify(self, event: WatchEvent) -> None:
+        if self._txn is not None:
+            # Staged: delivery (history + watcher queues) happens at
+            # commit, in write order; rollback drops the event unseen.
+            self._txn.events.append(event)
+            return
+        self._deliver(event)
+
+    def _deliver(self, event: WatchEvent) -> None:
         try:
             rv = int(event.obj["metadata"]["resourceVersion"])
         except (KeyError, ValueError, TypeError):
@@ -443,6 +544,8 @@ class ClusterStore:
         them; the restored objects' recorded rvs are superseded, like an
         etcd re-put bumping mod_revision."""
         with self._lock:
+            if self._txn is not None:
+                raise RuntimeError("restore() inside a store transaction")
             for kind in KINDS:
                 for obj in list(self._objects[kind].values()):
                     # Shallow re-wrap, not in-place: the stored dict may be

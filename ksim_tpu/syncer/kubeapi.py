@@ -47,6 +47,7 @@ import urllib.request
 from typing import Any
 
 from ksim_tpu.errors import InvalidConfigError, SimulatorError
+from ksim_tpu.faults import FAULTS
 from ksim_tpu.state.cluster import ADDED, DELETED, KINDS, MODIFIED, WatchEvent
 from ksim_tpu.state.resources import JSON, labels_of, name_of, namespace_of
 from ksim_tpu.state.selectors import match_label_selector
@@ -366,6 +367,10 @@ class KubeApiSource:
             self._headers_expiry = expiry
 
     def _open(self, path: str, query: dict[str, Any], timeout: float):
+        # Same fault-plane site as _request: "kubeapi.request" covers
+        # EVERY apiserver HTTP call, list/watch GETs included, so a
+        # chaos run exercises the relist/410-resume recovery paths too.
+        FAULTS.check("kubeapi.request")
         url = self._server + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
@@ -397,6 +402,9 @@ class KubeApiSource:
         """One non-streaming request with the same auth-refresh/401-retry
         protocol as ``_open``.  Raises KubeApiError carrying the HTTP
         status so callers can branch on 404/409."""
+        # Fault-plane site: injected before the wire so chaos runs can
+        # fail/hang any apiserver request without a cooperating server.
+        FAULTS.check("kubeapi.request")
         url = self._server + path
         data = None if body is None else json.dumps(body).encode()
         self._maybe_refresh_auth()

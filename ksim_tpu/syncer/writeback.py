@@ -40,6 +40,7 @@ import os
 import threading
 import time
 
+from ksim_tpu.faults import FAULTS
 from ksim_tpu.state.cluster import ADDED, DELETED, MODIFIED, ClusterStore
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
 from ksim_tpu.syncer.kubeapi import KubeApiError, KubeApiSource
@@ -268,6 +269,9 @@ class LiveWriteBack:
                 )
 
     def _handle(self, etype: str, pod: JSON) -> None:
+        # Fault-plane site: an injected failure here exercises the
+        # transient-retry policy above exactly like an apiserver blip.
+        FAULTS.check("writeback.push")
         ns = namespace_of(pod) or "default"
         key = _pod_key(pod)
         if etype == DELETED:

@@ -87,3 +87,40 @@ def test_bench_emits_json_on_sigterm():
     out = _last_json_line(stdout)
     assert out["metric"] == "sched_pairs_per_sec"
     assert out.get("interrupted") == "SIGTERM", out
+
+
+def test_bench_churn_child_reports_breaker_under_permanent_dispatch_fault(tmp_path):
+    """Round 8: a churn child whose device dispatch permanently fails
+    (fault plane armed through the environment — the stdlib-only parent
+    never imports anything) still writes its JSON record, with the
+    degradation evidence: device_error fallbacks counted, breaker
+    tripped, the whole stream carried by the per-pass path."""
+    out = tmp_path / "churn.json"
+    env = sanitized_cpu_env(
+        {
+            "KSIM_FAULTS": "replay.dispatch=always",
+            "KSIM_REPLAY_BREAKER_N": "2",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn", "--out", str(out),
+            "--seed", "0", "--churn-events", "800", "--churn-nodes", "200",
+            "--churn-device",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["breaker_tripped"] is True
+    assert rec["device_errors"] >= 2
+    assert rec["unsupported"].get("device_error", 0) >= 2
+    assert rec["unsupported"].get("breaker_open", 0) > 0
+    assert rec["device_steps"] == 0
+    assert rec["fallback_steps"] == rec["steps"]
+    assert rec["pods_scheduled"] > 0  # the host path carried the stream

@@ -260,3 +260,96 @@ def test_restore_clears_node_bucket_index():
     store.restore(boot)
     store.restore(snap)
     assert [p["metadata"]["name"] for p in store.pods_on_nodes(["n2"])] == ["real"]
+
+
+# ---------------------------------------------------------------------------
+# Transactions (round 8: the atomic-segment-reconcile substrate)
+# ---------------------------------------------------------------------------
+
+
+def test_transaction_commit_delivers_events_in_write_order():
+    store = ClusterStore()
+    store.create("nodes", make_node("n1"))
+    stream = store.watch(("pods", "nodes"))
+    with store.transaction():
+        store.create("pods", make_pod("p1"))
+        store.patch(
+            "pods", "p1", "default",
+            lambda o: o["spec"].__setitem__("nodeName", "n1"),
+        )
+        store.delete("nodes", "n1")
+        # Mid-transaction, the owning thread reads its own staged state...
+        assert store.get("pods", "p1")["spec"]["nodeName"] == "n1"
+        # ...but nothing has been delivered to watchers yet.
+        assert stream.next(timeout=0) is None
+    got = []
+    while True:
+        ev = stream.next(timeout=0)
+        if ev is None:
+            break
+        got.append((ev.event_type, ev.kind, ev.obj["metadata"]["name"]))
+    stream.close()
+    assert got == [
+        (ADDED, "pods", "p1"),
+        (MODIFIED, "pods", "p1"),
+        (DELETED, "nodes", "n1"),
+    ]
+
+
+def test_transaction_rollback_restores_objects_indexes_and_events():
+    """An exception rolls every staged write back: objects, the sorted
+    key order, the nodeName partition/bucket indexes — and no watch
+    event is ever delivered (a watcher cannot observe the attempt)."""
+    store = ClusterStore()
+    store.create("nodes", make_node("n1"))
+    store.create("pods", make_pod("keep"))
+    store.create("pods", make_pod("bound", node_name="n1"))
+    before_objs = store.dump()
+    stream = store.watch(("pods", "nodes"))
+    with pytest.raises(RuntimeError, match="boom"):
+        with store.transaction():
+            store.create("pods", make_pod("staged"))
+            store.patch(
+                "pods", "keep", "default",
+                lambda o: o["spec"].__setitem__("nodeName", "n1"),
+            )
+            store.delete("pods", "bound", "default")
+            store.delete("nodes", "n1")
+            raise RuntimeError("boom")
+    assert stream.next(timeout=0) is None  # nothing leaked
+    stream.close()
+    assert store.dump() == before_objs
+    # Incremental indexes repaired, not just the object tables:
+    assert [p["metadata"]["name"] for p in store.pods_without_node()] == ["keep"]
+    assert [p["metadata"]["name"] for p in store.pods_on_nodes(["n1"])] == ["bound"]
+    assert [n["metadata"]["name"] for n in store.list("nodes")] == ["n1"]
+    # The store still works normally afterwards (watchers, indexes, rv).
+    store.create("pods", make_pod("after"))
+    assert store.get("pods", "after")["metadata"]["name"] == "after"
+
+
+def test_transaction_rollback_restores_update_pre_image():
+    store = ClusterStore()
+    store.create("pods", make_pod("p1", cpu="100m"))
+    rv_before = store.get("pods", "p1")["metadata"]["resourceVersion"]
+    with pytest.raises(ValueError):
+        with store.transaction():
+            obj = store.get("pods", "p1")
+            obj["metadata"]["labels"] = {"x": "1"}
+            store.update("pods", obj)
+            raise ValueError("abort")
+    got = store.get("pods", "p1")
+    assert got["metadata"].get("labels") == {}
+    assert got["metadata"]["resourceVersion"] == rv_before
+
+
+def test_transaction_nested_and_restore_refused():
+    store = ClusterStore()
+    with pytest.raises(RuntimeError, match="nested"):
+        with store.transaction():
+            with store.transaction():
+                pass
+    boot = store.dump()
+    with pytest.raises(RuntimeError, match="restore"):
+        with store.transaction():
+            store.restore(boot)

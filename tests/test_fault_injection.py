@@ -85,3 +85,102 @@ def test_schedule_pending_propagates_but_leaves_store_consistent():
     svc.schedule_pending()
     for name in ("p1", "p2"):
         assert store.get("pods", name, "default")["spec"].get("nodeName") == "n1"
+
+
+# ---------------------------------------------------------------------------
+# Round 8: fault-plane sites outside the replay executor
+# ---------------------------------------------------------------------------
+
+
+def test_service_schedule_fault_site_loop_survives():
+    """An injected scheduling-pass fault aborts the pass before any
+    bookkeeping mutates; the watch loop's containment retries and the
+    pod still binds once the fault clears."""
+    from ksim_tpu.faults import FAULTS
+
+    FAULTS.reset()
+    FAULTS.arm("service.schedule", "first:2")
+    store = ClusterStore()
+    store.create("nodes", make_node("n1"))
+    store.create("pods", make_pod("p1"))
+    svc = SchedulerService(store)
+    svc.start()
+    try:
+        deadline = time.time() + 120
+        bound = None
+        while time.time() < deadline and not bound:
+            bound = store.get("pods", "p1", "default")["spec"].get("nodeName")
+            time.sleep(0.1)
+        assert FAULTS.fired("service.schedule") >= 1, "fault never exercised"
+        assert bound == "n1"
+    finally:
+        svc.stop()
+        FAULTS.reset()
+
+
+def test_writeback_push_fault_site_retries_and_lands(monkeypatch):
+    """An injected write-back push failure rides the transient-retry
+    policy like an apiserver blip: the bind still lands live, and the
+    exercised-fault counter proves the retry path ran."""
+    from ksim_tpu.faults import FAULTS
+    from ksim_tpu.syncer.writeback import LiveWriteBack
+
+    class FakeSource:
+        def __init__(self):
+            self.bound = []
+
+        def bind_pod(self, ns, name, node):
+            self.bound.append((ns, name, node))
+
+        def patch_pod_annotations(self, ns, name, ann):
+            pass
+
+        def get_pod(self, ns, name):
+            return {"metadata": {"name": name}}
+
+        def delete_pod(self, ns, name, uid=""):
+            pass
+
+    monkeypatch.setattr(LiveWriteBack, "RETRY_DELAY_S", 0.05)
+    FAULTS.reset()
+    FAULTS.arm("writeback.push", "call:1")
+    store = ClusterStore()
+    store.create("pods", make_pod("p1"))
+    src = FakeSource()
+    wb = LiveWriteBack(src, store).start()
+    try:
+        store.patch(
+            "pods", "p1", "default",
+            lambda o: o["spec"].__setitem__("nodeName", "n1"),
+        )
+        deadline = time.time() + 30
+        while time.time() < deadline and not src.bound:
+            time.sleep(0.05)
+        assert FAULTS.fired("writeback.push") == 1, "fault never exercised"
+        assert src.bound == [("default", "p1", "n1")]
+    finally:
+        wb.stop()
+        FAULTS.reset()
+
+
+def test_kubeapi_request_fault_site():
+    """The kubeapi site fires before the wire (no cooperating server
+    needed); once disarmed the real transport path resumes and fails
+    with its own classified error, not the injected one."""
+    import pytest
+
+    from ksim_tpu.faults import FAULTS, InjectedFault
+    from ksim_tpu.syncer.kubeapi import KubeApiError, KubeApiSource
+
+    FAULTS.reset()
+    FAULTS.arm("kubeapi.request", "call:1")
+    src = KubeApiSource("http://127.0.0.1:1", request_timeout=2.0)
+    try:
+        with pytest.raises(InjectedFault):
+            src.get_pod("default", "p1")
+        assert FAULTS.fired("kubeapi.request") == 1
+        assert isinstance(InjectedFault("x"), SimulatorError)
+        with pytest.raises(KubeApiError):
+            src.get_pod("default", "p1")  # fault cleared: real path resumes
+    finally:
+        FAULTS.reset()
