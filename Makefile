@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults
+.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace
 
 test:
 	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
@@ -23,6 +23,14 @@ faults:
 	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
 	'tests/test_replay_faults.py', 'tests/test_fault_injection.py', \
 	'-q', '-m', ''], env=sanitized_cpu_env()))"
+
+# Trace-plane validation (docs/observability.md): the locked 6k prefix
+# through the device path with KSIM_TRACE_OUT set, in the sanitized CPU
+# env — asserts the counts hold under tracing and the emitted Chrome
+# trace parses with every expected phase span, then an armed-fault run
+# asserting the fault/fallback timeline events.  Stdlib-only parent.
+trace:
+	$(PY) tools/trace_check.py
 
 test-tpu:
 	$(PY) -m pytest tests/test_tpu_parity.py -q -rs

@@ -291,6 +291,17 @@ def child_churn(
         "record": "full" if record_full else "selection",
         "platform": jax.devices()[0].platform,
     }
+    if res.phase_seconds:
+        # Per-phase wall-clock split (trace plane, obs.SPAN_NAMES keys):
+        # where inside the replay the time went — device lower/dispatch/
+        # reconcile vs the per-pass host path (runner.step, which nests
+        # its service.schedule span).  The stdlib-only parent passes the
+        # child JSON through untouched, so this rides to the one-line
+        # record for free.
+        out["phases"] = {
+            name: {"seconds": res.phase_seconds[name], "count": res.phase_counts[name]}
+            for name in sorted(res.phase_seconds)
+        }
     if device and runner.replay_driver is not None:
         # Dispatch evidence: the per-pass path pays one engine round-trip
         # group (pack + scan + pull) per scheduling pass; the device path
@@ -329,10 +340,37 @@ def child_churn(
     return out
 
 
+def _proc_watermarks() -> dict:
+    """This process's /proc watermarks (stdlib + procfs only, guarded
+    for non-Linux): the memory-map count — XLA:CPU executables each mmap
+    code pages, and the kernel's vm.max_map_count=65530 default kills a
+    long child at ~63k maps (repo CLAUDE.md) — and the kernel's RSS
+    high-water mark (VmHWM).  Maps are sampled at end-of-rung; under
+    XLA executable accumulation the count is monotone, so the sample IS
+    the rung's peak unless a cache shed ran.  Recording them per rung
+    turns the SIGSEGV class from fatal-only into an observable trend."""
+    out: dict = {}
+    try:
+        with open("/proc/self/maps") as f:
+            out["maps_count"] = sum(1 for _ in f)
+    except OSError:
+        pass
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    out["rss_peak_kb"] = int(line.split()[1])
+                    break
+    except (OSError, ValueError, IndexError):
+        pass
+    return out
+
+
 def _child_main(args: argparse.Namespace) -> None:
     """Entry for --child invocations: run the payload, write its JSON to
     --out (atomic rename), exit 0.  Any exception leaves a JSON error
-    record instead, so the parent can distinguish crash kinds."""
+    record instead, so the parent can distinguish crash kinds.  Every
+    record (success or error) carries the child's /proc watermarks."""
     try:
         if args.child == "probe":
             out = child_probe()
@@ -355,8 +393,10 @@ def _child_main(args: argparse.Namespace) -> None:
     except BaseException:
         traceback.print_exc(file=sys.stderr)
         out = {"error": traceback.format_exc(limit=1).strip().splitlines()[-1]}
+        out.update(_proc_watermarks())
         _write_json(args.out, out)
         sys.exit(1)
+    out.update(_proc_watermarks())
     _write_json(args.out, out)
 
 

@@ -86,9 +86,46 @@ from ksim_tpu.errors import (
     SimulatorError,
 )
 from ksim_tpu.faults import FAULTS
+from ksim_tpu.obs import TRACE, register_provider
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
 
 logger = logging.getLogger(__name__)
+
+#: Every STATIC fallback/discard reason ``ReplayDriver._reject`` can
+#: record — the machine-readable half of the taxonomy prose in
+#: docs/churn_floor.md.  Each rejection also lands a ``replay.fallback``
+#: trace event carrying the reason, so a timeline shows WHICH segment
+#: degraded and why; tests/test_obs.py's registry-sync test scans this
+#: module's source for reason literals and asserts this set matches
+#: (drift = a reason that silently never reaches the trace taxonomy).
+FALLBACK_REASONS: frozenset[str] = frozenset(
+    {
+        # service/profile configuration outside the vocabulary
+        "record_mode", "extenders", "pnts_emulation", "shard_mesh",
+        "featurizer_override", "multi_profile", "no_profile",
+        "queue_hooks", "permit_waiters", "plugin_extender",
+        # object vocabulary misses
+        "scheduling_gates", "foreign_scheduler", "terminal_phase",
+        "host_ports", "volumes", "volume_objects", "node_images",
+        "create_bound_pod", "bound_to_unknown_node", "inexact_units",
+        # stream-shape misses
+        "pod_name_reuse", "backoff_name_reuse", "node_name_reuse",
+        "delete_unknown_pod", "delete_unknown_node",
+        "drain_without_requeue", "duplicate_pod_keys",
+        # lowering-time guards
+        "interpod_local_mismatch", "preemption_filter_set",
+        "preemption_bits_width", "full_record_bytes",
+        # post-dispatch validation discards
+        "featurize_prediction", "preemption_overflow",
+        # degradation ladder (docs/churn_floor.md round 8)
+        "lowering_fault", "device_error", "reconcile_fault",
+        "breaker_open",
+    }
+)
+
+#: Dynamic reason families (``op:<op>/<kind>``, ``host_hook:<attr>``) —
+#: prefix-matched by the registry-sync test.
+FALLBACK_REASON_PREFIXES: tuple[str, ...] = ("op:", "host_hook:")
 
 # Steps batched per device dispatch.  The dispatch-latency win scales
 # with K; lowering/reconcile host work amortizes over it.  8-32 is the
@@ -956,6 +993,23 @@ class ReplayDriver:
         self.breaker_tripped = False  # sticky: device path disabled
         self._consecutive_device_errors = 0
         self._consecutive_reconcile_faults = 0
+        # Segment sequence number (trace-span correlation id: every
+        # lower/dispatch/reconcile span of one window shares it).
+        self._segment_seq = 0
+        # The live driver's degradation evidence rides in the merged
+        # /api/v1/metrics document (latest driver wins — one per
+        # ScenarioRunner run).  Weakly referenced: the module-global
+        # provider registry must not root a finished run's driver (and
+        # its store/service graph) for the rest of the process.
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _stats() -> dict:
+            drv = ref()
+            return drv.stats() if drv is not None else {"collected": True}
+
+        register_provider("replay", _stats)
 
     def stats(self) -> dict:
         """Degradation evidence for runner stats / the bench JSON."""
@@ -973,6 +1027,9 @@ class ReplayDriver:
 
     def _reject(self, reason: str) -> None:
         self.unsupported[reason] = self.unsupported.get(reason, 0) + 1
+        # Every degradation is a timeline event: reason + which window
+        # (the lower/dispatch spans of the same segment share the seq).
+        TRACE.event("replay.fallback", reason=reason, segment=self._segment_seq)
 
     def service_supported(self) -> bool:
         svc = self.service
@@ -1088,9 +1145,11 @@ class ReplayDriver:
             return None
         if self._record_mode == "full":
             m = min(m, self._full_k)
+        self._segment_seq += 1
         try:
-            FAULTS.check("replay.lower")
-            plan = self._lower(list(batches[:m]))
+            with TRACE.span("replay.lower", segment=self._segment_seq, steps=m):
+                FAULTS.check("replay.lower")
+                plan = self._lower(list(batches[:m]))
         except ReplayFallback as e:
             self._reject(str(e))
             return None
@@ -1104,7 +1163,10 @@ class ReplayDriver:
         if plan is None:
             return None
         try:
-            res = self._run_watchdogged(plan)
+            with TRACE.span(
+                "replay.dispatch", segment=self._segment_seq, steps=plan.n_steps
+            ):
+                res = self._run_watchdogged(plan)
         except ReplayParityError:
             raise  # a kernel bug, not a degradable condition
         except ReplayFallback as e:
@@ -1153,6 +1215,11 @@ class ReplayDriver:
         t.join(self.watchdog_s)
         if t.is_alive():
             self.watchdog_timeouts += 1
+            TRACE.event(
+                "replay.watchdog_timeout",
+                segment=self._segment_seq,
+                watchdog_s=self.watchdog_s,
+            )
             raise DeviceUnavailableError(
                 f"segment dispatch exceeded the {self.watchdog_s:.0f}s watchdog"
             )
@@ -1179,6 +1246,12 @@ class ReplayDriver:
             )
         ):
             self.breaker_tripped = True
+            TRACE.event(
+                "replay.breaker_open",
+                cause="device_error",
+                consecutive=self._consecutive_device_errors,
+                watchdog_timeouts=self.watchdog_timeouts,
+            )
             logger.error(
                 "device replay circuit breaker TRIPPED (%d consecutive "
                 "device failures, %d watchdog timeouts total, threshold %d; "
@@ -2014,6 +2087,11 @@ class ReplayDriver:
             and self._consecutive_reconcile_faults >= self.breaker_threshold
         ):
             self.breaker_tripped = True
+            TRACE.event(
+                "replay.breaker_open",
+                cause="reconcile_fault",
+                consecutive=self._consecutive_reconcile_faults,
+            )
             logger.error(
                 "device replay circuit breaker TRIPPED after %d consecutive "
                 "segment-reconcile rollbacks (threshold %d); remaining steps "

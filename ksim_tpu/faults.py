@@ -57,8 +57,25 @@ from ksim_tpu.errors import (
     ReplayFallback,
     SimulatorError,
 )
+from ksim_tpu.obs import TRACE
 
 logger = logging.getLogger(__name__)
+
+#: Every wired injection site, in pipeline order.  This is the ONE
+#: machine-readable list (the docstring table above is prose): each site
+#: fires inside the trace-plane span of the same name (obs.SPAN_NAMES),
+#: and tests/test_obs.py's registry-sync test asserts this tuple matches
+#: the ``FAULTS.check("...")`` call sites in the source AND stays
+#: covered by the span taxonomy — the two registries cannot drift apart
+#: silently.
+SITES: tuple[str, ...] = (
+    "replay.lower",
+    "replay.dispatch",
+    "replay.reconcile",
+    "service.schedule",
+    "writeback.push",
+    "kubeapi.request",
+)
 
 
 class InjectedFault(SimulatorError):
@@ -247,13 +264,18 @@ class FaultPlane:
                 )
         if not fire:
             return
+        # Timeline evidence: a chaos run's question is WHEN the fault
+        # landed relative to the phase spans around it, not just that a
+        # counter moved.
         if kind == "hang":
+            TRACE.event("fault.fired", site=site, mode="hang", seconds=hang_s)
             logger.warning(
                 "fault plane: hanging site %s for %.1fs (call %d)",
                 site, hang_s, calls,
             )
             time.sleep(hang_s)
             return
+        TRACE.event("fault.fired", site=site, mode="raise", exc=exc.__name__)
         logger.warning(
             "fault plane: injecting %s at site %s (call %d)",
             exc.__name__, site, calls,

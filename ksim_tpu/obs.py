@@ -1,0 +1,555 @@
+"""Process-global trace plane: spans, latency histograms, event ring.
+
+The reference simulator's observability is the upstream scheduler's
+Prometheus metrics plus klog (SURVEY §5); before this module the repo's
+analogue was a mean-only ``Metrics`` counter/timer and scattered ad-hoc
+dicts (``ReplayDriver.stats()``, ``FaultPlane`` site counters).  None of
+it could answer the ROADMAP's open TPU wall-clock question — *where*
+does the 50k trajectory spend its time, and *when* did a degradation
+(fallback, watchdog timeout, breaker trip) actually happen.
+
+This module is the single answer surface:
+
+- **Spans** — named intervals on a monotonic clock (``TRACE.span``),
+  one per pipeline phase (segment lower / dispatch / reconcile, the
+  per-pass host step, write-back pushes, kubeapi requests).  Every span
+  lands its duration in a fixed-bucket log-spaced latency histogram and
+  (ring mode) a structured record in the event ring.
+- **Events** — instants (``TRACE.event``): fallback reasons with the
+  segment context, pass outcomes, fault-plane fires, breaker state
+  changes, store-transaction commit/rollback.
+- **Export** — the ring renders as Chrome trace-event JSON
+  (``chrome://tracing`` / https://ui.perfetto.dev): spans become ``X``
+  complete events nested per thread, instants become ``i`` events.
+  ``KSIM_TRACE_OUT=path`` arms an atexit export, so any entrypoint can
+  be traced from the environment alone; ``/api/v1/trace`` serves the
+  same document live.
+
+Observability is zero-perturbation by construction: nothing here reads
+or writes scheduling state, so the churn behavior locks (repo
+CLAUDE.md) hold byte-identically with tracing fully enabled —
+tests/test_behavior_locks.py pins that.  With the plane fully disabled
+every site costs ONE attribute check (``TRACE._active``) and nothing
+else; the module is stdlib-only and never imports jax at module scope
+(the optional ``jax.profiler.TraceAnnotation`` bridge is lazy and
+guarded, so host spans can be correlated with device timelines when a
+jax profile is being captured: ``KSIM_TRACE_JAX=1``).
+
+Environment:
+
+- ``KSIM_TRACE_OUT=path``  enable timing + ring; export Chrome trace
+  JSON to ``path`` at process exit (and on demand).
+- ``KSIM_TRACE=1``         enable timing + ring without a file.
+- ``KSIM_TRACE=timing``    histograms/counters only (no ring storage).
+- ``KSIM_TRACE_RING=N``    ring capacity (default 65536 records).
+- ``KSIM_TRACE_JAX=1``     also wrap spans in
+  ``jax.profiler.TraceAnnotation`` (guarded; no-op if jax is absent or
+  no profiler session is active).
+
+The span/event name taxonomy lives in ``SPAN_NAMES`` / ``EVENT_NAMES``
+below; tests/test_obs.py's registry-sync test asserts every
+``faults.py`` injection site and every replay fallback reason stays
+covered (see docs/observability.md for the full table).
+"""
+
+from __future__ import annotations
+
+import atexit
+import bisect
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterator
+
+__all__ = [
+    "TRACE",
+    "TracePlane",
+    "LatencyHistogram",
+    "SPAN_NAMES",
+    "EVENT_NAMES",
+    "register_provider",
+    "provider_snapshots",
+]
+
+# ---------------------------------------------------------------------------
+# Taxonomy (docs/observability.md keeps the prose table in sync)
+# ---------------------------------------------------------------------------
+
+#: Interval (span) names.  The six fault-plane injection sites
+#: (faults.SITES) each fire INSIDE the span of the same name, so a
+#: fault event always has an enclosing phase on the timeline.
+SPAN_NAMES: tuple[str, ...] = (
+    "replay.lower",  # segment lowering (engine/replay.py)
+    "replay.dispatch",  # device dispatch incl. watchdog wait
+    "replay.reconcile",  # staged store reconcile (the segment txn)
+    "runner.step",  # one per-pass host step (ops + flush + schedule)
+    "service.schedule",  # one scheduling pass (scheduler/service.py)
+    "writeback.push",  # live-cluster write-back push
+    "kubeapi.request",  # any kube-apiserver HTTP request
+)
+
+#: Instant event names.
+EVENT_NAMES: tuple[str, ...] = (
+    "replay.fallback",  # segment rejected/degraded; args.reason is the
+    #                     stable histogram reason (ReplayDriver._reject)
+    "replay.watchdog_timeout",  # a dispatch exceeded the watchdog
+    "replay.breaker_open",  # the sticky circuit breaker tripped
+    #                         (it never closes — openings only)
+    "service.pass",  # pass outcome: attempts/scheduled/unschedulable
+    "fault.fired",  # the fault plane injected at args.site
+    "store.txn_commit",  # segment transaction committed (args.writes)
+    "store.txn_rollback",  # segment transaction rolled back
+)
+
+_KNOWN_NAMES = frozenset(SPAN_NAMES) | frozenset(EVENT_NAMES)
+
+
+# ---------------------------------------------------------------------------
+# Latency histogram
+# ---------------------------------------------------------------------------
+
+
+def _log_edges() -> tuple[float, ...]:
+    """Fixed log-spaced bucket upper edges: 4 per decade from 1 µs to
+    100 s (33 edges; an overflow bucket catches the rest).  Fixed — not
+    adaptive — so two snapshots (or two processes) always merge and
+    compare bucket-for-bucket."""
+    return tuple(1e-6 * 10 ** (i / 4) for i in range(33))
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram (seconds).  NOT thread-safe on its
+    own — callers (``TracePlane``, ``util.Metrics``) hold their lock."""
+
+    EDGES: tuple[float, ...] = _log_edges()
+
+    __slots__ = ("counts", "count", "total", "vmin", "vmax")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(self.EDGES) + 1)  # +1 = overflow
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = 0.0
+
+    def observe(self, seconds: float) -> None:
+        # bisect_left: an observation exactly ON an edge belongs to the
+        # bucket whose upper edge it is (le semantics, like Prometheus).
+        self.counts[bisect.bisect_left(self.EDGES, seconds)] += 1
+        self.count += 1
+        self.total += seconds
+        if seconds < self.vmin:
+            self.vmin = seconds
+        if seconds > self.vmax:
+            self.vmax = seconds
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (upper edge of the
+        bucket holding the q-th observation; the overflow bucket
+        reports the observed max)."""
+        if not self.count:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target and c:
+                # Clamped: a bucket's upper edge can exceed anything
+                # actually observed.
+                return (
+                    min(self.EDGES[i], self.vmax)
+                    if i < len(self.EDGES)
+                    else self.vmax
+                )
+        return self.vmax
+
+    def snapshot(self) -> dict:
+        """JSON-ready view.  Keeps the legacy mean-only timer keys
+        (``total_seconds`` / ``count`` / ``mean_seconds`` — pinned by
+        tests/test_server.py) and adds the histogram: nonzero buckets
+        as ``[upper_edge_seconds, count]`` pairs plus estimated
+        quantiles."""
+        if not self.count:
+            return {"count": 0, "total_seconds": 0.0, "mean_seconds": 0.0}
+        buckets = [
+            [round(self.EDGES[i], 9) if i < len(self.EDGES) else None, c]
+            for i, c in enumerate(self.counts)
+            if c
+        ]
+        return {
+            "count": self.count,
+            "total_seconds": round(self.total, 6),
+            "mean_seconds": round(self.total / self.count, 6),
+            "min_seconds": round(self.vmin, 6),
+            "max_seconds": round(self.vmax, 6),
+            "p50_seconds": round(self.quantile(0.50), 6),
+            "p90_seconds": round(self.quantile(0.90), 6),
+            "p99_seconds": round(self.quantile(0.99), 6),
+            "buckets": buckets,
+        }
+
+
+# ---------------------------------------------------------------------------
+# The plane
+# ---------------------------------------------------------------------------
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager — the whole disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """One live span.  Records at EXIT: a span that never exits (a
+    wedged dispatch abandoned with its watchdog worker) simply leaves
+    no record — the caller-side watchdog timeout event is the evidence
+    for that case."""
+
+    __slots__ = ("_plane", "name", "args", "_t0", "_jax_ctx")
+
+    def __init__(self, plane: "TracePlane", name: str, args: dict) -> None:
+        self._plane = plane
+        self.name = name
+        self.args = args
+        self._t0 = 0
+        self._jax_ctx = None
+
+    def __enter__(self):
+        plane = self._plane
+        tl = plane._tls
+        tl.depth = getattr(tl, "depth", 0) + 1
+        if plane._jax_bridge:
+            # Guarded device-timeline bridge: annotations show up in a
+            # captured jax profile next to the XLA ops they enclose.
+            try:
+                import jax
+
+                self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
+            except Exception:
+                self._jax_ctx = None
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter_ns()
+        if self._jax_ctx is not None:
+            try:
+                self._jax_ctx.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+        plane = self._plane
+        tl = plane._tls
+        depth = getattr(tl, "depth", 1)
+        tl.depth = depth - 1
+        if exc_type is not None:
+            self.args["error"] = exc_type.__name__
+        plane._record_span(self.name, self._t0, t1, depth - 1, self.args)
+        return False
+
+
+class TracePlane:
+    """Bounded, thread-safe process-global trace storage.
+
+    Three independently useful layers, one ``_active`` gate:
+
+    - per-name latency histograms + event counters (``timing``),
+    - the structured event ring (``ring``),
+    - the Chrome-trace exporter over the ring.
+
+    Thread-safe: spans/events land from the scheduler watch loop, the
+    write-back thread, HTTP handler threads, and the replay dispatch
+    worker concurrently; one leaf lock guards all storage (nothing
+    under it calls out, so it cannot participate in a lock cycle)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._active = False
+        # Set by an explicit disable() / KSIM_TRACE=off: ensure_timing's
+        # convenience activation must never override an operator's
+        # stated choice.
+        self._user_disabled = False
+        self._ring_on = False
+        self._jax_bridge = False
+        self.out_path: str | None = None
+        self._epoch_ns = time.perf_counter_ns()
+        self._hist: dict[str, LatencyHistogram] = {}
+        self._counters: dict[str, int] = {}
+        self._ring: deque = deque(maxlen=65536)
+        self._appended = 0  # ring pressure evidence (dropped = appended - len)
+        self._thread_names: dict[int, str] = {}
+
+    # -- configuration ---------------------------------------------------
+
+    def enable(self, *, ring: bool = True, out: str | None = None) -> None:
+        """Turn the plane on.  ``ring=False`` keeps histograms/counters
+        only (no per-record storage); ``out`` arms the atexit Chrome
+        export (also settable via ``KSIM_TRACE_OUT``)."""
+        with self._lock:
+            self._ring_on = ring or out is not None
+            if out is not None:
+                self.out_path = out
+            self._user_disabled = False
+            self._active = True
+
+    def disable(self) -> None:
+        """One attribute check per site from here on (storage kept;
+        ``reset`` clears it).  Sticky against ``ensure_timing``: only an
+        explicit ``enable`` turns the plane back on."""
+        self._active = False
+        self._user_disabled = True
+
+    def reset(self) -> None:
+        """Drop all recorded state (test teardown); enablement flags
+        and the ring capacity survive."""
+        with self._lock:
+            self._hist.clear()
+            self._counters.clear()
+            self._ring.clear()
+            self._appended = 0
+            self._thread_names.clear()
+            self._epoch_ns = time.perf_counter_ns()
+
+    def configure_from_env(self, environ=os.environ) -> None:
+        """Apply ``KSIM_TRACE*`` (import-time; tests re-invoke)."""
+        cap = environ.get("KSIM_TRACE_RING", "")
+        if cap:
+            try:
+                maxlen = max(int(cap), 16)
+            except ValueError:
+                maxlen = None
+            if maxlen is not None:
+                # Swap under the lock: a concurrent event() append must
+                # never land in an orphaned deque (that record would
+                # vanish and the eviction accounting would over-report).
+                with self._lock:
+                    self._ring = deque(self._ring, maxlen=maxlen)
+        self._jax_bridge = environ.get("KSIM_TRACE_JAX", "") == "1"
+        out = environ.get("KSIM_TRACE_OUT", "")
+        mode = environ.get("KSIM_TRACE", "")
+        if mode in ("0", "off"):
+            # The operator's opt-out beats everything, including a
+            # KSIM_TRACE_OUT a wrapper script may have exported — the
+            # same never-override-a-stated-choice contract as
+            # ensure_timing vs disable().
+            self.disable()
+        elif out:
+            self.enable(ring=True, out=out)
+        elif mode:
+            self.enable(ring=(mode != "timing"))
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def ensure_timing(self) -> None:
+        """Idempotent timing-only activation.  ScenarioRunner calls this
+        so per-phase wall-clock totals always exist (the histogram cost
+        is two clock reads + one locked increment per span, at
+        segment/pass granularity); ring storage stays off unless the
+        operator armed it, and an explicit ``disable()`` /
+        ``KSIM_TRACE=off`` wins — convenience activation never
+        overrides a stated opt-out."""
+        if not self._active and not self._user_disabled:
+            self.enable(ring=False)
+
+    # -- the hot path ----------------------------------------------------
+
+    def span(self, name: str, **args):
+        """Open a named span; a no-op singleton when the plane is off
+        (the single-check disabled path)."""
+        if not self._active:
+            return _NOOP
+        return _Span(self, name, args)
+
+    def event(self, name: str, **args) -> None:
+        """Record one instant event (counted always; stored when the
+        ring is on)."""
+        if not self._active:
+            return
+        now = time.perf_counter_ns()
+        tid = threading.get_ident()
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + 1
+            if self._ring_on:
+                self._note_thread(tid)
+                self._appended += 1
+                self._ring.append(
+                    {"ph": "i", "name": name, "t": now, "tid": tid, "args": args}
+                )
+
+    def _record_span(
+        self, name: str, t0: int, t1: int, depth: int, args: dict
+    ) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            hist = self._hist.get(name)
+            if hist is None:
+                hist = self._hist[name] = LatencyHistogram()
+            hist.observe((t1 - t0) / 1e9)
+            if self._ring_on:
+                self._note_thread(tid)
+                self._appended += 1
+                self._ring.append(
+                    {
+                        "ph": "X",
+                        "name": name,
+                        "t": t0,
+                        "d": t1 - t0,
+                        "tid": tid,
+                        "depth": depth,
+                        "args": args,
+                    }
+                )
+
+    def _note_thread(self, tid: int) -> None:
+        if tid not in self._thread_names:
+            t = threading.current_thread()
+            self._thread_names[tid] = t.name
+
+    # -- evidence --------------------------------------------------------
+
+    def phase_totals(self) -> dict[str, tuple[float, int]]:
+        """Per-span-name ``(total_seconds, count)`` — the runner diffs
+        two of these around a run for its per-phase breakdown."""
+        with self._lock:
+            return {n: (h.total, h.count) for n, h in self._hist.items()}
+
+    def snapshot(self) -> dict:
+        """Histograms + event counters + ring pressure, JSON-ready (the
+        ``trace`` section of /api/v1/metrics)."""
+        with self._lock:
+            return {
+                "enabled": self._active,
+                "ring": {
+                    "capacity": self._ring.maxlen,
+                    "size": len(self._ring),
+                    "appended": self._appended,
+                    "evicted": self._appended - len(self._ring),
+                },
+                "histograms": {n: h.snapshot() for n, h in sorted(self._hist.items())},
+                "events": dict(sorted(self._counters.items())),
+            }
+
+    def ring_records(self) -> list[dict]:
+        """A consistent copy of the ring (tests; the exporter)."""
+        with self._lock:
+            return list(self._ring)
+
+    # -- export ----------------------------------------------------------
+
+    def _chrome_events(self) -> Iterator[dict]:
+        with self._lock:
+            ring = list(self._ring)
+            names = dict(self._thread_names)
+            epoch = self._epoch_ns
+        pid = os.getpid()
+        for tid, tname in names.items():
+            yield {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": tname},
+            }
+        for r in ring:
+            ev: dict[str, Any] = {
+                "name": r["name"],
+                "cat": r["name"].partition(".")[0],
+                "ph": r["ph"],
+                "ts": (r["t"] - epoch) / 1e3,  # µs
+                "pid": pid,
+                "tid": r["tid"],
+                "args": r["args"],
+            }
+            if r["ph"] == "X":
+                ev["dur"] = r["d"] / 1e3
+            else:
+                ev["s"] = "t"  # instant scoped to its thread
+            yield ev
+
+    def export_chrome(self, path: str | None = None) -> dict:
+        """Render the ring as a Chrome trace-event document (the JSON
+        object format, so Perfetto metadata can ride along); write it
+        to ``path`` when given.  Returns the document either way."""
+        doc = {
+            "traceEvents": list(self._chrome_events()),
+            "displayTimeUnit": "ms",
+            "otherData": {"producer": "ksim_tpu.obs", "pid": os.getpid()},
+        }
+        if path:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        return doc
+
+
+# ---------------------------------------------------------------------------
+# Stats providers (non-timing evidence merged into /api/v1/metrics)
+# ---------------------------------------------------------------------------
+
+_providers: dict[str, Callable[[], dict]] = {}
+_providers_lock = threading.Lock()
+
+#: Top-level sections of the merged /api/v1/metrics document that a
+#: provider must not shadow (the endpoint merges providers at the top
+#: level, so a collision would silently clobber a core section).
+RESERVED_PROVIDER_NAMES = frozenset({"counters", "timings", "trace", "faults"})
+
+
+def register_provider(name: str, fn: Callable[[], dict]) -> None:
+    """Register (or replace) a named evidence provider.  The metrics
+    endpoint snapshots every provider per GET — e.g. the CURRENT run's
+    ``ReplayDriver.stats()`` registers under ``"replay"`` (latest
+    driver wins; one driver exists per ScenarioRunner run)."""
+    if name in RESERVED_PROVIDER_NAMES:
+        raise ValueError(
+            f"provider name {name!r} shadows a core /api/v1/metrics section"
+        )
+    with _providers_lock:
+        _providers[name] = fn
+
+
+def provider_snapshots() -> dict[str, dict]:
+    """All providers' current snapshots; a provider that raises reports
+    its error instead of poisoning the metrics document."""
+    with _providers_lock:
+        items = list(_providers.items())
+    out: dict[str, dict] = {}
+    for name, fn in items:
+        try:
+            out[name] = fn()
+        except Exception as e:  # evidence endpoint must never 500
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+#: The process-global plane every span/event site checks.  ``KSIM_TRACE*``
+#: configures it at import so subprocess children (bench rungs, the make
+#: trace child) inherit tracing through the environment — the stdlib-only
+#: bench parent never has to import this module.
+TRACE = TracePlane()
+TRACE.configure_from_env()
+
+
+@atexit.register
+def _export_at_exit() -> None:
+    if TRACE.out_path and TRACE.active:
+        try:
+            TRACE.export_chrome(TRACE.out_path)
+        except OSError:
+            pass

@@ -14,6 +14,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Iterable, Sequence
 
+from ksim_tpu.obs import TRACE
 from ksim_tpu.scheduler.service import SchedulerService
 from ksim_tpu.state.cluster import ClusterStore
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
@@ -53,6 +54,13 @@ class ScenarioResult:
     unschedulable_attempts: int = 0
     wall_seconds: float = 0.0
     succeeded: bool = False  # a doneOperation step completed (KEP-140)
+    # Per-phase wall-clock split of wall_seconds, sourced from the trace
+    # plane (obs.SPAN_NAMES keys): device path = replay.lower /
+    # replay.dispatch / replay.reconcile; per-pass host path =
+    # runner.step (which NESTS its service.schedule span — the two are
+    # reported side by side, not additive).
+    phase_seconds: dict[str, float] = field(default_factory=dict)
+    phase_counts: dict[str, int] = field(default_factory=dict)
 
     @property
     def events_per_second(self) -> float:
@@ -209,6 +217,12 @@ class ScenarioRunner:
     def _run_step(self, step: int, batch: list[Operation], result: ScenarioResult) -> bool:
         """The per-pass step body: apply ops, flush, one scheduling pass.
         Returns the done flag."""
+        with TRACE.span("runner.step", step=step, ops=len(batch)):
+            return self._run_step_traced(step, batch, result)
+
+    def _run_step_traced(
+        self, step: int, batch: list[Operation], result: ScenarioResult
+    ) -> bool:
         done = self._apply_batch(batch)
         result.events_applied += len(batch)
         # The runner drives the store directly (no watch loop), so it
@@ -346,7 +360,11 @@ class ScenarioRunner:
         evictions: list[tuple[str, str]] = []
         step_nodes: list = []
         try:
-            with self.store.transaction():
+            with TRACE.span(
+                "replay.reconcile",
+                segment=driver._segment_seq,
+                steps=len(seg.steps),
+            ), self.store.transaction():
                 for batch, outcome in zip(batches, seg.steps):
                     FAULTS.check("replay.reconcile")
                     self._stage_device_step(batch, outcome, evictions)
@@ -383,6 +401,12 @@ class ScenarioRunner:
         supported K-step segments run as single device dispatches (see
         engine/replay.py); everything else takes this per-pass loop."""
         result = ScenarioResult()
+        # Per-phase wall-clock split rides on the trace plane's latency
+        # histograms; timing-only mode costs two clock reads per span at
+        # segment/pass granularity and never touches scheduling state
+        # (the behavior locks hold with it on — tests pin that).
+        TRACE.ensure_timing()
+        phase0 = TRACE.phase_totals()
         t0 = time.perf_counter()
         by_step: dict[int, list[Operation]] = {}
         for op in ops:
@@ -427,4 +451,11 @@ class ScenarioRunner:
                 result.succeeded = True
                 break
         result.wall_seconds = time.perf_counter() - t0
+        # The trace plane is process-global: diff its totals around this
+        # run so concurrent earlier runs don't bleed into the split.
+        for name, (total, count) in TRACE.phase_totals().items():
+            prev_total, prev_count = phase0.get(name, (0.0, 0))
+            if count > prev_count:
+                result.phase_seconds[name] = round(total - prev_total, 6)
+                result.phase_counts[name] = count - prev_count
         return result

@@ -50,6 +50,7 @@ from ksim_tpu.scheduler.permit import (
     go_duration_str,
 )
 from ksim_tpu.errors import NotFoundError
+from ksim_tpu.obs import TRACE
 from ksim_tpu.state.cluster import ClusterStore, WatchEvent
 from ksim_tpu.state.featurizer import FeaturizedSnapshot, Featurizer
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
@@ -414,7 +415,11 @@ class SchedulerService:
 
     def _schedule_pending_inner(self) -> dict[str, str | None]:
         with self._pass_lock:
-            return self._schedule_pending_locked()
+            # The span covers the pass body only (not the lock wait):
+            # queue-contention latency would otherwise masquerade as
+            # scheduling latency in the histogram.
+            with TRACE.span("service.schedule", pass_num=self._pass_count + 1):
+                return self._schedule_pending_locked()
 
     def _schedule_pending_locked(self) -> dict[str, str | None]:
         # Fault-plane site: an injected fault aborts the pass BEFORE any
@@ -558,6 +563,14 @@ class SchedulerService:
                 for rv in sorted(self._own_rvs, key=int)[:-limit]:
                     self._own_rvs.discard(rv)
         self._record_attempts(placements)
+        if TRACE.active:
+            TRACE.event(
+                "service.pass",
+                pass_num=self._pass_count,
+                attempts=len(placements),
+                scheduled=sum(1 for v in placements.values() if v is not None),
+                unschedulable=sum(1 for v in placements.values() if v is None),
+            )
         self.metrics.inc("scheduling_passes")
         self.metrics.inc("scheduling_attempts", len(placements))
         self.metrics.inc(

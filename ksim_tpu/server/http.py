@@ -20,7 +20,11 @@ simulator/server/server.go:44-54, handlers under server/handler/):
                                                 (server.go:88-93)
 
 Beyond the reference surface: /api/v1/resources/* CRUD (the role the
-KWOK apiserver plays for the reference UI), GET /api/v1/metrics, and the
+KWOK apiserver plays for the reference UI), GET /api/v1/metrics (the
+merged evidence document: scheduler counters + latency histograms +
+fault-plane counters + replay driver stats), GET /api/v1/trace (the
+trace plane's event ring as Chrome trace-event JSON — see
+docs/observability.md), and the
 Permit waiting-pod view/ops (GET /api/v1/waitingpods, POST
 /api/v1/waitingpods/<ns>/<name>/{allow,reject} — the framework handle's
 WaitingPod surface for external permit controllers).
@@ -36,6 +40,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from ksim_tpu.faults import FAULTS
+from ksim_tpu.obs import TRACE, provider_snapshots
 from ksim_tpu.server.di import DIContainer
 
 logger = logging.getLogger(__name__)
@@ -146,7 +152,13 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/api/v1/export":
             self._json(200, self.server.di.snapshot_service.snap())
         elif url.path == "/api/v1/metrics":
-            self._json(200, self.server.di.scheduler_service.metrics.snapshot())
+            self._json(200, self._merged_metrics())
+        elif url.path == "/api/v1/trace":
+            # The live event ring as Chrome trace-event JSON — load the
+            # response body straight into Perfetto (ui.perfetto.dev) or
+            # chrome://tracing.  Empty unless the trace plane's ring is
+            # on (KSIM_TRACE_OUT / KSIM_TRACE=1 / TRACE.enable()).
+            self._json(200, TRACE.export_chrome())
         elif url.path == "/api/v1/waitingpods":
             # Permit-parked pods (the framework handle's waiting-pod view).
             self._json(200, {"items": self.server.di.scheduler_service.get_waiting_pods()})
@@ -230,6 +242,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(404, {"message": "Not Found"})
 
     # -- handlers -----------------------------------------------------------
+
+    def _merged_metrics(self) -> dict:
+        """One GET = the whole degradation-evidence surface: the
+        scheduler's counters + latency histograms, the trace plane's
+        span histograms/event counters, every fault-plane site's
+        calls/fired counters, and the registered evidence providers
+        (the live run's ``ReplayDriver.stats()`` under ``"replay"``).
+        Previously only ``Metrics.snapshot()`` was served and the rest
+        was visible only in bench JSON."""
+        doc = self.server.di.scheduler_service.metrics.snapshot()
+        doc["trace"] = TRACE.snapshot()
+        doc["faults"] = FAULTS.snapshot()
+        doc.update(provider_snapshots())
+        return doc
 
     def _resource(self, method: str, path: str, query: dict | None = None) -> None:
         """Per-resource CRUD.  The reference UI talks straight to the

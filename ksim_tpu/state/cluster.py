@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator
 
 from ksim_tpu.errors import ConflictError, ExpiredError, NotFoundError
+from ksim_tpu.obs import TRACE
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
 
 # Kind names follow the reference's watcher kinds
@@ -152,11 +153,20 @@ class ClusterStore:
             self._txn = txn
             try:
                 yield self
-            except BaseException:
+            except BaseException as e:
                 self._txn = None
                 self._rollback(txn)
+                TRACE.event(
+                    "store.txn_rollback",
+                    writes=len(txn.pre),
+                    events=len(txn.events),
+                    error=type(e).__name__,
+                )
                 raise
             self._txn = None
+            TRACE.event(
+                "store.txn_commit", writes=len(txn.pre), events=len(txn.events)
+            )
             for ev in txn.events:
                 self._deliver(ev)
 

@@ -48,6 +48,7 @@ from typing import Any
 
 from ksim_tpu.errors import InvalidConfigError, SimulatorError
 from ksim_tpu.faults import FAULTS
+from ksim_tpu.obs import TRACE
 from ksim_tpu.state.cluster import ADDED, DELETED, KINDS, MODIFIED, WatchEvent
 from ksim_tpu.state.resources import JSON, labels_of, name_of, namespace_of
 from ksim_tpu.state.selectors import match_label_selector
@@ -370,25 +371,36 @@ class KubeApiSource:
         # Same fault-plane site as _request: "kubeapi.request" covers
         # EVERY apiserver HTTP call, list/watch GETs included, so a
         # chaos run exercises the relist/410-resume recovery paths too.
-        FAULTS.check("kubeapi.request")
-        url = self._server + path
-        if query:
-            url += "?" + urllib.parse.urlencode(query)
-        self._maybe_refresh_auth()
-        for attempt in (0, 1):
-            req = urllib.request.Request(url, headers=self._headers)
-            try:
-                return urllib.request.urlopen(req, timeout=timeout, context=self._ssl)
-            except urllib.error.HTTPError as e:
-                if e.code == 401 and attempt == 0 and self._headers_refresh is not None:
-                    # Token died before its advertised expiry: one forced
-                    # re-exec, then the retry below.
-                    self._maybe_refresh_auth(force=True)
-                    continue
-                body = e.read(4096).decode(errors="replace")
-                raise SimulatorError(f"GET {path}: HTTP {e.code}: {body[:200]}") from None
-            except (urllib.error.URLError, OSError, ssl.SSLError) as e:
-                raise SimulatorError(f"GET {path}: {e}") from None
+        # The span covers connection setup only — for a watch stream the
+        # body is consumed long after this returns.
+        with TRACE.span("kubeapi.request", method="GET", path=path, stream=True):
+            FAULTS.check("kubeapi.request")
+            url = self._server + path
+            if query:
+                url += "?" + urllib.parse.urlencode(query)
+            self._maybe_refresh_auth()
+            for attempt in (0, 1):
+                req = urllib.request.Request(url, headers=self._headers)
+                try:
+                    return urllib.request.urlopen(
+                        req, timeout=timeout, context=self._ssl
+                    )
+                except urllib.error.HTTPError as e:
+                    if (
+                        e.code == 401
+                        and attempt == 0
+                        and self._headers_refresh is not None
+                    ):
+                        # Token died before its advertised expiry: one
+                        # forced re-exec, then the retry below.
+                        self._maybe_refresh_auth(force=True)
+                        continue
+                    body = e.read(4096).decode(errors="replace")
+                    raise SimulatorError(
+                        f"GET {path}: HTTP {e.code}: {body[:200]}"
+                    ) from None
+                except (urllib.error.URLError, OSError, ssl.SSLError) as e:
+                    raise SimulatorError(f"GET {path}: {e}") from None
 
     def _request(
         self,
@@ -402,33 +414,42 @@ class KubeApiSource:
         """One non-streaming request with the same auth-refresh/401-retry
         protocol as ``_open``.  Raises KubeApiError carrying the HTTP
         status so callers can branch on 404/409."""
-        # Fault-plane site: injected before the wire so chaos runs can
-        # fail/hang any apiserver request without a cooperating server.
-        FAULTS.check("kubeapi.request")
-        url = self._server + path
-        data = None if body is None else json.dumps(body).encode()
-        self._maybe_refresh_auth()
-        for attempt in (0, 1):
-            headers = dict(self._headers)
-            if data is not None:
-                headers["Content-Type"] = content_type
-            req = urllib.request.Request(url, data=data, headers=headers, method=method)
-            try:
-                with urllib.request.urlopen(
-                    req, timeout=timeout or self._timeout, context=self._ssl
-                ) as resp:
-                    raw = resp.read()
-                    return json.loads(raw) if raw else {}
-            except urllib.error.HTTPError as e:
-                if e.code == 401 and attempt == 0 and self._headers_refresh is not None:
-                    self._maybe_refresh_auth(force=True)
-                    continue
-                detail = e.read(4096).decode(errors="replace")
-                raise KubeApiError(
-                    f"{method} {path}: HTTP {e.code}: {detail[:200]}", code=e.code
-                ) from None
-            except (urllib.error.URLError, OSError, ssl.SSLError) as e:
-                raise KubeApiError(f"{method} {path}: {e}") from None
+        with TRACE.span("kubeapi.request", method=method, path=path):
+            # Fault-plane site: injected before the wire so chaos runs
+            # can fail/hang any apiserver request without a cooperating
+            # server.
+            FAULTS.check("kubeapi.request")
+            url = self._server + path
+            data = None if body is None else json.dumps(body).encode()
+            self._maybe_refresh_auth()
+            for attempt in (0, 1):
+                headers = dict(self._headers)
+                if data is not None:
+                    headers["Content-Type"] = content_type
+                req = urllib.request.Request(
+                    url, data=data, headers=headers, method=method
+                )
+                try:
+                    with urllib.request.urlopen(
+                        req, timeout=timeout or self._timeout, context=self._ssl
+                    ) as resp:
+                        raw = resp.read()
+                        return json.loads(raw) if raw else {}
+                except urllib.error.HTTPError as e:
+                    if (
+                        e.code == 401
+                        and attempt == 0
+                        and self._headers_refresh is not None
+                    ):
+                        self._maybe_refresh_auth(force=True)
+                        continue
+                    detail = e.read(4096).decode(errors="replace")
+                    raise KubeApiError(
+                        f"{method} {path}: HTTP {e.code}: {detail[:200]}",
+                        code=e.code,
+                    ) from None
+                except (urllib.error.URLError, OSError, ssl.SSLError) as e:
+                    raise KubeApiError(f"{method} {path}: {e}") from None
 
     # -- write verbs (live scheduling write-back) ----------------------------
     #

@@ -41,6 +41,7 @@ import threading
 import time
 
 from ksim_tpu.faults import FAULTS
+from ksim_tpu.obs import TRACE
 from ksim_tpu.state.cluster import ADDED, DELETED, MODIFIED, ClusterStore
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
 from ksim_tpu.syncer.kubeapi import KubeApiError, KubeApiSource
@@ -269,6 +270,10 @@ class LiveWriteBack:
                 )
 
     def _handle(self, etype: str, pod: JSON) -> None:
+        with TRACE.span("writeback.push", etype=etype):
+            self._handle_traced(etype, pod)
+
+    def _handle_traced(self, etype: str, pod: JSON) -> None:
         # Fault-plane site: an injected failure here exercises the
         # transient-retry policy above exactly like an apiserver blip.
         FAULTS.check("writeback.push")

@@ -7,6 +7,8 @@ import threading
 import time
 from typing import Callable, TypeVar
 
+from ksim_tpu.obs import LatencyHistogram
+
 T = TypeVar("T")
 
 
@@ -109,16 +111,21 @@ def retry_with_exponential_backoff(
 
 
 class Metrics:
-    """Thread-safe counters + cumulative timers.
+    """Thread-safe counters + latency histograms.
 
     The reference's observability is the upstream scheduler's Prometheus
     metrics plus klog (SURVEY section 5); this is the in-process
-    analogue, exposed as JSON at /api/v1/metrics."""
+    analogue, exposed as JSON at /api/v1/metrics.  Timers record into
+    fixed-bucket log-spaced histograms (ksim_tpu.obs.LatencyHistogram)
+    — the former mean-only [total, count] pairs hid multimodal
+    latencies (a 5 s cold XLA compile averaged into thousands of 10 ms
+    warm passes reads as "15 ms mean"); the snapshot keeps the legacy
+    total/count/mean keys and adds buckets + estimated quantiles."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, int] = {}
-        self._timers: dict[str, list[float]] = {}  # [total_s, count]
+        self._timers: dict[str, LatencyHistogram] = {}
 
     def inc(self, name: str, value: int = 1) -> None:
         with self._lock:
@@ -126,9 +133,10 @@ class Metrics:
 
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
-            entry = self._timers.setdefault(name, [0.0, 0])
-            entry[0] += seconds
-            entry[1] += 1
+            hist = self._timers.get(name)
+            if hist is None:
+                hist = self._timers[name] = LatencyHistogram()
+            hist.observe(seconds)
 
     class _Timer:
         def __init__(self, metrics: "Metrics", name: str) -> None:
@@ -150,11 +158,6 @@ class Metrics:
             return {
                 "counters": dict(self._counters),
                 "timings": {
-                    name: {
-                        "total_seconds": round(total, 6),
-                        "count": count,
-                        "mean_seconds": round(total / count, 6) if count else 0.0,
-                    }
-                    for name, (total, count) in self._timers.items()
+                    name: hist.snapshot() for name, hist in self._timers.items()
                 },
             }

@@ -57,6 +57,50 @@ def test_churn_lock_6k_seed0(x64):
     assert (scheduled, unschedulable) == (LOCK_SCHEDULED, LOCK_UNSCHEDULABLE)
 
 
+def test_churn_lock_6k_holds_with_tracing_enabled(tmp_path):
+    """Observability must be zero-perturbation: the locked prefix's
+    counts are byte-identical with the trace plane FULLY enabled
+    (``KSIM_TRACE_OUT`` set: histograms + event ring + file export),
+    and the emitted Chrome-trace JSON validates with the per-pass
+    phase spans on it."""
+    import json
+    import os
+
+    from ksim_tpu.obs import TRACE
+
+    out = tmp_path / "trace.json"
+    prev_state = (TRACE._active, TRACE._ring_on, TRACE._user_disabled)
+    prev_x64 = jax.config.jax_enable_x64
+    os.environ["KSIM_TRACE_OUT"] = str(out)
+    try:
+        TRACE.configure_from_env()
+        jax.config.update("jax_enable_x64", False)
+        scheduled, unschedulable, events = _run_locked_churn()
+        assert events == LOCK_EVENTS
+        assert (scheduled, unschedulable) == (LOCK_SCHEDULED, LOCK_UNSCHEDULABLE)
+        TRACE.export_chrome(str(out))
+        doc = json.loads(out.read_text())
+        names = {e["name"] for e in doc["traceEvents"]}
+        # The per-pass path's phase spans + pass-outcome events.
+        assert {"runner.step", "service.schedule", "service.pass"} <= names
+        n_sched_spans = sum(
+            1
+            for e in doc["traceEvents"]
+            if e["name"] == "service.schedule" and e.get("ph") == "X"
+        )
+        assert n_sched_spans >= 1
+    finally:
+        jax.config.update("jax_enable_x64", prev_x64)
+        os.environ.pop("KSIM_TRACE_OUT", None)
+        TRACE.out_path = None
+        # Drop the 6k run's ring contents (up to 65536 record dicts)
+        # and restore the exact pre-test flags — NOT via disable(),
+        # whose sticky opt-out would leave ensure_timing inert for
+        # every later test in the process.
+        TRACE.reset()
+        TRACE._active, TRACE._ring_on, TRACE._user_disabled = prev_state
+
+
 # The full 50k flagship locks (repo CLAUDE.md).
 LOCK_50K_SCHEDULED = 52_781
 LOCK_50K_UNSCHEDULABLE = 42_829

@@ -1,0 +1,409 @@
+"""Trace plane (ksim_tpu/obs.py): spans, histograms, ring, export,
+and the registry-sync guards that keep the fault-site / fallback-reason
+taxonomies and the trace event names from drifting apart.
+
+The plane is process-global in production; these tests construct
+private ``TracePlane`` instances wherever possible and restore the
+global one when they must touch it."""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import threading
+
+import pytest
+
+from ksim_tpu import obs
+from ksim_tpu.obs import (
+    EVENT_NAMES,
+    SPAN_NAMES,
+    LatencyHistogram,
+    TracePlane,
+)
+
+
+@pytest.fixture
+def plane() -> TracePlane:
+    p = TracePlane()
+    p.enable(ring=True)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_depth_and_order(plane):
+    with plane.span("runner.step", step=1):
+        with plane.span("service.schedule", pass_num=1):
+            pass
+        with plane.span("service.schedule", pass_num=2):
+            pass
+    recs = plane.ring_records()
+    # Spans record at EXIT: inner spans land before their parent.
+    assert [r["name"] for r in recs] == [
+        "service.schedule",
+        "service.schedule",
+        "runner.step",
+    ]
+    assert [r["depth"] for r in recs] == [1, 1, 0]
+    outer = recs[2]
+    for inner in recs[:2]:
+        # Interval containment (what makes Chrome/Perfetto nest them).
+        assert outer["t"] <= inner["t"]
+        assert inner["t"] + inner["d"] <= outer["t"] + outer["d"]
+    assert outer["args"] == {"step": 1}
+
+
+def test_span_records_error_and_propagates(plane):
+    with pytest.raises(ValueError):
+        with plane.span("replay.lower", segment=1):
+            raise ValueError("boom")
+    (rec,) = plane.ring_records()
+    assert rec["args"]["error"] == "ValueError"
+    # Histogram observed the failed span too (time was still spent).
+    assert plane.phase_totals()["replay.lower"][1] == 1
+
+
+def test_span_histograms_accumulate(plane):
+    for _ in range(5):
+        with plane.span("kubeapi.request"):
+            pass
+    total, count = plane.phase_totals()["kubeapi.request"]
+    assert count == 5
+    assert total > 0.0
+    snap = plane.snapshot()["histograms"]["kubeapi.request"]
+    assert snap["count"] == 5
+    assert sum(c for _, c in snap["buckets"]) == 5
+
+
+# ---------------------------------------------------------------------------
+# Histogram buckets
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_edges_are_fixed_log_spaced():
+    edges = LatencyHistogram.EDGES
+    assert len(edges) == 33
+    assert edges[0] == pytest.approx(1e-6)
+    assert edges[-1] == pytest.approx(100.0)
+    # 4 per decade: every 4th edge is a decade step.
+    assert edges[4] == pytest.approx(1e-5)
+    assert edges[32] == pytest.approx(1e-6 * 10**8)
+
+
+def test_histogram_bucket_edge_assignment():
+    h = LatencyHistogram()
+    # An observation exactly ON an edge belongs to the bucket it is the
+    # upper edge of (le semantics).
+    h.observe(1e-6)
+    assert h.counts[0] == 1
+    # Just above the first edge -> second bucket.
+    h.observe(1.0000001e-6)
+    assert h.counts[1] == 1
+    # Overflow bucket catches everything past 100 s.
+    h.observe(1e9)
+    assert h.counts[-1] == 1
+    # Sub-first-edge lands in the first bucket.
+    h.observe(1e-9)
+    assert h.counts[0] == 2
+    assert h.count == 4
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    # The overflow bucket serializes with a null upper edge.
+    assert [edge for edge, _ in snap["buckets"]][-1] is None
+
+
+def test_histogram_quantiles_clamped_to_observed_max():
+    h = LatencyHistogram()
+    h.observe(0.01)
+    h.observe(5.0)
+    # The 5.0 bucket's upper edge is ~5.62; estimates must not exceed
+    # anything actually observed.
+    assert h.quantile(0.99) == pytest.approx(5.0)
+    assert h.quantile(0.5) == pytest.approx(0.01)
+    assert h.snapshot()["max_seconds"] == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# Ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_eviction_under_concurrent_writers():
+    p = TracePlane()
+    p.configure_from_env({"KSIM_TRACE_RING": "64", "KSIM_TRACE": "1"})
+    n_threads, per_thread = 8, 200
+
+    def hammer(i: int) -> None:
+        for j in range(per_thread):
+            p.event("replay.fallback", reason=f"t{i}", n=j)
+            with p.span("runner.step", thread=i):
+                pass
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = p.snapshot()
+    assert snap["ring"]["capacity"] == 64
+    assert snap["ring"]["size"] == 64
+    appended = n_threads * per_thread * 2
+    assert snap["ring"]["appended"] == appended
+    assert snap["ring"]["evicted"] == appended - 64
+    # Nothing was lost from the aggregate layers despite eviction.
+    assert snap["events"]["replay.fallback"] == n_threads * per_thread
+    assert snap["histograms"]["runner.step"]["count"] == n_threads * per_thread
+    # Every surviving record is well-formed.
+    for r in p.ring_records():
+        assert r["ph"] in ("X", "i")
+        assert isinstance(r["t"], int) and isinstance(r["args"], dict)
+
+
+# ---------------------------------------------------------------------------
+# Disabled path
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_plane_is_noop():
+    p = TracePlane()
+    assert not p.active
+    s1 = p.span("runner.step", step=1)
+    s2 = p.span("service.schedule")
+    # The disabled path hands out ONE shared no-op object — no
+    # allocation, no clock read.
+    assert s1 is s2 is obs._NOOP
+    with s1:
+        pass
+    p.event("fault.fired", site="replay.dispatch")
+    assert p.ring_records() == []
+    assert p.phase_totals() == {}
+    assert p.snapshot()["events"] == {}
+
+
+def test_disable_reenable_cycle(plane):
+    with plane.span("runner.step"):
+        pass
+    plane.disable()
+    with plane.span("runner.step"):
+        pass
+    assert plane.phase_totals()["runner.step"][1] == 1
+    plane.enable(ring=True)
+    with plane.span("runner.step"):
+        pass
+    assert plane.phase_totals()["runner.step"][1] == 2
+
+
+def test_ensure_timing_keeps_ring_off():
+    p = TracePlane()
+    p.ensure_timing()
+    assert p.active
+    with p.span("runner.step"):
+        pass
+    assert p.phase_totals()["runner.step"][1] == 1
+    assert p.ring_records() == []  # timing-only: histograms, no ring
+
+
+def test_ensure_timing_respects_explicit_disable():
+    """Convenience activation (ScenarioRunner.run) must never override
+    an operator's stated opt-out — disable()/KSIM_TRACE=off is sticky
+    against it; only an explicit enable() turns the plane back on."""
+    p = TracePlane()
+    p.disable()
+    p.ensure_timing()
+    assert not p.active
+    p2 = TracePlane()
+    p2.configure_from_env({"KSIM_TRACE": "off"})
+    p2.ensure_timing()
+    assert not p2.active
+    p2.enable(ring=False)
+    assert p2.active
+
+
+# ---------------------------------------------------------------------------
+# Export
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_export_roundtrip(plane, tmp_path):
+    with plane.span("replay.lower", segment=1, steps=16):
+        with plane.span("replay.dispatch", segment=1, steps=16):
+            pass
+    plane.event("store.txn_commit", writes=3, events=3)
+    out = tmp_path / "trace.json"
+    doc = plane.export_chrome(str(out))
+    on_disk = json.loads(out.read_text())
+    assert on_disk == doc
+    evs = doc["traceEvents"]
+    phases = {e["ph"] for e in evs}
+    assert phases == {"M", "X", "i"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"replay.lower", "replay.dispatch"}
+    for e in xs:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert e["cat"] == "replay"
+    (instant,) = [e for e in evs if e["ph"] == "i"]
+    assert instant["s"] == "t" and instant["args"]["writes"] == 3
+    # Thread metadata names the recording thread.
+    (meta,) = [e for e in evs if e["ph"] == "M"]
+    assert meta["name"] == "thread_name"
+
+
+def test_env_configuration(tmp_path):
+    p = TracePlane()
+    out = tmp_path / "t.json"
+    p.configure_from_env({"KSIM_TRACE_OUT": str(out)})
+    assert p.active and p.out_path == str(out)
+    p2 = TracePlane()
+    p2.configure_from_env({"KSIM_TRACE": "timing"})
+    assert p2.active
+    with p2.span("runner.step"):
+        pass
+    assert p2.ring_records() == []
+    p3 = TracePlane()
+    p3.configure_from_env({"KSIM_TRACE": "off"})
+    assert not p3.active
+    # The operator's opt-out beats a wrapper-exported KSIM_TRACE_OUT.
+    p4 = TracePlane()
+    p4.configure_from_env({"KSIM_TRACE": "off", "KSIM_TRACE_OUT": "/tmp/x.json"})
+    assert not p4.active and p4.out_path is None
+
+
+# ---------------------------------------------------------------------------
+# Registry sync: fault sites <-> spans, fallback reasons <-> events
+# ---------------------------------------------------------------------------
+
+
+def _repo_root():
+    import os
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fault_sites_match_source_and_span_taxonomy():
+    """Every FAULTS.check("...") literal in the codebase is a declared
+    site, every declared site is wired somewhere, and every site has a
+    same-named span enclosing it on the timeline — the taxonomies
+    cannot drift apart silently."""
+    import os
+
+    from ksim_tpu.faults import SITES
+
+    root = os.path.join(_repo_root(), "ksim_tpu")
+    wired: set[str] = set()
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            # faults.py DECLARES the sites (and its docstring shows the
+            # check() idiom); the wiring we're auditing lives elsewhere.
+            if not fn.endswith(".py") or fn == "faults.py":
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                wired.update(re.findall(r'FAULTS\.check\(\s*"([^"]+)"', f.read()))
+    assert wired == set(SITES)
+    assert set(SITES) <= set(SPAN_NAMES)
+    assert "fault.fired" in EVENT_NAMES
+
+
+def test_fallback_reasons_match_replay_source():
+    """Every statically spelled fallback reason in engine/replay.py is
+    registered in FALLBACK_REASONS (so it reaches the trace taxonomy),
+    and the registry carries no dead entries."""
+    import os
+
+    from ksim_tpu.engine.replay import (
+        FALLBACK_REASON_PREFIXES,
+        FALLBACK_REASONS,
+    )
+
+    path = os.path.join(_repo_root(), "ksim_tpu", "engine", "replay.py")
+    with open(path) as f:
+        tree = ast.parse(f.read())
+
+    call_reasons: set[str] = set()
+    fstring_prefixes: set[str] = set()
+    return_strs: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = (
+                node.func.id
+                if isinstance(node.func, ast.Name)
+                else getattr(node.func, "attr", "")
+            )
+            if fname in ("_Unsupported", "_reject") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    call_reasons.add(arg.value)
+                elif isinstance(arg, ast.JoinedStr) and isinstance(
+                    arg.values[0], ast.Constant
+                ):
+                    fstring_prefixes.add(arg.values[0].value)
+        elif (
+            isinstance(node, ast.Return)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            return_strs.add(node.value.value)
+
+    unregistered = call_reasons - FALLBACK_REASONS
+    assert not unregistered, (
+        f"fallback reasons missing from FALLBACK_REASONS: {sorted(unregistered)}"
+    )
+    # The post-dispatch validation discards return their reason as a
+    # string (featurize_prediction / preemption_overflow): registry
+    # entries must exist SOMEWHERE in the source.
+    dead = FALLBACK_REASONS - call_reasons - return_strs
+    assert not dead, f"FALLBACK_REASONS entries not found in source: {sorted(dead)}"
+    for prefix in fstring_prefixes:
+        assert any(prefix.startswith(p) for p in FALLBACK_REASON_PREFIXES), (
+            f"dynamic fallback reason family {prefix!r} not in "
+            f"FALLBACK_REASON_PREFIXES"
+        )
+    assert "replay.fallback" in EVENT_NAMES
+
+
+def test_fault_fire_emits_trace_event():
+    """The fault plane lands fault.fired on the global plane; exercised
+    through a private enable/restore cycle of the global TRACE."""
+    from ksim_tpu.faults import FaultPlane, InjectedFault
+    from ksim_tpu.obs import TRACE
+
+    prev_state = (TRACE._active, TRACE._ring_on, TRACE._user_disabled)
+    TRACE.enable(ring=True)
+    try:
+        before = TRACE.snapshot()["events"].get("fault.fired", 0)
+        plane = FaultPlane()
+        plane.arm("replay.dispatch", "call:1")
+        with pytest.raises(InjectedFault):
+            plane.check("replay.dispatch")
+        events = [
+            r for r in TRACE.ring_records() if r["name"] == "fault.fired"
+        ]
+        assert events and events[-1]["args"]["site"] == "replay.dispatch"
+        assert TRACE.snapshot()["events"]["fault.fired"] == before + 1
+    finally:
+        # Exact flag restore (not disable(): its sticky opt-out would
+        # leak into later tests' ensure_timing).
+        TRACE._active, TRACE._ring_on, TRACE._user_disabled = prev_state
+
+
+def test_provider_registry_rejects_reserved_names():
+    for name in obs.RESERVED_PROVIDER_NAMES:
+        with pytest.raises(ValueError):
+            obs.register_provider(name, dict)
+
+
+def test_provider_registry():
+    obs.register_provider("_test_ok", lambda: {"x": 1})
+    obs.register_provider("_test_boom", lambda: 1 / 0)
+    try:
+        snaps = obs.provider_snapshots()
+        assert snaps["_test_ok"] == {"x": 1}
+        assert "ZeroDivisionError" in snaps["_test_boom"]["error"]
+    finally:
+        with obs._providers_lock:
+            obs._providers.pop("_test_ok", None)
+            obs._providers.pop("_test_boom", None)

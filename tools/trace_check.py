@@ -1,0 +1,209 @@
+"""`make trace`: end-to-end trace-plane validation (docs/observability.md).
+
+Replays the locked 6k churn prefix (seed 0, 2000 nodes — repo CLAUDE.md)
+through the DEVICE-resident path with tracing fully enabled
+(``KSIM_TRACE_OUT``) in the sanitized CPU environment (runnable under
+any hardware condition, like ``make faults``), then validates:
+
+- the behavior locks hold byte-identically with tracing on (2524/471);
+- the emitted Chrome-trace JSON parses and contains a
+  lower/dispatch/reconcile span for EVERY on-device segment plus a
+  ``store.txn_commit`` event per committed segment;
+- with a ``KSIM_FAULTS`` schedule armed (second, smaller run), the
+  timeline carries the ``fault.fired`` and ``replay.fallback`` events
+  the chaos evidence story depends on.
+
+The parent process is stdlib-only (the bench.py crash-containment
+pattern: jax backend init can wedge on a dead chip, so anything that
+must complete runs jax only in subprocesses)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD_TIMEOUT_S = 840
+
+# The locked 6k prefix (repo CLAUDE.md; tests/test_behavior_locks.py).
+LOCK = (2524, 471)
+
+
+# ---------------------------------------------------------------------------
+# Child payload (imports jax; only ever runs in a subprocess)
+# ---------------------------------------------------------------------------
+
+
+def _child(events: int, nodes: int, out_path: str) -> None:
+    # Scripts put THEIR directory (tools/) on sys.path, not the repo.
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    import jax
+
+    from ksim_tpu.obs import TRACE
+    from ksim_tpu.scenario import ScenarioRunner, churn_scenario
+    from ksim_tpu.util import enable_compilation_cache, raise_map_count_limit
+
+    enable_compilation_cache()
+    raise_map_count_limit()
+    jax.config.update("jax_enable_x64", False)
+    runner = ScenarioRunner(
+        max_pods_per_pass=1024,
+        pod_bucket_min=128,
+        device_replay=True,
+        preemption=True,
+    )
+    res = runner.run(
+        churn_scenario(0, n_nodes=nodes, n_events=events, ops_per_step=100)
+    )
+    drv = runner.replay_driver
+    # Flush the trace explicitly (the atexit hook would too; an explicit
+    # write means the result JSON below can promise the file exists).
+    if TRACE.out_path:
+        TRACE.export_chrome(TRACE.out_path)
+    with open(out_path, "w") as f:
+        json.dump(
+            {
+                "scheduled": res.pods_scheduled,
+                "unschedulable": res.unschedulable_attempts,
+                "steps": len(res.steps),
+                "phases": res.phase_seconds,
+                **drv.stats(),
+            },
+            f,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Parent validation (stdlib only)
+# ---------------------------------------------------------------------------
+
+
+def _sanitized_env() -> dict:
+    sys.path.insert(0, _REPO)
+    try:
+        from tests.helpers import sanitized_cpu_env
+    finally:
+        sys.path.pop(0)
+    return sanitized_cpu_env()
+
+
+def _run_child(events: int, nodes: int, env: dict, tmp: str, tag: str) -> tuple[dict, dict]:
+    """One traced child replay; returns (result record, trace doc)."""
+    trace_path = os.path.join(tmp, f"trace_{tag}.json")
+    result_path = os.path.join(tmp, f"result_{tag}.json")
+    env = dict(env, KSIM_TRACE_OUT=trace_path)
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--child", "--events", str(events), "--nodes", str(nodes),
+        "--out", result_path,
+    ]
+    proc = subprocess.run(cmd, cwd=_REPO, env=env, timeout=CHILD_TIMEOUT_S)
+    if proc.returncode != 0:
+        raise SystemExit(f"trace-check child ({tag}) exited rc={proc.returncode}")
+    with open(result_path) as f:
+        result = json.load(f)
+    with open(trace_path) as f:
+        trace = json.load(f)  # must PARSE — that is half the check
+    return result, trace
+
+
+def _span_counts(trace: dict) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for ev in trace.get("traceEvents", ()):
+        if ev.get("ph") in ("X", "i"):
+            out[ev["name"]] = out.get(ev["name"], 0) + 1
+    return out
+
+
+def _fail(msg: str) -> None:
+    raise SystemExit(f"trace-check FAILED: {msg}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--events", type=int, default=6000)
+    ap.add_argument("--nodes", type=int, default=2000)
+    ap.add_argument("--out", type=str, default="")
+    args = ap.parse_args()
+    if args.child:
+        _child(args.events, args.nodes, args.out)
+        return
+
+    env = _sanitized_env()
+    with tempfile.TemporaryDirectory(prefix="ksim_trace_check_") as tmp:
+        # -- run 1: the locked 6k prefix, fully traced, no faults ------
+        result, trace = _run_child(args.events, args.nodes, env, tmp, "clean")
+        counts = (result["scheduled"], result["unschedulable"])
+        if args.events == 6000 and args.nodes == 2000 and counts != LOCK:
+            _fail(f"locked counts diverged under tracing: {counts} != {LOCK}")
+        names = _span_counts(trace)
+        segments = result["device_round_trips"]
+        if segments < 1:
+            _fail(f"no device segments ran (stats: {result})")
+        for span in ("replay.lower", "replay.dispatch"):
+            if names.get(span, 0) < segments:
+                _fail(
+                    f"{span}: {names.get(span, 0)} spans for {segments} "
+                    f"dispatched segments"
+                )
+        # device_round_trips counts HEALTHY dispatches only (errored
+        # ones never increment it); of those, post-dispatch validation
+        # discards return before any reconcile, and a reconcile that
+        # rolled back has a span but no commit.
+        unsupported = result.get("unsupported", {})
+        discards = unsupported.get("featurize_prediction", 0) + unsupported.get(
+            "preemption_overflow", 0
+        )
+        reconciled = segments - discards
+        committed = reconciled - unsupported.get("reconcile_fault", 0)
+        if names.get("replay.reconcile", 0) < reconciled:
+            _fail(
+                f"replay.reconcile: {names.get('replay.reconcile', 0)} spans "
+                f"for {reconciled} reconciled segments"
+            )
+        if names.get("store.txn_commit", 0) < committed:
+            _fail(
+                f"store.txn_commit: {names.get('store.txn_commit', 0)} events "
+                f"for {committed} committed segments"
+            )
+        if result["fallback_steps"] and not names.get("runner.step"):
+            _fail("fallback steps ran but no runner.step spans recorded")
+        print(
+            f"trace-check: clean run OK — counts {counts}, "
+            f"{segments} segments, spans {({k: names[k] for k in sorted(names)})}"
+        )
+
+        # -- run 2: a KSIM_FAULTS schedule armed -----------------------
+        # One injected dispatch failure over a small prefix: the
+        # timeline must show the fault firing AND the resulting
+        # degradation (device_error fallback -> per-pass step).
+        armed_env = dict(env, KSIM_FAULTS="replay.dispatch=call:1")
+        result2, trace2 = _run_child(1000, 500, armed_env, tmp, "armed")
+        names2 = _span_counts(trace2)
+        if not names2.get("fault.fired"):
+            _fail("armed run recorded no fault.fired event")
+        if not names2.get("replay.fallback"):
+            _fail("armed run recorded no replay.fallback event")
+        reasons = {
+            ev["args"].get("reason")
+            for ev in trace2["traceEvents"]
+            if ev.get("name") == "replay.fallback"
+        }
+        if "device_error" not in reasons:
+            _fail(f"armed run's fallback reasons lack device_error: {reasons}")
+        print(
+            f"trace-check: armed run OK — fault.fired x{names2['fault.fired']}, "
+            f"fallback reasons {sorted(r for r in reasons if r)}"
+        )
+    print("trace-check: PASS")
+
+
+if __name__ == "__main__":
+    main()
