@@ -9,7 +9,10 @@ test:
 
 # One-command behavior-lock verification: the FULL 50k churn stream
 # through both the per-pass and device-resident paths, asserting the
-# 52781/42829 counts stepwise (repo CLAUDE.md).  ~10 min on CPU.
+# 52781/42829 counts stepwise (repo CLAUDE.md) — with the incremental
+# lower-cache + double-buffered prelower fully ON (round 10), plus the
+# counter-based O(delta) guard (steady-state featurize rows scale with
+# window events, not universe size).  ~10-20 min on CPU.
 lock-check:
 	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_device_vs_per_pass -q -rs -m slow
 
@@ -22,6 +25,7 @@ faults:
 	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
 	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
 	'tests/test_replay_faults.py', 'tests/test_fault_injection.py', \
+	'tests/test_replay_cache.py', \
 	'-q', '-m', ''], env=sanitized_cpu_env()))"
 
 # Trace-plane validation (docs/observability.md): the locked 6k prefix

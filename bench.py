@@ -308,6 +308,11 @@ def child_churn(
         # pays one per SEGMENT plus one per fallback step.  The fallback
         # histogram (SegmentLowerer reject reasons) and the on-device
         # step fraction track tensor-vocabulary coverage across rounds.
+        # Since round 10 drv.stats() also carries the incremental-
+        # lowering evidence next to the phases split above: lower_cache
+        # hits/misses/invalidations, featurize_calls (fresh per-pod row
+        # builds — the O(delta) counter `make lock-check` guards),
+        # prelower pipeline counters, and dev_const transfer reuse.
         drv = runner.replay_driver
         round_trips = drv.device_round_trips + drv.fallback_steps
         # drv.stats() carries the dispatch counters PLUS the round-8

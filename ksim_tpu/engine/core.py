@@ -253,6 +253,20 @@ _UNPACK_CACHE: dict[tuple, Any] = {}
 _OUTPACK_CACHE: dict[tuple, Any] = {}
 
 
+def _multi_device(a) -> bool:
+    """True for a jax.Array laid out over more than one device.  The
+    jitted byte-pack below must never see one: GSPMD partitions the
+    bitcast+concatenate and inserts a cross-replica reduction, so every
+    output byte comes back SUMMED over the mesh replicas (observed on
+    the 8-device CPU mesh: selected values 4x on a dp=2 x tp=4 layout,
+    -1 bytes wrapping to 0xFC).  Sharded results gather per-leaf."""
+    s = getattr(a, "sharding", None)
+    try:
+        return s is not None and len(s.device_set) > 1
+    except Exception:
+        return False
+
+
 def _pull_tree_to_host(tree):
     """Transfer a pytree of device arrays to host numpy with ONE
     device->host transfer: a jitted program bitcasts every leaf to bytes
@@ -263,7 +277,7 @@ def _pull_tree_to_host(tree):
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if len(leaves) < 2 or not all(
         hasattr(a, "dtype") and np.dtype(a.dtype) != object for a in leaves
-    ):
+    ) or any(_multi_device(a) for a in leaves):
         # Mirror _pack_tree_to_device's non-array fallback.
         return jax.tree_util.tree_unflatten(
             treedef, [np.asarray(a) for a in leaves]

@@ -70,9 +70,11 @@ locks.
 
 from __future__ import annotations
 
+import bisect
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Sequence
@@ -219,24 +221,153 @@ class _SlotSim:
         self.slot_of: dict[str, int] = dict(slot_of or {})
         self.names: list[str] = list(names or [])
 
-    def sync(self, current_names: Sequence[str]) -> None:
+    def sync(
+        self, current_names: Sequence[str]
+    ) -> tuple[list[str], list[tuple[str, int]]]:
         """Mirror NodeSlots.sync for a post-step node-name set, in the
-        store's name-sorted list order (what featurize receives)."""
+        store's name-sorted list order (what featurize receives).
+
+        Returns ``(removed_names, changed_assignments)`` — the per-step
+        DELTA, so the lowering maintains its rank row incrementally
+        instead of re-walking the whole slot map every step (the old
+        O(K*N) python loop).  Entries in ``changed_assignments`` apply
+        in order (a name moved twice within one sync keeps its last
+        slot)."""
         present = set(current_names)
+        removed: list[str] = []
+        changed: list[tuple[str, int]] = []
         gone = [s for nm, s in self.slot_of.items() if nm not in present]
         for s in sorted(gone, reverse=True):
             nm = self.names[s]
             last = len(self.names) - 1
             del self.slot_of[nm]
+            removed.append(nm)
             if s != last:
                 moved = self.names[last]
                 self.names[s] = moved
                 self.slot_of[moved] = s
+                changed.append((moved, s))
             self.names.pop()
         for nm in current_names:
             if nm not in self.slot_of:
                 self.slot_of[nm] = len(self.names)
                 self.names.append(nm)
+                changed.append((nm, len(self.names) - 1))
+        return removed, changed
+
+
+# ---------------------------------------------------------------------------
+# Window parse (the store-independent prefix of segment lowering)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _StepParse:
+    """One step's net object events, window-locally validated."""
+
+    pc: list[str] = field(default_factory=list)  # created pod keys
+    pd: list[str] = field(default_factory=list)  # deleted pod keys
+    nc: list[str] = field(default_factory=list)  # created node names
+    nd: list[str] = field(default_factory=list)  # deleted node names
+    flush: bool = False
+
+
+@dataclass
+class _WindowSpec:
+    """The STORE-INDEPENDENT prefix of one window's lowering: event
+    parsing, op-vocabulary screening, window-local name bookkeeping and
+    created-object support checks — everything ``_lower`` needs that
+    does not read the ClusterStore or the service's mutable state.
+
+    Built either synchronously (inside the ``replay.lower`` span) or
+    SPECULATIVELY for segment N+1 on the main thread while segment N's
+    dispatch runs on the watchdogged worker (``replay.prelower`` span /
+    fault site) — the double-buffered executor's overlap.  A speculative
+    spec is keyed by the identity of its batch lists and discarded
+    whenever the window it predicted is not the window that actually
+    runs next (mid-window fallback, rollback, shorter consumed prefix,
+    service reconfiguration).
+
+    Store-membership validation (delete-of-unknown, name reuse against
+    live objects, backoff-entry reuse) cannot run here; those checks are
+    recorded in op order in ``checks`` and replayed against the live
+    store/service sets by ``_lower``.  A window-local vocabulary miss
+    stops the parse and lands in ``err_step``/``err_reason``: the
+    consumer lowers only the supported prefix, and the erroring step
+    heads the next window, which head-rejects it (prefix-granular
+    fallback)."""
+
+    wlen: int  # window length this spec was parsed for
+    sched_names: tuple[str, ...]  # service config the support checks used
+    n: int = 0  # op-screen prefix length (steps fully parsed)
+    head_reason: str | None = None  # op-vocabulary reject of step 0
+    err_step: int = _I32_MAX  # step where a window-local miss stopped parse
+    err_reason: str | None = None
+    steps: list[_StepParse] = field(default_factory=list)
+    # (step, kind, key) store-membership checks, in op order; kind in
+    # {"create_pod", "delete_pod", "create_node", "delete_node"}.
+    checks: list[tuple[int, str, str]] = field(default_factory=list)
+    created_pods: list[tuple[int, str, JSON]] = field(default_factory=list)
+    created_nodes: list[tuple[int, JSON]] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Persistent lowered-universe cache
+# ---------------------------------------------------------------------------
+
+
+class _LowerCache:
+    """Lowered-universe state reused across CONSECUTIVE committed
+    segments, making per-segment host lowering O(delta) instead of
+    O(universe): the queue-sorted universe (cleaned pod objects + their
+    static ``queue_sort_key`` tuples), the priority resolution, and —
+    by keeping the surviving objects' IDENTITY stable — every per-pod
+    featurizer/encoder memo row behind them.  Only objects created
+    inside the new window are featurized fresh.
+
+    Validity contract (docs/churn_floor.md "Incremental lowering +
+    pipelined executor"): the cache is trustworthy exactly when nothing
+    touched the store except committed device segments, which is what
+    ``ClusterStore.mutation_epoch`` certifies — segment reconciles run
+    in an epoch-exempt transaction, every other write moves the epoch.
+    Invalidation is STRICT: any per-pass fallback, a segment rollback, a
+    breaker trip, or an epoch mismatch (out-of-band store write) flushes
+    the whole cache; the next lower rebuilds from the store and
+    re-screens every object.  ``verify_segment``'s store-vs-device
+    parity check (which runs inside every segment transaction) is what
+    anchors the cached survivor view to the real store contents."""
+
+    def __init__(self) -> None:
+        self.valid = False
+        self.epoch = -1
+        self.keys: list[str] = []  # queue-sort order
+        self.sort_keys: list[tuple] = []  # parallel queue_sort_key tuples
+        self.clean_pods: list[JSON] = []  # parallel cleaned pending objects
+        self.priority_of = None
+        self.prio_gen = 0  # memo token for resolver-dependent per-pod keys
+        self.sched_names = None  # profile set the survivors were screened against
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    def invalidate(self, reason: str) -> None:
+        if not self.valid:
+            return
+        self.valid = False
+        self.invalidations += 1
+        self.keys = []
+        self.sort_keys = []
+        self.clean_pods = []
+        self.priority_of = None
+        self.sched_names = None
+        TRACE.event("replay.cache_invalidate", reason=reason)
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "invalidations": self.invalidations,
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -996,6 +1127,40 @@ class ReplayDriver:
         # Segment sequence number (trace-span correlation id: every
         # lower/dispatch/reconcile span of one window shares it).
         self._segment_seq = 0
+        # Incremental-lowering state (docs/churn_floor.md round 10): the
+        # persistent lowered-universe cache, the speculative next-window
+        # spec from the double-buffered executor, the committed plan the
+        # cache advances from, and the device-resident constant-buffer
+        # reuse map ({id(host array): (host ref, device array)} from the
+        # previous dispatch; the host ref pins the id).
+        self._cache = _LowerCache()
+        self._spec: "tuple[tuple[int, ...], _WindowSpec] | None" = None
+        self._last_plan: "_SegmentPlan | None" = None
+        self._dev_consts: dict[int, tuple[Any, Any]] = {}
+        self._dev_consts_x64: "bool | None" = None
+        # Default: ON where re-transfer is the only cost (cpu backend),
+        # OFF on the axon remote-tunnel runtime — pinning extra live
+        # device buffers there slows every subsequent execution/transfer
+        # 3-4x (the measured KSIM_H2D_CACHE pathology, engine/core.py);
+        # KSIM_REPLAY_DEV_CACHE=1/0 overrides either way.  Unset env ->
+        # None: the backend probe is DEFERRED to after the first healthy
+        # dispatch — jax.default_backend() initializes the XLA client,
+        # which must only ever happen on the watchdogged worker (a
+        # wedged tunnel would hang an unguarded main-thread init here).
+        _dc = os.environ.get("KSIM_REPLAY_DEV_CACHE")
+        self._dev_cache_on: "bool | None" = _dc != "0" if _dc is not None else None
+        self._prio_gen = 0
+        # Pipeline / O(delta) evidence counters (bench JSON, lock-check
+        # guard).  ``lower_log`` records one entry per successful lower:
+        # the window's event count vs the fresh per-pod featurize rows it
+        # actually built — the counter-based O(delta) guard's input.
+        self.prelower_windows = 0
+        self.prelower_consumed = 0
+        self.prelower_discarded = 0
+        self.prelower_faults = 0
+        self.dev_const_hits = 0
+        self.dev_const_misses = 0
+        self.lower_log: list[dict] = []
         # The live driver's degradation evidence rides in the merged
         # /api/v1/metrics document (latest driver wins — one per
         # ScenarioRunner run).  Weakly referenced: the module-global
@@ -1013,6 +1178,7 @@ class ReplayDriver:
 
     def stats(self) -> dict:
         """Degradation evidence for runner stats / the bench JSON."""
+        feat = self._featurizer
         return {
             "device_steps": self.device_steps,
             "fallback_steps": self.fallback_steps,
@@ -1021,6 +1187,24 @@ class ReplayDriver:
             "watchdog_timeouts": self.watchdog_timeouts,
             "breaker_tripped": self.breaker_tripped,
             "unsupported": dict(self.unsupported),
+            # Incremental-lowering evidence (round 10): the cache's
+            # hit/miss/invalidation counters and the driver featurizer's
+            # fresh per-pod row builds make the O(delta) lowering claim
+            # machine-checkable straight from the bench JSON line.
+            "lower_cache": self._cache.stats(),
+            "featurize_calls": feat.pod_rows_built if feat is not None else 0,
+            "featurize_reused": feat.pod_rows_reused if feat is not None else 0,
+            "featurize_passes": feat.featurize_passes if feat is not None else 0,
+            "prelower": {
+                "windows": self.prelower_windows,
+                "consumed": self.prelower_consumed,
+                "discarded": self.prelower_discarded,
+                "faults": self.prelower_faults,
+            },
+            "dev_const": {
+                "hits": self.dev_const_hits,
+                "misses": self.dev_const_misses,
+            },
         }
 
     # -- support checks ------------------------------------------------------
@@ -1077,6 +1261,225 @@ class ReplayDriver:
 
     _OP_KINDS = frozenset({"pods", "nodes"})
 
+    def _window_len(self) -> int:
+        """Steps one lowered window may consume (record mode dependent;
+        valid after ``service_supported``)."""
+        return self._full_k if self._record_mode == "full" else self.k
+
+    def _parse_window(self, batches: list[list[Any]]) -> _WindowSpec:
+        """The store-independent lowering prefix for up to one window of
+        batches: op-vocabulary screening, per-step net object events
+        (same-step create+delete cancels), window-local name
+        bookkeeping, and support checks on CREATED objects.  Never reads
+        the store or mutable service state, so it can run speculatively
+        while the previous segment's dispatch is in flight.  Vocabulary
+        misses never propagate: they stop the parse and land in the
+        spec's ``head_reason`` / ``err_step``+``err_reason`` fields for
+        the consumer to raise (or ignore, when its clamped window ends
+        before the erroring step)."""
+        spec = _WindowSpec(
+            wlen=self._window_len(), sched_names=self.service._scheduler_names
+        )
+        # (The op screen below is also run — head batch only, pre-span —
+        # by _batch_ops_ok, so a head-rejected window never opens the
+        # replay.lower span; keep the two in sync.)
+        win_pod_seen: set[str] = set()  # keys ever used by window creates
+        win_pod_live: set[str] = set()  # window-created keys still alive
+        ext_del_pods: set[str] = set()  # pre-window keys deleted in-window
+        win_node_seen: set[str] = set()
+        win_node_live: set[str] = set()
+        ext_del_nodes: set[str] = set()
+        try:
+            for k, batch in enumerate(batches):
+                for op in batch:
+                    if op.kind not in self._OP_KINDS or op.op not in (
+                        "create",
+                        "delete",
+                    ):
+                        if k == 0:
+                            spec.head_reason = f"op:{op.op}/{op.kind}"
+                        return spec  # op-screen prefix ends here
+                st = _StepParse(
+                    flush=any(
+                        op.kind == "nodes"
+                        or (op.op == "delete" and op.kind == "pods")
+                        for op in batch
+                    )
+                )
+                for op in batch:
+                    if op.kind == "pods":
+                        if op.op == "create":
+                            key = _pod_key(op.obj)
+                            if key in win_pod_seen or key in ext_del_pods:
+                                raise _Unsupported("pod_name_reuse")
+                            # Against the live store + the service's
+                            # backoff table: deferred (_lower).
+                            spec.checks.append((k, "create_pod", key))
+                            if op.obj.get("spec", {}).get("nodeName") or op.obj.get(
+                                "status", {}
+                            ).get("phase"):
+                                raise _Unsupported("create_bound_pod")
+                            reason = self._pod_supported(op.obj, spec.sched_names)
+                            if reason is not None:
+                                raise _Unsupported(reason)
+                            win_pod_seen.add(key)
+                            win_pod_live.add(key)
+                            st.pc.append(key)
+                            spec.created_pods.append((k, key, op.obj))
+                        else:
+                            key = f"{op.namespace or 'default'}/{op.name}"
+                            if key in win_pod_live:
+                                if key in st.pc:
+                                    st.pc.remove(key)  # same-step net no-op
+                                else:
+                                    st.pd.append(key)
+                                win_pod_live.discard(key)
+                            elif key in win_pod_seen or key in ext_del_pods:
+                                # Window-locally provable double delete.
+                                raise _Unsupported("delete_unknown_pod")
+                            else:
+                                # Must exist in the store: deferred.
+                                spec.checks.append((k, "delete_pod", key))
+                                ext_del_pods.add(key)
+                                st.pd.append(key)
+                    else:  # nodes
+                        if op.op == "create":
+                            nm = name_of(op.obj)
+                            if nm in win_node_seen or nm in ext_del_nodes:
+                                raise _Unsupported("node_name_reuse")
+                            spec.checks.append((k, "create_node", nm))
+                            if op.obj.get("status", {}).get("images"):
+                                raise _Unsupported("node_images")
+                            win_node_seen.add(nm)
+                            win_node_live.add(nm)
+                            st.nc.append(nm)
+                            spec.created_nodes.append((k, op.obj))
+                        else:
+                            if not self._requeue:
+                                raise _Unsupported("drain_without_requeue")
+                            nm = op.name
+                            if nm in win_node_live:
+                                if nm in st.nc:
+                                    st.nc.remove(nm)
+                                else:
+                                    st.nd.append(nm)
+                                win_node_live.discard(nm)
+                            elif nm in win_node_seen or nm in ext_del_nodes:
+                                raise _Unsupported("delete_unknown_node")
+                            else:
+                                spec.checks.append((k, "delete_node", nm))
+                                ext_del_nodes.add(nm)
+                                st.nd.append(nm)
+                spec.steps.append(st)
+                spec.n = len(spec.steps)
+        except _Unsupported as e:
+            spec.err_step = len(spec.steps)
+            spec.err_reason = str(e)
+        return spec
+
+    # -- the double-buffered executor's speculative prefix -------------------
+
+    def _discard_spec(self) -> None:
+        if self._spec is not None:
+            self._spec = None
+            self.prelower_discarded += 1
+
+    def _flush_incremental(self, reason: str) -> None:
+        """Strictly drop ALL incremental lowering state — the cache, the
+        speculative prefix, the retained plan, and the device-resident
+        constant buffers — ahead of a path the incremental bookkeeping
+        cannot track.  One helper so no future invalidation site can
+        flush the cache but leave a stale plan/buffer map behind it."""
+        self._cache.invalidate(reason)
+        self._discard_spec()
+        self._last_plan = None
+        self._dev_consts = {}
+
+    def _take_spec(self, batches: list[list[Any]]) -> "_WindowSpec | None":
+        """Consume the speculative prefix if it predicted exactly this
+        window (same batch-list identities, same window length, same
+        profile config); discard it otherwise."""
+        held = self._spec
+        self._spec = None
+        if held is None:
+            return None
+        lists, spec = held
+        if (
+            len(batches) < len(lists)
+            or any(a is not b for a, b in zip(lists, batches))
+            or spec.wlen != self._window_len()
+            or spec.sched_names != self.service._scheduler_names
+        ):
+            self.prelower_discarded += 1
+            return None
+        self.prelower_consumed += 1
+        return spec
+
+    def _prelower_next(self, plan: "_SegmentPlan", future: list[list[Any]]) -> None:
+        """Speculatively parse + memo-warm the NEXT window while the
+        current segment's dispatch runs on the worker thread.  The
+        prefix is store-independent by construction, so it cannot race
+        the (not-yet-known) outcome of segment N; the store-dependent
+        remainder runs in ``_lower`` only after N's reconcile commits.
+        Containment: any classified failure here — including an armed
+        ``replay.prelower`` fault — degrades THIS window's overlap only
+        (the window parses synchronously instead); it never touches the
+        in-flight dispatch or the locks."""
+        self._discard_spec()  # a stale prediction can never be consumed
+        nxt = future[plan.n_steps : plan.n_steps + self._window_len()]
+        if not nxt:
+            return
+        self.prelower_windows += 1
+        try:
+            with TRACE.span(
+                "replay.prelower", segment=self._segment_seq, steps=len(nxt)
+            ):
+                FAULTS.check("replay.prelower")
+                spec = self._parse_window(nxt)
+                self._warm_spec(spec)
+        except Exception as e:
+            # Catch EVERYTHING, not just SimulatorError: this runs while
+            # the dispatch worker is in flight, and a propagating
+            # programming error would be misclassified by the dispatch
+            # handlers as a device_error (feeding the breaker) or crash
+            # past the un-joined worker.  A real bug is not masked — the
+            # window re-parses synchronously inside replay.lower, where
+            # the taxonomy re-raises non-SimulatorErrors with the worker
+            # safely joined.
+            self.prelower_faults += 1
+            logger.warning(
+                "speculative prelower failed (%s: %s); the next window "
+                "lowers synchronously",
+                type(e).__name__, e,
+            )
+            return
+        # Hold the batch lists themselves, not bare id()s: the pinned
+        # references keep CPython from recycling an id onto a different
+        # list, so _take_spec's identity match can never false-positive.
+        self._spec = (tuple(nxt), spec)
+
+    def _warm_spec(self, spec: _WindowSpec) -> None:
+        """Populate the per-object parse memos for the window's CREATED
+        objects (the only ones the next featurize will miss on) off the
+        critical path.  Every warmed function is a pure parse of a
+        frozen object memoized on its identity (state/objcache.py), so
+        warming is semantically invisible — the completion path would
+        compute the identical entries, just inside the replay.lower
+        span."""
+        from ksim_tpu.state.encoding import _parsed_node_affinity
+        from ksim_tpu.state.interpod import parsed_terms
+        from ksim_tpu.state.resources import node_allocatable, pod_tolerations
+        from ksim_tpu.state.resources import pod_requests as _preqs
+
+        for _step, _key, obj in spec.created_pods:
+            _preqs(obj)
+            _preqs(obj, non_zero=True)
+            pod_tolerations(obj)
+            _parsed_node_affinity(obj)
+            parsed_terms(obj)
+        for _step, obj in spec.created_nodes:
+            node_allocatable(obj)
+
     def _batch_ops_ok(self, batch: Sequence[Any], record: bool) -> bool:
         """Cheap op-vocabulary screen for ONE step's batch (no store
         access).  ``record`` counts the reject reason — only the batch
@@ -1112,11 +1515,14 @@ class ReplayDriver:
     # -- lowering ------------------------------------------------------------
 
     def try_segment(self, batches: list[list[Any]]):
-        """Lower + run up to len(batches) steps; returns SegmentOutcome
-        (whose ``steps`` may be SHORTER than the window: the supported
-        prefix, tail-padded on-device to the compiled K) or None (the
-        FIRST step is unsupported — the caller falls back for it).
-        Must be called BEFORE the steps' ops touch the store.
+        """Lower + run up to one window of steps (``batches`` may carry
+        LOOKAHEAD beyond the window — the double-buffered executor
+        pre-lowers the following window's store-independent prefix while
+        this one's dispatch is in flight); returns SegmentOutcome (whose
+        ``steps`` may be SHORTER than the window: the supported prefix,
+        tail-padded on-device to the compiled K) or None (the FIRST step
+        is unsupported — the caller falls back for it).  Must be called
+        BEFORE the steps' ops touch the store.
 
         Failure taxonomy (classified, never a bare catch-all):
 
@@ -1128,7 +1534,18 @@ class ReplayDriver:
           ``device_error`` fallback, counted toward the circuit breaker;
         - everything else (TypeError & friends) is a programming error
           and RE-RAISES — silent fallback must never mask a bug.
+
+        Any None return STRICTLY invalidates the lowered-universe cache,
+        discards the speculative prefix, and drops the device-resident
+        constant buffers: the per-pass path is about to mutate store and
+        service state the incremental bookkeeping cannot track.
         """
+        out = self._try_segment_impl(batches)
+        if out is None:
+            self._flush_incremental("fallback")
+        return out
+
+    def _try_segment_impl(self, batches: list[list[Any]]):
         if self.breaker_tripped:
             # Sticky: after the breaker opens, every window falls back
             # immediately — no lowering work, no watchdog tax.
@@ -1136,20 +1553,34 @@ class ReplayDriver:
             return None
         if not self.service_supported():
             return None
-        m = 0
-        for batch in batches:
-            if not self._batch_ops_ok(batch, record=(m == 0)):
-                break
-            m += 1
-        if m == 0:
+        # Pre-span head screen: a window whose FIRST step is outside the
+        # op vocabulary never lowers — no replay.lower span, no fault
+        # slot, no segment seq — so phase counts and armed call:N fault
+        # schedules keep tracking REAL lowerings (the pre-round-10
+        # semantics).  try_segment's None wrapper discards any held
+        # speculative spec and flushes the cache, as for any fallback.
+        if not batches or not self._batch_ops_ok(batches[0], record=True):
             return None
-        if self._record_mode == "full":
-            m = min(m, self._full_k)
+        wlen = self._window_len()
+        spec = self._take_spec(batches)
         self._segment_seq += 1
         try:
-            with TRACE.span("replay.lower", segment=self._segment_seq, steps=m):
+            with TRACE.span(
+                "replay.lower",
+                segment=self._segment_seq,
+                steps=min(len(batches), wlen),
+            ) as sp:
                 FAULTS.check("replay.lower")
-                plan = self._lower(list(batches[:m]))
+                if spec is None:
+                    spec = self._parse_window(batches[:wlen])
+                m = min(spec.n, wlen)
+                if m == 0:
+                    raise _Unsupported(spec.head_reason or spec.err_reason)
+                # The opening value is window CAPACITY; refine to the
+                # actually-lowered count so lower spans line up with
+                # dispatch spans on short (vocabulary-miss) segments.
+                sp.set(steps=m)
+                plan = self._lower(list(batches[:m]), spec)
         except ReplayFallback as e:
             self._reject(str(e))
             return None
@@ -1162,11 +1593,16 @@ class ReplayDriver:
             return None
         if plan is None:
             return None
+        if (
+            self._dev_cache_on
+            and self._dev_consts_x64 == bool(jax.config.jax_enable_x64)
+        ):
+            plan.dev_reuse = self._dev_consts
         try:
             with TRACE.span(
                 "replay.dispatch", segment=self._segment_seq, steps=plan.n_steps
             ):
-                res = self._run_watchdogged(plan)
+                res = self._run_watchdogged(plan, batches)
         except ReplayParityError:
             raise  # a kernel bug, not a degradable condition
         except ReplayFallback as e:
@@ -1178,6 +1614,17 @@ class ReplayDriver:
         # the segment): the backend is alive — reset the breaker window.
         self._consecutive_device_errors = 0
         self.device_round_trips += 1
+        if self._dev_cache_on is None:
+            # Safe to probe now: the dispatch initialized the backend on
+            # the watchdogged worker, so this is an instant lookup.
+            self._dev_cache_on = jax.default_backend() == "cpu"
+        if self._dev_cache_on and plan.dev_map_out is not None:
+            # Adopt this dispatch's device buffers for id-keyed reuse by
+            # the next one (main thread: _run never mutates the driver).
+            self._dev_consts = plan.dev_map_out
+            self._dev_consts_x64 = bool(jax.config.jax_enable_x64)
+            self.dev_const_hits += plan.dev_hits
+            self.dev_const_misses += plan.dev_misses
         if isinstance(res, str):
             # Post-dispatch validation discard (featurize_prediction /
             # preemption_overflow): store untouched, fall back.
@@ -1186,10 +1633,15 @@ class ReplayDriver:
         # device_steps is counted by the caller once the segment COMMITS
         # (a rolled-back reconcile re-runs its steps per-pass — counting
         # here would double-book them).
+        self._last_plan = plan
         return res
 
-    def _run_watchdogged(self, plan: "_SegmentPlan"):
-        """Run ``_run`` on a worker thread bounded by the watchdog.
+    def _run_watchdogged(self, plan: "_SegmentPlan", future: list[list[Any]]):
+        """Run ``_run`` on a worker thread bounded by the watchdog, and
+        OVERLAP the wait with the next window's speculative prelower on
+        this (the main) thread — the double-buffered pipeline.  The
+        watchdog budget still covers the dispatch from ITS start: the
+        join timeout is reduced by however long the prelower took.
 
         ``block_until_ready`` against a wedged backend never returns;
         the join timeout turns that hang into DeviceUnavailableError so
@@ -1201,7 +1653,11 @@ class ReplayDriver:
         caller on the MAIN thread), so a late-finishing stray worker
         cannot corrupt the accounting of the degraded run."""
         if self.watchdog_s <= 0:
-            return self._run(plan)
+            out = self._run(plan)
+            # No worker to overlap with; the parse/memo warm still moves
+            # off the next window's replay.lower span.
+            self._prelower_next(plan, future)
+            return out
         box: dict[str, Any] = {}
 
         def work() -> None:
@@ -1212,7 +1668,9 @@ class ReplayDriver:
 
         t = threading.Thread(target=work, name="replay-dispatch", daemon=True)
         t.start()
-        t.join(self.watchdog_s)
+        t0 = time.monotonic()
+        self._prelower_next(plan, future)
+        t.join(max(self.watchdog_s - (time.monotonic() - t0), 0.001))
         if t.is_alive():
             self.watchdog_timeouts += 1
             TRACE.event(
@@ -1290,7 +1748,7 @@ class ReplayDriver:
             svc._featurizers[name] = feat
         return feat
 
-    def _lower(self, batches: list[list[Any]]):
+    def _lower(self, batches: list[list[Any]], spec: _WindowSpec):
         from ksim_tpu.engine.core import _Program
         from ksim_tpu.scheduler.service import queue_sort_key
         from ksim_tpu.state.featurizer import bucket_size
@@ -1302,96 +1760,88 @@ class ReplayDriver:
             if store.list(kind, copy_objs=False):
                 raise _Unsupported("volume_objects")
 
+        m_steps = len(batches)
+        lower_epoch = store.mutation_epoch
         cur_pods = store.list("pods", copy_objs=False)
         cur_nodes = store.list("nodes", copy_objs=False)
         node_names = {name_of(n) for n in cur_nodes}
         sched_names = svc._scheduler_names
-
-        # Net per-step object events (create+delete of the same object
-        # within one step cancels — the pass never sees it).
-        pod_objs: dict[str, JSON] = {_pod_key(p): p for p in cur_pods}
-        known_pods = set(pod_objs)
-        # Names ever used within the segment (including deleted ones): a
-        # recreated name would collapse two distinct objects onto one
-        # universe row / node slot, so it falls back instead.
-        seen_pod_keys = set(known_pods)
-        created_pods: list[JSON] = []
-        step_pod_creates: list[list[str]] = []
-        step_pod_deletes: list[list[str]] = []
-        step_node_creates: list[list[str]] = []
-        step_node_deletes: list[list[str]] = []
-        step_flush: list[bool] = []
-        created_nodes: list[JSON] = []
-        live_node_names = set(node_names)
-        seen_node_names = set(node_names)
-        for batch in batches:
-            pc, pd, nc, nd = [], [], [], []
-            for op in batch:
-                if op.kind == "pods":
-                    if op.op == "create":
-                        key = _pod_key(op.obj)
-                        if key in seen_pod_keys:
-                            raise _Unsupported("pod_name_reuse")
-                        if key in svc._backoff:
-                            # A stale backoff entry for a DEAD same-name
-                            # pod: the per-pass path would let the new
-                            # pod inherit it (_in_backoff is key-based),
-                            # which the fresh universe row cannot model.
-                            raise _Unsupported("backoff_name_reuse")
-                        if op.obj.get("spec", {}).get("nodeName") or op.obj.get(
-                            "status", {}
-                        ).get("phase"):
-                            raise _Unsupported("create_bound_pod")
-                        seen_pod_keys.add(key)
-                        known_pods.add(key)
-                        pod_objs[key] = op.obj
-                        created_pods.append(op.obj)
-                        pc.append(key)
-                    else:
-                        key = f"{op.namespace or 'default'}/{op.name}"
-                        if key not in known_pods:
-                            raise _Unsupported("delete_unknown_pod")
-                        if key in pc:
-                            pc.remove(key)  # same-step create+delete: net no-op
-                        else:
-                            pd.append(key)
-                        known_pods.discard(key)
-                elif op.kind == "nodes":
-                    if op.op == "create":
-                        nm = name_of(op.obj)
-                        if nm in seen_node_names:
-                            raise _Unsupported("node_name_reuse")
-                        seen_node_names.add(nm)
-                        live_node_names.add(nm)
-                        created_nodes.append(op.obj)
-                        nc.append(nm)
-                    else:
-                        if not self._requeue:
-                            raise _Unsupported("drain_without_requeue")
-                        if op.name not in live_node_names:
-                            raise _Unsupported("delete_unknown_node")
-                        if op.name in nc:
-                            nc.remove(op.name)
-                        else:
-                            nd.append(op.name)
-                        live_node_names.discard(op.name)
-            step_pod_creates.append(pc)
-            step_pod_deletes.append(pd)
-            step_node_creates.append(nc)
-            step_node_deletes.append(nd)
-            step_flush.append(
-                any(
-                    op.kind == "nodes" or (op.op == "delete" and op.kind == "pods")
-                    for op in batch
-                )
+        cache = self._cache
+        use_cache = (
+            cache.valid
+            and cache.epoch == lower_epoch
+            and cache.sched_names == sched_names
+        )
+        if cache.valid and not use_cache:
+            # An out-of-band store write moved the mutation epoch — or a
+            # scheduler reconfiguration changed the profile set the cached
+            # survivors' support screen ran against (config changes never
+            # write the store, so the epoch alone cannot see them) —
+            # since the cache's segment committed: strict flush, rebuild.
+            cache.invalidate(
+                "epoch_mismatch"
+                if cache.epoch != lower_epoch
+                else "sched_config"
             )
+
+        # Store-dependent half of the window validation: replay the
+        # parse's deferred membership checks against the live store and
+        # the service backoff table, in recorded op order, then raise
+        # any window-local miss that sits inside this window.  (The
+        # window-LOCAL half — op vocabulary, same-window name reuse,
+        # created-object support — already ran in _parse_window,
+        # possibly speculatively while the previous dispatch flew.)
+        with svc._backoff_lock:
+            backoff_keys = set(svc._backoff)
+        # O(checks), not O(universe): each deferred pod-membership check
+        # is one keyed store probe — building a key set over every store
+        # pod here would reintroduce the per-segment O(U) host walk on
+        # exactly the common cache-hit path this cache exists to avoid.
+        for stp, check, key in spec.checks:
+            if stp >= m_steps:
+                break
+            if check == "create_pod":
+                ns, _, nm = key.partition("/")
+                if store.contains("pods", nm, ns):
+                    raise _Unsupported("pod_name_reuse")
+                if key in backoff_keys:
+                    # A stale backoff entry for a DEAD same-name pod: the
+                    # per-pass path would let the new pod inherit it
+                    # (_in_backoff is key-based), which the fresh
+                    # universe row cannot model.
+                    raise _Unsupported("backoff_name_reuse")
+            elif check == "delete_pod":
+                ns, _, nm = key.partition("/")
+                if not store.contains("pods", nm, ns):
+                    raise _Unsupported("delete_unknown_pod")
+            elif check == "create_node":
+                if key in node_names:
+                    raise _Unsupported("node_name_reuse")
+            else:  # delete_node
+                if key not in node_names:
+                    raise _Unsupported("delete_unknown_node")
+        # A parse error can only sit AT or PAST the lowered prefix's end
+        # (err_step == spec.n by construction and m_steps <= spec.n):
+        # the erroring step heads the NEXT window, which head-rejects it
+        # — the prefix-granular fallback.
+        assert spec.err_step >= m_steps, spec.err_reason
+
+        # Net per-step object events from the (possibly speculative)
+        # window parse; copies, because tail padding appends below.
+        steps = spec.steps[:m_steps]
+        step_pod_creates = [list(s.pc) for s in steps]
+        step_pod_deletes = [list(s.pd) for s in steps]
+        step_node_creates = [list(s.nc) for s in steps]
+        step_node_deletes = [list(s.nd) for s in steps]
+        step_flush = [s.flush for s in steps]
+        created_pod_entries = [e for e in spec.created_pods if e[0] < m_steps]
+        created_nodes = [obj for stp, obj in spec.created_nodes if stp < m_steps]
 
         # Tail padding: segments shorter than the compiled K (the stream
         # tail, a mid-window vocabulary miss, or full-record's shorter
         # K) extend with inactive no-op steps so they reuse the existing
         # compile instead of falling back (ROADMAP open item).
-        m_steps = len(batches)
-        k_pad = self._full_k if self._record_mode == "full" else self.k
+        k_pad = self._window_len()
         step_active = [True] * m_steps + [False] * (k_pad - m_steps)
         for _ in range(k_pad - m_steps):
             step_pod_creates.append([])
@@ -1400,28 +1850,70 @@ class ReplayDriver:
             step_node_deletes.append([])
             step_flush.append(False)
 
-        for n in list(cur_nodes) + created_nodes:
-            if n.get("status", {}).get("images"):
-                raise _Unsupported("node_images")
-
         # Universe pods, globally sorted by the exact per-pass queue key
         # (static per pod), so slot order IS queue order every step.
-        priority_of = build_priority_resolver(
-            store.list("priorityclasses", copy_objs=False)
-        )
-        universe_pods = list(cur_pods) + created_pods
-        for p in universe_pods:
-            reason = self._pod_supported(p, sched_names)
-            if reason is not None:
-                raise _Unsupported(reason)
-        universe_pods.sort(key=lambda p: queue_sort_key(p, priority_of))
-        row_of = {_pod_key(p): j for j, p in enumerate(universe_pods)}
-        universe_keys = [_pod_key(p) for p in universe_pods]
+        # O(delta) on a cache hit: survivors keep their cached order and
+        # sort keys (``queue_sort_key`` is total over distinct pod keys
+        # — priority desc, creationTimestamp, namespace, name — so a
+        # bisect merge of the window's creates reproduces exactly what a
+        # full stable sort would); only created objects compute keys.
+        # The UNIVERSE LIST HOLDS THE CLEANED PENDING OBJECTS: identical
+        # to the live store objects in every lowered field (sort key,
+        # requests, labels, tolerations, affinity, preemption statics —
+        # binds/annotations only touch nodeName/phase/annotations/rv),
+        # and identity-stable across segments, which is what keeps every
+        # per-pod featurizer memo row alive (the O(delta) claim).
+        if use_cache:
+            cache.hits += 1
+            priority_of = cache.priority_of
+            prio_gen = cache.prio_gen
+            uni_keys = list(cache.keys)
+            uni_sort = list(cache.sort_keys)
+            uni_clean = list(cache.clean_pods)
+        else:
+            cache.misses += 1
+            priority_of = build_priority_resolver(
+                store.list("priorityclasses", copy_objs=False)
+            )
+            self._prio_gen += 1
+            prio_gen = self._prio_gen
+            # Full support screen + node-image screen (survivors on the
+            # cache-hit path were screened when they entered the
+            # universe and cannot have changed: only segment-exempt
+            # writes happened since, and those never touch the screened
+            # fields).
+            for p in cur_pods:
+                reason = self._pod_supported(p, sched_names)
+                if reason is not None:
+                    raise _Unsupported(reason)
+            for n in cur_nodes:
+                if n.get("status", {}).get("images"):
+                    raise _Unsupported("node_images")
+            decorated = sorted(
+                (queue_sort_key(p, priority_of), _pod_key(p), _cleaned_pending(p))
+                for p in cur_pods
+            )
+            uni_sort = [d[0] for d in decorated]
+            uni_keys = [d[1] for d in decorated]
+            uni_clean = [d[2] for d in decorated]
+        for _stp, key, obj in created_pod_entries:
+            sk = queue_sort_key(obj, priority_of)
+            j = bisect.bisect_left(uni_sort, sk)
+            uni_sort.insert(j, sk)
+            uni_keys.insert(j, key)
+            uni_clean.insert(j, obj)
+
+        universe_pods = uni_clean
+        universe_keys = uni_keys
+        row_of = {k: j for j, k in enumerate(universe_keys)}
         if len(row_of) != len(universe_pods):
             raise _Unsupported("duplicate_pod_keys")
 
         # Featurize the universe once (persistent device featurizer:
-        # per-pod rows memoize, bound aggregates update by delta).
+        # per-pod rows memoize, bound aggregates update by delta; with
+        # the identity-stable cached universe, fresh row builds are
+        # O(window creates) — tracked in pod_rows_built and logged per
+        # segment in lower_log for the counter-based O(delta) guard).
         if self._featurizer is None:
             if svc._plugins_factory is not None:
                 from ksim_tpu.state.featurizer import Featurizer
@@ -1429,13 +1921,13 @@ class ReplayDriver:
                 self._featurizer = Featurizer()
             else:
                 self._featurizer = svc._profiles[self._sched_name].featurizer()
+        rows_built0 = self._featurizer.pod_rows_built
         universe_nodes = list(cur_nodes) + created_nodes
-        clean_pods = [_cleaned_pending(p) for p in universe_pods]
         bound_pods = store.pods_with_node()
         feats = self._featurizer.featurize(
             universe_nodes,
             (),
-            queue_pods=clean_pods,
+            queue_pods=universe_pods,
             bound_pods=bound_pods,
             namespaces=store.list("namespaces", copy_objs=False),
         )
@@ -1569,7 +2061,6 @@ class ReplayDriver:
         # this must fail loudly — a silently empty seed would produce
         # wrong rank tensors and break the count locks undetected.
         sim = _SlotSim(sim_feat._slots.slot_of, sim_feat._slots._names)
-        live = set(node_names)
         ranks = np.full((K, N), _I32_MAX, np.int32)
         # Per-step live-node views: name-order ranks + upstream's
         # candidate count for the preemption search; the live slot/name
@@ -1583,25 +2074,60 @@ class ReplayDriver:
         ]
         from ksim_tpu.scheduler.preemption import candidate_count
 
+        # Rank rows are maintained INCREMENTALLY: ``rank_row`` applies
+        # only the slots each sync actually changed (the per-step delta
+        # _SlotSim.sync now returns), and the sorted live-name list
+        # evolves by bisect insert/remove — per-step cost is O(events +
+        # one vectorized row copy), not the old O(N) python walk per
+        # step over the whole slot map.
+        rank_row = np.full(N, _I32_MAX, np.int32)
+        for nm, slot in sim.slot_of.items():
+            # .get: a dead node's name can linger in the service
+            # featurizer's slot map (an empty-queue pass skips the
+            # sync entirely); it has no universe slot and the kernels
+            # never read its rank.
+            j = slot_of.get(nm)
+            if j is not None:
+                rank_row[j] = slot
+        need_names = self._preempt_active or self._record_mode == "full"
+        live_sorted: list[str] = sorted(node_names)
+        live_slots = (
+            np.asarray([slot_of[nm] for nm in live_sorted], np.int64)
+            if need_names
+            else None
+        )
         for k in range(K):
-            live -= set(step_node_deletes[k])
-            live |= set(step_node_creates[k])
+            for nm in step_node_deletes[k]:
+                j = bisect.bisect_left(live_sorted, nm)
+                live_sorted.pop(j)
+                if need_names:
+                    live_slots = np.delete(live_slots, j)
+            for nm in step_node_creates[k]:
+                j = bisect.bisect_left(live_sorted, nm)
+                live_sorted.insert(j, nm)
+                if need_names:
+                    live_slots = np.insert(live_slots, j, slot_of[nm])
             if pred_featurizes[k]:
-                sim.sync(sorted(live))
-            for nm, slot in sim.slot_of.items():
-                ranks[k, slot_of[nm]] = slot
-            if self._preempt_active or self._record_mode == "full":
-                live_sorted = sorted(live)
+                removed, changed = sim.sync(live_sorted)
+                for nm in removed:
+                    # .get: the sync may drop a name that predates the
+                    # universe (see the seed loop above).
+                    j = slot_of.get(nm)
+                    if j is not None:
+                        rank_row[j] = _I32_MAX
+                for nm, slot in changed:
+                    rank_row[slot_of[nm]] = slot
+            ranks[k] = rank_row
+            if need_names:
                 want[k] = candidate_count(len(live_sorted))
-                for r, nm in enumerate(live_sorted):
-                    name_ranks[k, slot_of[nm]] = r
+                name_ranks[k, live_slots] = np.arange(
+                    len(live_sorted), dtype=np.int32
+                )
                 if self._record_mode == "full":
                     # Only the full-record decode consumes the slot/name
                     # views — don't build them on the selection hot path.
-                    step_live_slots.append(
-                        np.asarray([slot_of[nm] for nm in live_sorted], np.int64)
-                    )
-                    step_live_names.append(live_sorted)
+                    step_live_slots.append(live_slots)
+                    step_live_names.append(list(live_sorted))
 
         # Queue width: pending(now) + creates + requeue-able is an exact
         # upper bound on the pending population at any step, so eligible
@@ -1675,6 +2201,25 @@ class ReplayDriver:
                 pod_eligible_to_preempt,
                 start_time,
             )
+            from ksim_tpu.state import objcache
+
+            # Per-pod statics memoized on object identity (the cached
+            # universe keeps survivors' objects alive across segments,
+            # so the JSON walks behind these keys run once per pod, not
+            # once per segment).  ``more_important_key`` depends on the
+            # priority resolver, so its memo carries the resolver
+            # generation — a rebuilt resolver (cache miss) mints fresh
+            # entries instead of trusting stale priorities.
+            def mik(p: JSON):
+                return objcache.cached(
+                    "replay_mik",
+                    p,
+                    lambda: more_important_key(p, priority_of),
+                    prio_gen,
+                )
+
+            def stime(p: JSON) -> str:
+                return objcache.cached("replay_stime", p, lambda: start_time(p))
 
             priority = np.zeros(P, np.int32)
             imp_rank = np.full(P, _I32_MAX, np.int32)
@@ -1683,17 +2228,16 @@ class ReplayDriver:
             prios = [priority_of(p) for p in universe_pods]
             priority[:U] = prios
             for r, j in enumerate(
-                sorted(
-                    range(U),
-                    key=lambda j: more_important_key(universe_pods[j], priority_of),
-                )
+                sorted(range(U), key=lambda j: mik(universe_pods[j]))
             ):
                 imp_rank[j] = r
-            starts = sorted({start_time(p) for p in universe_pods} | {""})
+            starts = sorted({stime(p) for p in universe_pods} | {""})
             srank = {sv: i for i, sv in enumerate(starts)}
             for j, p in enumerate(universe_pods):
-                start_rank[j] = srank[start_time(p)]
-                preempt_ok[j] = pod_eligible_to_preempt(p)
+                start_rank[j] = srank[stime(p)]
+                preempt_ok[j] = objcache.cached(
+                    "replay_pel", p, lambda p=p: pod_eligible_to_preempt(p)
+                )
             const["pods"].update(
                 priority=priority,
                 imp_rank=imp_rank,
@@ -1742,6 +2286,19 @@ class ReplayDriver:
             "ip_vw": ip_vw0,
             "pass_count": np.asarray(svc._pass_count, np.int32),
         }
+        # O(delta) evidence: fresh per-pod featurize rows this lower
+        # actually built vs the window's event count (the lock-check
+        # guard asserts steady-state proportionality; counters, not
+        # timings, so it is CI-stable).
+        self.lower_log.append(
+            {
+                "events": sum(len(b) for b in batches),
+                "steps": m_steps,
+                "universe": U,
+                "rows_built": self._featurizer.pod_rows_built - rows_built0,
+                "cache_hit": use_cache,
+            }
+        )
         return _SegmentPlan(
             statics=statics,
             prog=prog,
@@ -1758,6 +2315,13 @@ class ReplayDriver:
             step_live_slots=step_live_slots,
             step_live_names=step_live_names,
             step_node_event=step_node_event,
+            lower_epoch=lower_epoch,
+            sort_keys=uni_sort,
+            clean_pods=uni_clean,
+            priority_of=priority_of,
+            prio_gen=prio_gen,
+            sched_names=sched_names,
+            dev_collect=bool(self._dev_cache_on),
         )
 
     @staticmethod
@@ -1877,9 +2441,47 @@ class ReplayDriver:
         extra = {
             k: const[k] for k in ("resolv", "empty_start_rank") if k in const
         }
-        tree = (const["node"], const["pods"], extra, aux_host, plan.ev, plan.state0)
-        node_dev, pods_dev, extra_dev, aux_dev, ev_dev, state_dev = (
-            _pack_tree_to_device(tree)
+        # Constant buffers (node statics, pod rows, aux tables) that are
+        # the SAME host arrays as the previous dispatch — the featurizer
+        # family caches and the lowered-universe cache keep them
+        # identity-stable when the underlying objects survived — reuse
+        # their device buffers instead of re-transferring; everything
+        # else (always the per-segment ev/state0 streams) packs into the
+        # usual single byte-buffer transfer.  The id-keyed map pins its
+        # host arrays, so a recycled id can never alias a fresh array.
+        cacheable = (const["node"], const["pods"], extra, aux_host)
+        transient = (plan.ev, plan.state0)
+        c_leaves, c_def = jax.tree_util.tree_flatten(cacheable)
+        t_leaves, t_def = jax.tree_util.tree_flatten(transient)
+        reuse = plan.dev_reuse
+        dev_c: list[Any] = [None] * len(c_leaves)
+        miss_idx: list[int] = []
+        for i, a in enumerate(c_leaves):
+            ent = reuse.get(id(a)) if reuse else None
+            if ent is not None and ent[0] is a:
+                dev_c[i] = ent[1]
+            else:
+                miss_idx.append(i)
+        packed = _pack_tree_to_device([c_leaves[i] for i in miss_idx] + t_leaves)
+        for pos, i in enumerate(miss_idx):
+            dev_c[i] = packed[pos]
+        plan.dev_hits = len(c_leaves) - len(miss_idx)
+        plan.dev_misses = len(miss_idx)
+        # Collected only when the driver will adopt it: with the reuse
+        # cache off, holding this map in the retained plan would pin a
+        # full segment's constant device buffers across the next window
+        # — the KSIM_H2D_CACHE pinning pathology (engine/core.py) the
+        # off-default exists to avoid.
+        plan.dev_map_out = (
+            {id(a): (a, d) for a, d in zip(c_leaves, dev_c)}
+            if plan.dev_collect
+            else None
+        )
+        node_dev, pods_dev, extra_dev, aux_dev = jax.tree_util.tree_unflatten(
+            c_def, dev_c
+        )
+        ev_dev, state_dev = jax.tree_util.tree_unflatten(
+            t_def, packed[len(miss_idx):]
         )
         const_dev = {"node": node_dev, "pods": pods_dev, "aux": aux_dev, **extra_dev}
         final_state, outs = _segment_fn(
@@ -2073,14 +2675,47 @@ class ReplayDriver:
         # A committed segment proves the whole device->store pipeline is
         # healthy: reset the reconcile side of the breaker window.
         self._consecutive_reconcile_faults = 0
+        self._advance_cache(seg)
+
+    def _advance_cache(self, seg: SegmentOutcome) -> None:
+        """Roll the lowered-universe cache forward to the committed
+        segment's end state: the lowered universe filtered to the pods
+        the device left alive (``verify_segment`` — which ran inside the
+        just-committed transaction — proved that view byte-identical to
+        the store).  Refuses and invalidates if the store epoch moved
+        since the lowering read it: an out-of-band write interleaved
+        with the dispatch, and the cache must not paper over it."""
+        plan = self._last_plan
+        cache = self._cache
+        if plan is None:
+            cache.invalidate("no_plan")
+            return
+        if self.store.mutation_epoch != plan.lower_epoch:
+            cache.invalidate("epoch_raced")
+            return
+        surv = set(seg.bound_view) | set(seg.pending_view)
+        keep = [j for j, k in enumerate(plan.universe_keys) if k in surv]
+        cache.keys = [plan.universe_keys[j] for j in keep]
+        cache.sort_keys = [plan.sort_keys[j] for j in keep]
+        cache.clean_pods = [plan.clean_pods[j] for j in keep]
+        cache.priority_of = plan.priority_of
+        cache.prio_gen = plan.prio_gen
+        cache.sched_names = plan.sched_names
+        cache.epoch = plan.lower_epoch
+        cache.valid = True
 
     def note_reconcile_fault(self) -> None:
         """Account one rolled-back segment reconcile (the runner's
         atomic-commit fallback).  Consecutive rollbacks trip the same
         sticky breaker as device failures: a persistently failing
         reconcile would otherwise pay a full lowering + dispatch +
-        rollback for every remaining step with no containment."""
+        rollback for every remaining step with no containment.  The
+        lowered-universe cache and the speculative prefix are STRICTLY
+        flushed: the rolled-back window's head step is about to re-run
+        per-pass, mutating state the incremental bookkeeping does not
+        track."""
         self._reject("reconcile_fault")
+        self._flush_incremental("rollback")
         self._consecutive_reconcile_faults += 1
         if (
             not self.breaker_tripped
@@ -2118,6 +2753,24 @@ class _SegmentPlan:
     step_live_slots: list = field(default_factory=list)
     step_live_names: list = field(default_factory=list)
     step_node_event: list = field(default_factory=list)
+    # Lower-cache seed (ReplayDriver._advance_cache filters it to the
+    # committed segment's survivors) + the store epoch the lowering read.
+    lower_epoch: int = -1
+    sort_keys: list = field(default_factory=list)
+    clean_pods: list = field(default_factory=list)
+    priority_of: Any = None
+    prio_gen: int = 0
+    sched_names: Any = None  # profile set the lowering screened against
+    # Device-resident constant-buffer reuse: ``dev_reuse`` is consumed by
+    # _run (id(host array) -> (host ref, device array) from the previous
+    # dispatch); ``dev_map_out``/hits/misses are produced by _run and
+    # adopted by the driver on the MAIN thread after a healthy join
+    # (_run itself stays side-effect-free on the driver).
+    dev_reuse: dict = field(default_factory=dict)
+    dev_collect: bool = False  # build dev_map_out (driver cache enabled)
+    dev_map_out: "dict | None" = None
+    dev_hits: int = 0
+    dev_misses: int = 0
 
 
 class _Unsupported(ReplayFallback):

@@ -11,6 +11,10 @@ unarmed site costs one dict lookup on an empty dict; nothing else.
 Sites currently wired (see docs/faults.md for the full table):
 
 - ``replay.lower``      segment lowering (engine/replay.py)
+- ``replay.prelower``   the NEXT window's speculative store-independent
+                        prefix, overlapped with the in-flight dispatch
+                        (a fault here degrades that window's overlap
+                        only — it re-parses synchronously)
 - ``replay.dispatch``   per-segment device dispatch (under the watchdog)
 - ``replay.reconcile``  per-step segment reconcile (inside the store
                         transaction — a fault here must roll back)
@@ -70,6 +74,7 @@ logger = logging.getLogger(__name__)
 #: silently.
 SITES: tuple[str, ...] = (
     "replay.lower",
+    "replay.prelower",
     "replay.dispatch",
     "replay.reconcile",
     "service.schedule",

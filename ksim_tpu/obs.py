@@ -82,6 +82,11 @@ __all__ = [
 #: fault event always has an enclosing phase on the timeline.
 SPAN_NAMES: tuple[str, ...] = (
     "replay.lower",  # segment lowering (engine/replay.py)
+    "replay.prelower",  # NEXT window's speculative store-independent
+    #                     prefix, overlapped with the in-flight dispatch
+    #                     (runs on the main thread INSIDE the dispatch
+    #                     span's wall-clock window — the two are
+    #                     concurrent by design, not additive)
     "replay.dispatch",  # device dispatch incl. watchdog wait
     "replay.reconcile",  # staged store reconcile (the segment txn)
     "runner.step",  # one per-pass host step (ops + flush + schedule)
@@ -101,6 +106,10 @@ EVENT_NAMES: tuple[str, ...] = (
     "fault.fired",  # the fault plane injected at args.site
     "store.txn_commit",  # segment transaction committed (args.writes)
     "store.txn_rollback",  # segment transaction rolled back
+    "replay.cache_invalidate",  # the lowered-universe cache flushed
+    #                             (args.reason: fallback / rollback /
+    #                             epoch_mismatch / epoch_raced /
+    #                             sched_config / no_plan)
 )
 
 _KNOWN_NAMES = frozenset(SPAN_NAMES) | frozenset(EVENT_NAMES)
@@ -207,6 +216,9 @@ class _NoopSpan:
     def __exit__(self, *exc):
         return False
 
+    def set(self, **args) -> None:
+        pass
+
 
 _NOOP = _NoopSpan()
 
@@ -242,6 +254,12 @@ class _Span:
                 self._jax_ctx = None
         self._t0 = time.perf_counter_ns()
         return self
+
+    def set(self, **args) -> None:
+        """Refine span attributes mid-flight (recorded at exit) — for
+        values the caller only learns inside the span, e.g. the ACTUAL
+        lowered step count of a window that hit a vocabulary miss."""
+        self.args.update(args)
 
     def __exit__(self, exc_type, exc, tb):
         t1 = time.perf_counter_ns()

@@ -364,7 +364,12 @@ class ScenarioRunner:
                 "replay.reconcile",
                 segment=driver._segment_seq,
                 steps=len(seg.steps),
-            ), self.store.transaction():
+            ), self.store.transaction(epoch_exempt=True):
+                # epoch_exempt: the segment's own staged writes are the
+                # deltas the driver's lower-cache already tracks; only
+                # OUT-OF-BAND writes may move the store mutation epoch
+                # (and thereby invalidate the cache).  A rollback takes
+                # the explicit invalidation path (note_reconcile_fault).
                 for batch, outcome in zip(batches, seg.steps):
                     FAULTS.check("replay.reconcile")
                     self._stage_device_step(batch, outcome, evictions)
@@ -430,12 +435,23 @@ class ScenarioRunner:
                 # consumes the supported PREFIX of the window (possibly
                 # shorter than K for full-record segments or mid-window
                 # vocabulary misses) and pads on-device to the compiled
-                # shape.
-                seg_keys = keys[i : i + driver.k]
-                batches = [by_step[s] for s in seg_keys]
+                # shape.  Two windows' worth of batches ride along as
+                # LOOKAHEAD: while this window's dispatch runs on the
+                # watchdogged worker, the driver pre-lowers the next
+                # window's store-independent prefix on this thread (the
+                # double-buffered pipeline, engine/replay.py
+                # _prelower_next).  The inner batch lists are the same
+                # objects every iteration (by_step), so the speculative
+                # prefix can be matched to the window that actually runs
+                # next by identity alone.
+                batches = [by_step[s] for s in keys[i : i + 2 * driver.k]]
                 seg = driver.try_segment(batches)
                 if seg is not None and self._commit_segment(
-                    seg_keys, batches, seg, driver, result
+                    keys[i : i + len(seg.steps)],
+                    batches[: len(seg.steps)],
+                    seg,
+                    driver,
+                    result,
                 ):
                     i += len(seg.steps)
                     continue

@@ -61,6 +61,11 @@ class _Txn:
 
     pre: dict = field(default_factory=dict)  # (kind, key) -> obj | _MISSING
     events: list = field(default_factory=list)
+    # True for the device-replay segment reconcile: its writes are the
+    # segment's OWN deltas, which the replay lower-cache already tracks,
+    # so they must not bump the mutation epoch (see ClusterStore
+    # docstring / mutation_epoch).
+    epoch_exempt: bool = False
 
 
 @dataclass(frozen=True, slots=True)
@@ -125,11 +130,25 @@ class ClusterStore:
         self._node_of: dict[str, str] = {}
         # Open transaction (``transaction()``); None outside one.
         self._txn: _Txn | None = None
+        # Mutation epoch: bumped by EVERY write except those staged in an
+        # ``epoch_exempt`` transaction (the device-replay segment
+        # reconcile, whose deltas the ReplayDriver's lower-cache tracks
+        # itself).  The cache keys its validity on this counter: any
+        # out-of-band write — a server handler, the write-back loop, a
+        # per-pass fallback step, test scaffolding — moves the epoch and
+        # strictly invalidates the cached lowered universe at the next
+        # segment lower (engine/replay.py _LowerCache).
+        self._mutation_epoch = 0
+
+    @property
+    def mutation_epoch(self) -> int:
+        with self._lock:
+            return self._mutation_epoch
 
     # -- transactions -------------------------------------------------------
 
     @contextlib.contextmanager
-    def transaction(self):
+    def transaction(self, *, epoch_exempt: bool = False):
         """All-or-nothing write batch.
 
         Holds the store lock for the whole block (readers in OTHER
@@ -144,12 +163,15 @@ class ClusterStore:
         Used by the device-replay segment reconcile (scenario/runner.py)
         so an injected mid-reconcile fault — or a parity-check failure —
         can never leave a partially applied segment in the store.
-        Nesting is not supported; ``restore`` inside a transaction is
-        refused."""
+        ``epoch_exempt=True`` (the segment reconcile only) keeps the
+        batch's writes from bumping ``mutation_epoch``: the replay
+        lower-cache tracks those deltas itself, and only OUT-OF-BAND
+        writes must invalidate it.  Nesting is not supported;
+        ``restore`` inside a transaction is refused."""
         with self._lock:
             if self._txn is not None:
                 raise RuntimeError("nested store transactions are not supported")
-            txn = _Txn()
+            txn = _Txn(epoch_exempt=epoch_exempt)
             self._txn = txn
             try:
                 yield self
@@ -300,6 +322,14 @@ class ClusterStore:
                 return copy.deepcopy(self._objects[kind][key])
             except KeyError:
                 raise NotFoundError(f"{kind} {key!r} not found") from None
+
+    def contains(self, kind: str, name: str, namespace: str = "") -> bool:
+        """Keyed membership probe — no deep copy, no NotFoundError (the
+        replay lowering's deferred store-membership checks run one probe
+        per window event on the hot cache-hit path)."""
+        self._check_kind(kind)
+        with self._lock:
+            return _key(kind, name, namespace) in self._objects[kind]
 
     def list(self, kind: str, namespace: str = "", *, copy_objs: bool = True) -> list[JSON]:
         """List objects sorted by name.  ``copy_objs=False`` returns the
@@ -522,11 +552,15 @@ class ClusterStore:
             self._watchers = [(w, ks) for (w, ks) in self._watchers if w is not q]
 
     def _notify(self, event: WatchEvent) -> None:
-        if self._txn is not None:
+        txn = self._txn
+        if txn is not None:
+            if not txn.epoch_exempt:
+                self._mutation_epoch += 1
             # Staged: delivery (history + watcher queues) happens at
             # commit, in write order; rollback drops the event unseen.
-            self._txn.events.append(event)
+            txn.events.append(event)
             return
+        self._mutation_epoch += 1
         self._deliver(event)
 
     def _deliver(self, event: WatchEvent) -> None:

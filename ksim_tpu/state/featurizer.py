@@ -206,6 +206,17 @@ class Featurizer:
         # re-scanning 15k+ bound pods per pass was the single largest
         # steady-state featurize cost.
         self._bound_vol_count = 0
+        # O(delta) evidence counters: per-pod base-row computations that
+        # actually RAN vs. ones served from the identity memo.  A caller
+        # with an identity-stable queue (the replay lower-cache keeps
+        # surviving universe pods' objects alive across segments) should
+        # see ``pod_rows_built`` grow with its per-window object churn,
+        # not with the universe size — the counter the bench /
+        # ``make lock-check`` O(delta) guard reads (docs/churn_floor.md
+        # "Incremental lowering + pipelined executor").
+        self.pod_rows_built = 0
+        self.pod_rows_reused = 0
+        self.featurize_passes = 0
 
     def advance_slots(self, nodes: Sequence[JSON]) -> None:
         """Advance the persistent node-slot history WITHOUT featurizing.
@@ -451,6 +462,8 @@ class Featurizer:
         phas = np.zeros(PP, dtype=bool)
         base_set = set(BASE_RESOURCES)
 
+        self.featurize_passes += 1
+
         def pod_base(p: JSON, j: int):
             """One memo entry bundling the pod's base-row pieces — a
             saturated churn pass re-featurizes ~1k unchanged pods, and
@@ -458,7 +471,9 @@ class Featurizer:
             key = ("podbase", objcache.ref_id(p), units_token)
             hit = objcache.get(key)
             if hit is not objcache.MISS:
+                self.pod_rows_reused += 1
                 return hit
+            self.pod_rows_built += 1
             reqs = pod_reqs[j]
             # Upstream fitsRequest early-exit predicate: base requests all
             # zero AND no scalar-resource key present (a zero-valued

@@ -148,3 +148,22 @@ def test_churn_lock_50k_stepwise_device_vs_per_pass():
     # stream rejected), and even without it the tail step fell back.
     assert driver.fallback_steps == 0, driver.unsupported
     assert driver.device_steps == len(dev.steps)
+    # Incremental lowering (round 10), asserted with the cache and the
+    # double-buffered prelower fully ON (they are the defaults the
+    # counts above were just produced under):
+    cache = driver.stats()["lower_cache"]
+    # (a) a clean stream keeps the lowered-universe cache hot — every
+    # segment after the first is a hit and nothing ever flushed it;
+    assert cache["misses"] == 1 and cache["invalidations"] == 0, cache
+    assert cache["hits"] == driver.device_round_trips - 1
+    # every non-final window's speculative prefix was consumed;
+    assert driver.prelower_discarded == 0
+    assert driver.prelower_consumed == driver.prelower_windows
+    # (b) the counter-based O(delta) guard: every steady-state (cache
+    # hit) segment built fresh featurize rows proportional to ITS
+    # window's events — never to the universe size.  Counters, not
+    # timings, so the guard is stable in CI.
+    steady = [e for e in driver.lower_log if e["cache_hit"]]
+    assert steady, driver.lower_log
+    for entry in steady:
+        assert entry["rows_built"] <= entry["events"] + 32, entry

@@ -1,0 +1,413 @@
+"""Incremental segment lowering: lower-cache validity + pipelined
+executor containment (docs/churn_floor.md "Incremental lowering +
+pipelined executor (round 10)").
+
+The lowered-universe cache makes per-segment host lowering O(delta); its
+entire correctness story is STRICT invalidation — any path the
+incremental bookkeeping cannot track (a per-pass fallback step, a
+rolled-back segment reconcile, an out-of-band store write, a breaker
+trip) must flush it, and the behavior locks must hold byte-identically
+with the cache and the double-buffered prelower fully on.  Small-stream
+probes (tier-1) pin the mechanics against per-pass ground truth; the
+slow-marked 6k runs pin the locked counts (repo CLAUDE.md) under each
+invalidation class and run via ``make faults`` / the full suite.
+"""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+from ksim_tpu.faults import FAULTS
+from ksim_tpu.scenario import ScenarioRunner, churn_scenario
+from ksim_tpu.scenario.runner import Operation
+from ksim_tpu.state.cluster import ClusterStore
+
+LOCK = (2524, 471)  # scheduled/unschedulable, seed 0 / 2000 nodes / 6k events
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(autouse=True)
+def _f32_fast_mode():
+    jax.config.update("jax_enable_x64", False)
+    yield
+    jax.config.update("jax_enable_x64", True)
+
+
+# ---------------------------------------------------------------------------
+# Store mutation epoch (the cache's validity anchor)
+# ---------------------------------------------------------------------------
+
+
+def test_store_mutation_epoch_semantics():
+    """Every write bumps the epoch EXCEPT writes staged in an
+    epoch-exempt transaction (the segment reconcile); a rollback never
+    delivers events and exempt writes never move the epoch either way."""
+    store = ClusterStore()
+    e0 = store.mutation_epoch
+    store.create("nodes", {"metadata": {"name": "n1"}})
+    assert store.mutation_epoch == e0 + 1
+    store.patch("nodes", "n1", "", lambda o: o["metadata"].setdefault("labels", {}))
+    assert store.mutation_epoch == e0 + 2
+    store.delete("nodes", "n1")
+    assert store.mutation_epoch == e0 + 3
+
+    # Exempt transaction: commit moves the store, not the epoch.
+    e1 = store.mutation_epoch
+    with store.transaction(epoch_exempt=True):
+        store.create("nodes", {"metadata": {"name": "n2"}})
+    assert store.mutation_epoch == e1
+    # Non-exempt transaction: its writes count.
+    with store.transaction():
+        store.create("nodes", {"metadata": {"name": "n3"}})
+    assert store.mutation_epoch == e1 + 1
+    # Exempt rollback: store restored, epoch still untouched.
+    with pytest.raises(RuntimeError):
+        with store.transaction(epoch_exempt=True):
+            store.create("nodes", {"metadata": {"name": "n4"}})
+            raise RuntimeError("abort")
+    assert store.mutation_epoch == e1 + 1
+    assert len(store.list("nodes")) == 2  # n2, n3
+
+
+# ---------------------------------------------------------------------------
+# Small-stream probes (tier-1): mechanics against per-pass ground truth
+# ---------------------------------------------------------------------------
+
+
+def _small_ops(extra=()):
+    ops = list(churn_scenario(7, n_nodes=24, n_events=600, ops_per_step=40))
+    ops.extend(extra)
+    return ops
+
+
+def _signature(res, store):
+    return (
+        res.pods_scheduled,
+        res.unschedulable_attempts,
+        [(s.step, s.scheduled, s.unschedulable, s.pending_after) for s in res.steps],
+        {
+            f"{p['metadata']['namespace']}/{p['metadata']['name']}": p["spec"].get(
+                "nodeName"
+            )
+            for p in store.list("pods")
+        },
+    )
+
+
+def _run(ops, device, runner_cls=ScenarioRunner, k=8):
+    runner = runner_cls(
+        max_pods_per_pass=64, device_replay=device, device_segment_steps=k
+    )
+    res = runner.run(list(ops))
+    return runner, _signature(res, runner.store)
+
+
+def test_cache_and_pipeline_match_per_pass_small():
+    """The steady-state happy path: cache hits + consumed speculative
+    prefixes, zero invalidations, and stepwise equality with the
+    per-pass ground truth."""
+    ops = _small_ops()
+    _base, sig_base = _run(ops, device=False)
+    dev, sig_dev = _run(ops, device=True)
+    assert sig_dev == sig_base
+    d = dev.replay_driver
+    cache = d.stats()["lower_cache"]
+    assert cache["hits"] >= 1
+    assert cache["invalidations"] == 0
+    assert d.prelower_consumed >= 1
+    assert d.prelower_discarded == 0
+    # O(delta): every cache-hit lower built at most O(window events)
+    # fresh featurize rows, never the whole universe.
+    for entry in d.lower_log:
+        if entry["cache_hit"]:
+            assert entry["rows_built"] <= entry["events"] + 32
+
+
+def test_mid_stream_fallback_discards_prefix_and_invalidates():
+    """An op outside the tensor vocabulary (a patch) forces a per-pass
+    fallback mid-stream: the speculative prefix for the shifted window
+    is discarded, the cache strictly invalidates, and — because the
+    per-pass path is the ground truth being fallen back to — the
+    outcomes still match the pure per-pass replay exactly."""
+    # An inert node-annotation patch: the per-pass path applies it (no
+    # scheduling effect), the device path rejects the step (op:patch).
+    # The target must exist at the patch step — replay the node events
+    # up to it to pick one that does.
+    base = _small_ops()
+    live: set[str] = set()
+    for op in sorted(base, key=lambda o: o.step):
+        if op.step > 8:
+            break
+        if op.kind == "nodes":
+            if op.op == "create":
+                live.add(op.obj["metadata"]["name"])
+            elif op.op == "delete":
+                live.discard(op.name)
+    patch = Operation(
+        step=8,
+        op="patch",
+        kind="nodes",
+        obj={"metadata": {"annotations": {"oob": "1"}}},
+        name=sorted(live)[0],
+    )
+    ops = base + [patch]
+    # K=4 so enough windows run on BOTH sides of the fallback to observe
+    # the cache warming, flushing, and warming again.
+    _base, sig_base = _run(ops, device=False, k=4)
+    dev, sig_dev = _run(ops, device=True, k=4)
+    assert sig_dev == sig_base
+    d = dev.replay_driver
+    assert d.fallback_steps >= 1
+    assert d.unsupported.get("op:patch/nodes", 0) >= 1
+    cache = d.stats()["lower_cache"]
+    assert cache["invalidations"] >= 1
+    # The head-rejected window never reaches _take_spec (the pre-span
+    # op screen rejects first), so its speculative prefix is discarded
+    # by the fallback wrapper; untouched windows still consume theirs.
+    assert d.prelower_consumed >= 1
+    assert d.prelower_discarded >= 1
+    # The cache recovers after the fallback: at least one pre-fallback
+    # hit and at least one post-rebuild hit.
+    assert cache["hits"] >= 2
+
+
+def test_unpredicted_window_shift_discards_speculative_prefix():
+    """A device error mid-stream shifts the next window by ONE step
+    instead of the speculated n_steps: the held prefix can no longer
+    match and must be discarded, never consumed against the wrong
+    window."""
+    # call:1 — the FIRST dispatch fails, while a speculative prefix for
+    # the window after it is already held (a later fault could land on
+    # the stream tail, where there is nothing left to speculate about).
+    FAULTS.arm("replay.dispatch", "call:1")
+    ops = _small_ops()
+    _base, sig_base = _run(ops, device=False)
+    dev, sig_dev = _run(ops, device=True)
+    assert sig_dev == sig_base
+    d = dev.replay_driver
+    assert FAULTS.fired("replay.dispatch") == 1
+    assert d.device_errors == 1
+    # The prefix speculated during the failed dispatch was discarded
+    # (the window it predicted never ran).  No invalidation: the fault
+    # hit before the cache ever became valid — invalidate() counts only
+    # flushes of real state (the 6k rollback test covers the warm case).
+    assert d.prelower_discarded >= 1
+    assert d.stats()["lower_cache"]["invalidations"] == 0
+
+
+class _OutOfBandRunner(ScenarioRunner):
+    """Writes an inert object to the store after each committed segment
+    — the out-of-band mutation class the epoch counter exists to catch.
+    A PriorityClass no pod references cannot change any outcome."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._oob = 0
+
+    def _commit_segment(self, *a, **kw):
+        out = super()._commit_segment(*a, **kw)
+        if out:
+            self._oob += 1
+            self.store.create(
+                "priorityclasses",
+                {"metadata": {"name": f"oob-{self._oob}"}, "value": 7},
+            )
+        return out
+
+
+def test_out_of_band_store_write_invalidates_cache_small():
+    ops = _small_ops()
+    _base, sig_base = _run(ops, device=False)
+    dev, sig_dev = _run(ops, device=True, runner_cls=_OutOfBandRunner)
+    assert sig_dev == sig_base
+    cache = dev.replay_driver.stats()["lower_cache"]
+    # Every post-commit write moved the epoch, so every subsequent
+    # lower rebuilt from the store instead of trusting the cache.
+    assert cache["invalidations"] >= 1
+    assert cache["hits"] == 0
+
+
+def test_stale_featurizer_slot_name_survives_lowering():
+    """A node deleted on a per-pass step whose scheduling pass has an
+    EMPTY queue lingers in the service featurizer's slot map (the
+    canonical path skips the sync entirely).  The next lowered window's
+    incremental rank seed iterates that map and must SKIP the stale
+    name — it has no universe slot — instead of raising KeyError."""
+    from tests.helpers import make_node, make_pod
+
+    def ops():
+        out = [
+            Operation(step=0, op="create", kind="nodes", obj=make_node(f"n{i}"))
+            for i in range(3)
+        ]
+        out.append(Operation(step=0, op="create", kind="pods", obj=make_pod("p0")))
+        # Step 1 runs per-pass (the patch is an op-vocabulary head miss)
+        # and its pass sees an empty queue (p0 bound at step 0), so the
+        # featurizer never syncs away the deleted n2.
+        out.append(
+            Operation(
+                step=1,
+                op="patch",
+                kind="nodes",
+                obj={"metadata": {"annotations": {"x": "1"}}},
+                name="n0",
+            )
+        )
+        out.append(Operation(step=1, op="delete", kind="nodes", name="n2"))
+        # Step 2 lowers on-device again, with n2 still in the slot map.
+        out.append(Operation(step=2, op="create", kind="pods", obj=make_pod("p1")))
+        return out
+
+    _base, sig_base = _run(ops(), device=False)
+    dev, sig_dev = _run(ops(), device=True)
+    assert sig_dev == sig_base
+    # Both the pre-patch window and the post-delete window ran on-device
+    # (the KeyError class would have crashed the second lowering).
+    assert dev.replay_driver.device_steps >= 2
+
+
+class _SchedReconfigRunner(ScenarioRunner):
+    """Swaps the scheduler profile set after the FIRST committed segment
+    — the epoch-BLIND out-of-band mutation class: apply_scheduler_config
+    writes no store object, so only the cache's sched_names token can
+    see that the cached survivors' support screen is stale."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._reconfigured = False
+
+    def _commit_segment(self, *a, **kw):
+        out = super()._commit_segment(*a, **kw)
+        if out and not self._reconfigured:
+            self._reconfigured = True
+            self.service.apply_scheduler_config(
+                {"profiles": [{"schedulerName": "other-sched"}]}, trusted=True
+            )
+        return out
+
+
+def test_scheduler_reconfig_invalidates_cache_small():
+    """After the swap every pending pod is foreign to the new profile.
+    The rebuilt (NOT cached) screen must reject the next window to the
+    per-pass path — whose queue skips foreign pods too — so scheduling
+    stops at the swap instead of the stale cached universe smuggling
+    default-profile pods onto the device."""
+    ops = _small_ops()
+    _clean, sig_clean = _run(ops, device=True)
+    dev = _SchedReconfigRunner(
+        max_pods_per_pass=64, device_replay=True, device_segment_steps=8
+    )
+    res = dev.run(list(ops))
+    d = dev.replay_driver
+    cache = d.stats()["lower_cache"]
+    assert cache["invalidations"] >= 1
+    assert d.unsupported.get("foreign_scheduler", 0) >= 1
+    assert d.fallback_steps >= 1
+    # Strictly fewer binds than the un-reconfigured run: nothing
+    # schedules after the first (K=8) segment commits.
+    assert res.pods_scheduled < sig_clean[0]
+    assert all(s.scheduled == 0 for s in res.steps if s.step >= 8)
+
+
+def test_prelower_fault_degrades_window_only_small():
+    """An armed fault in the SPECULATIVE prefix loses that window's
+    overlap and nothing else: no fallback step, no cache flush, same
+    outcomes."""
+    FAULTS.arm("replay.prelower", "call:1")
+    ops = _small_ops()
+    _base, sig_base = _run(ops, device=False)
+    dev, sig_dev = _run(ops, device=True)
+    assert sig_dev == sig_base
+    d = dev.replay_driver
+    assert FAULTS.fired("replay.prelower") == 1
+    assert d.prelower_faults == 1
+    assert d.fallback_steps == 0
+    assert d.stats()["lower_cache"]["invalidations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The locked 6k prefix under each invalidation class (slow; make faults)
+# ---------------------------------------------------------------------------
+
+
+def _run_6k(runner_cls=ScenarioRunner):
+    runner = runner_cls(
+        max_pods_per_pass=1024,
+        pod_bucket_min=128,
+        device_replay=True,
+        device_segment_steps=16,
+    )
+    res = runner.run(
+        churn_scenario(0, n_nodes=2000, n_events=6000, ops_per_step=100)
+    )
+    return runner, res
+
+
+def _assert_lock(res, driver):
+    assert (res.pods_scheduled, res.unschedulable_attempts) == LOCK
+    assert driver.device_steps + driver.fallback_steps == len(res.steps)
+
+
+@pytest.mark.slow
+def test_lock_holds_with_midstream_fallback_invalidation_6k():
+    """A mid-stream lowering fault forces one window per-pass: the
+    speculative prefix is discarded, the cache flushes and then
+    recovers, and the locked counts hold byte-identically."""
+    FAULTS.arm("replay.lower", "call:2")
+    runner, res = _run_6k()
+    d = runner.replay_driver
+    _assert_lock(res, d)
+    assert FAULTS.fired("replay.lower") == 1
+    cache = d.stats()["lower_cache"]
+    assert cache["invalidations"] >= 1
+    assert cache["hits"] >= 1  # recovered after the fallback
+
+
+@pytest.mark.slow
+def test_lock_holds_with_rollback_invalidation_6k():
+    """A mid-reconcile injected fault rolls the segment back
+    (ClusterStore.transaction abort): the cache flushes, the head step
+    re-runs per-pass, and the locked counts hold.  call:17 = the FIRST
+    step of the SECOND segment's reconcile (the site fires per step,
+    K=16), so the cache is warm when the rollback flushes it."""
+    FAULTS.arm("replay.reconcile", "call:17")
+    runner, res = _run_6k()
+    d = runner.replay_driver
+    _assert_lock(res, d)
+    assert FAULTS.fired("replay.reconcile") == 1
+    assert d.unsupported.get("reconcile_fault") == 1
+    assert d.stats()["lower_cache"]["invalidations"] >= 1
+    # The prefix speculated during the rolled-back segment's dispatch
+    # predicted a window that never ran: discarded, not consumed.
+    assert d.prelower_discarded >= 1
+
+
+@pytest.mark.slow
+def test_lock_holds_with_out_of_band_writes_6k():
+    runner, res = _run_6k(runner_cls=_OutOfBandRunner)
+    d = runner.replay_driver
+    _assert_lock(res, d)
+    cache = d.stats()["lower_cache"]
+    assert cache["invalidations"] >= 1
+    assert cache["hits"] == 0
+
+
+@pytest.mark.slow
+def test_lock_holds_with_prelower_fault_6k():
+    """The replay.prelower fault site (faults.SITES): an armed fault in
+    the speculative prefix degrades that window's overlap only — every
+    step still runs on-device and the locked counts hold."""
+    FAULTS.arm("replay.prelower", "call:1")
+    runner, res = _run_6k()
+    d = runner.replay_driver
+    _assert_lock(res, d)
+    assert FAULTS.fired("replay.prelower") == 1
+    assert d.prelower_faults == 1
+    assert d.fallback_steps == 0

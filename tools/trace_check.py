@@ -153,6 +153,13 @@ def main() -> None:
                     f"{span}: {names.get(span, 0)} spans for {segments} "
                     f"dispatched segments"
                 )
+        # The double-buffered executor (round 10) pre-lowers every
+        # non-final window's successor while its dispatch is in flight.
+        if segments > 1 and not names.get("replay.prelower"):
+            _fail("pipelined run recorded no replay.prelower spans")
+        cache = result.get("lower_cache", {})
+        if segments > 1 and not cache.get("hits"):
+            _fail(f"lowered-universe cache never hit across {segments} segments: {cache}")
         # device_round_trips counts HEALTHY dispatches only (errored
         # ones never increment it); of those, post-dispatch validation
         # discards return before any reconcile, and a reconcile that
