@@ -13,7 +13,10 @@ test:
 # lower-cache + double-buffered prelower fully ON (round 10), plus the
 # counter-based O(delta) guard (steady-state featurize rows scale with
 # window events, not universe size).  ~10-20 min on CPU.
-lock-check:
+# The analyzer gates the lock run: a lock/kernel/registry contract
+# violation is exactly the class of bug the 50k stepwise run exists to
+# catch, and lint finds it in seconds instead of minutes.
+lock-check: lint
 	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_device_vs_per_pass -q -rs -m slow
 
 # The fault suite (docs/faults.md) on CPU in the sanitized environment
@@ -21,12 +24,15 @@ lock-check:
 # wedges jax init on a dead chip) — runnable under ANY hardware state.
 # -m '' overrides pyproject's default -m 'not slow' so the slow-marked
 # 6k fault schedules run here too (the full five-schedule matrix).
+# KSIM_STORE_STRICT=1: the sanitizer-lite store mode (docs/env.md) is
+# on for the whole fault matrix — an injected fault whose containment
+# path touched the store without the lock would fail loudly here.
 faults:
 	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
 	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
 	'tests/test_replay_faults.py', 'tests/test_fault_injection.py', \
 	'tests/test_replay_cache.py', \
-	'-q', '-m', ''], env=sanitized_cpu_env()))"
+	'-q', '-m', ''], env=sanitized_cpu_env({'KSIM_STORE_STRICT': '1'})))"
 
 # Trace-plane validation (docs/observability.md): the locked 6k prefix
 # through the device path with KSIM_TRACE_OUT set, in the sanitized CPU
@@ -58,5 +64,12 @@ perf-table:
 serve:
 	$(PY) -m ksim_tpu.cmd.simulator
 
+# Static contract analysis (docs/lint.md): compile the tree, then run
+# the AST analyzer over ksim_tpu/, bench.py and tools/ — exits nonzero
+# on any unsuppressed finding.  tools/ksimlint is stdlib-only (it never
+# imports jax, numpy or ksim_tpu), so this is safe under ANY hardware
+# condition, including the wedged-tunnel environments bench guards
+# against — no sanitized env needed.
 lint:
-	$(PY) -m compileall -q ksim_tpu
+	$(PY) -m compileall -q ksim_tpu tools bench.py
+	$(PY) -m tools.ksimlint

@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ksim_tpu.engine.kernelreg import device_kernel
 from ksim_tpu.plugins.base import (
     FilterOutput,
     NodeStateView,
@@ -638,6 +639,7 @@ class _Program:
 
     # -- compiled entry points ----------------------------------------------
 
+    @device_kernel(static=("self",))
     def _batch_eval(self, state, pods: PodBatch, aux: dict, carries: dict):
         """Traceable body shared by the chunked and fused batch entries."""
 
@@ -656,10 +658,12 @@ class _Program:
         return jax.vmap(per_pod)(pods)
 
     @partial(jax.jit, static_argnums=0)
+    @device_kernel(static=("self",))
     def _batch_fn(self, state, pods: PodBatch, aux: dict, carries: dict):
         return self._batch_eval(state, pods, aux, carries)
 
     @partial(jax.jit, static_argnums=(0, 5))
+    @device_kernel(static=("self", "block"))
     def _batch_fused_fn(
         self, state, pods: PodBatch, aux: dict, carries: dict, block: int
     ):
@@ -715,6 +719,7 @@ class _Program:
         return visited, sample, new_start
 
     @partial(jax.jit, static_argnums=0)
+    @device_kernel(static=("self",))
     def _schedule_sampled_fn(
         self, state, pods: PodBatch, aux: dict, carries: dict, start, n_real
     ):
@@ -754,6 +759,7 @@ class _Program:
         return final_state, final_carries, final_start, out
 
     @partial(jax.jit, static_argnums=0)
+    @device_kernel(static=("self",))
     def _schedule_fn(self, state, pods: PodBatch, aux: dict, carries: dict):
         def body(carry, pb: PodBatch):
             node_state, plugin_carries = carry

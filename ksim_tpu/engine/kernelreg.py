@@ -1,0 +1,49 @@
+"""Runtime registry of device-kernel functions.
+
+``@device_kernel`` marks the functions whose bodies execute under a jax
+trace — the segment program (engine/replay.py ``_segment_fn``) and the
+sequential-commit / batch programs (engine/core.py).  The decorator is
+an identity marker: it records the function (and which of its
+parameters are jit-STATIC, mirroring the adjacent ``jax.jit``
+``static_argnums``) and returns it unchanged, so it composes under
+``@partial(jax.jit, ...)`` with zero runtime cost.
+
+Two consumers:
+
+- ``tools/ksimlint``'s kernel-purity rule finds the decorator in the
+  AST and checks the marked bodies for host effects and
+  f32-determinism hazards (docs/lint.md "Kernel purity") — the
+  decorator is the contract declaration, the analyzer the enforcement;
+- tests/test_lint.py cross-checks this runtime registry against the
+  analyzer's AST view, so a kernel added without the marker (or marked
+  but unregistered) cannot drift silently.
+
+Stdlib-only by design: the registry must be importable (and the
+analyzer must be able to reason about it) without touching jax.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+#: Every registered kernel function, in import order.
+KERNELS: list[Callable] = []
+
+
+def device_kernel(fn: "Callable | None" = None, *, static: tuple[str, ...] = ()):
+    """Mark ``fn`` as a device kernel.  ``static`` names the parameters
+    that are jit-static (trace-time Python values — branching on them
+    is legal inside the body); it must mirror the ``static_argnums`` of
+    the enclosing ``jax.jit``.  Usable bare or with arguments::
+
+        @partial(jax.jit, static_argnums=(0, 1))
+        @device_kernel(static=("st", "prog"))
+        def _segment_fn(st, prog, const, ev, state0): ...
+    """
+
+    def mark(f: Callable) -> Callable:
+        f.__ksim_kernel_static__ = tuple(static)
+        KERNELS.append(f)
+        return f
+
+    return mark(fn) if fn is not None else mark

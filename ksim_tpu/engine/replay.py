@@ -87,6 +87,7 @@ from ksim_tpu.errors import (
     ReplayFallback,
     SimulatorError,
 )
+from ksim_tpu.engine.kernelreg import device_kernel
 from ksim_tpu.faults import FAULTS
 from ksim_tpu.obs import TRACE, register_provider
 from ksim_tpu.state.resources import JSON, name_of, namespace_of
@@ -395,6 +396,7 @@ class _SegmentStatics:
 # ---------------------------------------------------------------------------
 
 
+@device_kernel(static=("st",))
 def _derive_interpod(loc: dict, ipa: dict, st: _SegmentStatics) -> dict:
     """Local per-node term accumulators -> the domain-aggregated carry
     view the InterPodAffinity kernels consume (state/interpod.py
@@ -428,6 +430,7 @@ def _derive_interpod(loc: dict, ipa: dict, st: _SegmentStatics) -> dict:
 
 
 @partial(jax.jit, static_argnums=(0, 1))
+@device_kernel(static=("st", "prog"))
 def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
     """Run K scenario steps on-device.
 
@@ -1109,21 +1112,27 @@ class ReplayDriver:
         # record="full" segments run at a shorter fixed K (their stacked
         # result tensors multiply device memory by K).
         self._full_k = max(1, min(self.k, FULL_SEGMENT_STEPS))
-        # Evidence counters (the bench rung reports them).
-        self.device_steps = 0
-        self.fallback_steps = 0
-        self.device_round_trips = 0  # one per segment dispatch group
-        self.unsupported: dict[str, int] = {}
+        # Evidence counters (the bench rung reports them).  guarded-by:
+        # main-thread — the driver's mutable state is thread-confined:
+        # only the main thread writes it; the watchdogged dispatch
+        # worker (``_run``, annotated worker-thread below) must stay
+        # side-effect-free on the driver so an abandoned late-finishing
+        # worker can never corrupt the degraded run's accounting.
+        # tools/ksimlint's lock-discipline rule enforces the write side.
+        self.device_steps = 0  # guarded-by: main-thread
+        self.fallback_steps = 0  # guarded-by: main-thread
+        self.device_round_trips = 0  # guarded-by: main-thread
+        self.unsupported: dict[str, int] = {}  # guarded-by: main-thread
         # Failure-containment state — PER DRIVER, never process-global
         # (two runners in one process must not trip each other's
         # breaker).  The bench rung and runner stats surface all of it.
         self.watchdog_s = _watchdog_seconds()
         self.breaker_threshold = max(_breaker_threshold(), 1)
-        self.device_errors = 0  # dispatches that degraded to the host path
-        self.watchdog_timeouts = 0  # subset of device_errors
-        self.breaker_tripped = False  # sticky: device path disabled
-        self._consecutive_device_errors = 0
-        self._consecutive_reconcile_faults = 0
+        self.device_errors = 0  # guarded-by: main-thread (degraded dispatches)
+        self.watchdog_timeouts = 0  # guarded-by: main-thread (subset of above)
+        self.breaker_tripped = False  # guarded-by: main-thread (sticky)
+        self._consecutive_device_errors = 0  # guarded-by: main-thread
+        self._consecutive_reconcile_faults = 0  # guarded-by: main-thread
         # Segment sequence number (trace-span correlation id: every
         # lower/dispatch/reconcile span of one window shares it).
         self._segment_seq = 0
@@ -1133,11 +1142,11 @@ class ReplayDriver:
         # cache advances from, and the device-resident constant-buffer
         # reuse map ({id(host array): (host ref, device array)} from the
         # previous dispatch; the host ref pins the id).
-        self._cache = _LowerCache()
-        self._spec: "tuple[tuple[int, ...], _WindowSpec] | None" = None
-        self._last_plan: "_SegmentPlan | None" = None
-        self._dev_consts: dict[int, tuple[Any, Any]] = {}
-        self._dev_consts_x64: "bool | None" = None
+        self._cache = _LowerCache()  # guarded-by: main-thread
+        self._spec: "tuple[tuple[int, ...], _WindowSpec] | None" = None  # guarded-by: main-thread
+        self._last_plan: "_SegmentPlan | None" = None  # guarded-by: main-thread
+        self._dev_consts: dict[int, tuple[Any, Any]] = {}  # guarded-by: main-thread
+        self._dev_consts_x64: "bool | None" = None  # guarded-by: main-thread
         # Default: ON where re-transfer is the only cost (cpu backend),
         # OFF on the axon remote-tunnel runtime — pinning extra live
         # device buffers there slows every subsequent execution/transfer
@@ -1154,13 +1163,13 @@ class ReplayDriver:
         # guard).  ``lower_log`` records one entry per successful lower:
         # the window's event count vs the fresh per-pod featurize rows it
         # actually built — the counter-based O(delta) guard's input.
-        self.prelower_windows = 0
-        self.prelower_consumed = 0
-        self.prelower_discarded = 0
-        self.prelower_faults = 0
-        self.dev_const_hits = 0
-        self.dev_const_misses = 0
-        self.lower_log: list[dict] = []
+        self.prelower_windows = 0  # guarded-by: main-thread
+        self.prelower_consumed = 0  # guarded-by: main-thread
+        self.prelower_discarded = 0  # guarded-by: main-thread
+        self.prelower_faults = 0  # guarded-by: main-thread
+        self.dev_const_hits = 0  # guarded-by: main-thread
+        self.dev_const_misses = 0  # guarded-by: main-thread
+        self.lower_log: list[dict] = []  # guarded-by: main-thread
         # The live driver's degradation evidence rides in the merged
         # /api/v1/metrics document (latest driver wins — one per
         # ScenarioRunner run).  Weakly referenced: the module-global
@@ -1660,7 +1669,7 @@ class ReplayDriver:
             return out
         box: dict[str, Any] = {}
 
-        def work() -> None:
+        def work() -> None:  # ksimlint: worker-thread
             try:
                 box["out"] = self._run(plan)
             except BaseException as e:  # classified by the caller
@@ -2421,7 +2430,7 @@ class ReplayDriver:
             )
         return out
 
-    def _run(self, plan: "_SegmentPlan") -> "SegmentOutcome | str":
+    def _run(self, plan: "_SegmentPlan") -> "SegmentOutcome | str":  # ksimlint: worker-thread
         """Dispatch one lowered segment and decode its outputs.
 
         Returns the SegmentOutcome, or a DISCARD REASON string when

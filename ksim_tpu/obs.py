@@ -300,15 +300,16 @@ class TracePlane:
         # convenience activation must never override an operator's
         # stated choice.
         self._user_disabled = False
-        self._ring_on = False
+        self._ring_on = False  # guarded-by: _lock
         self._jax_bridge = False
         self.out_path: str | None = None
-        self._epoch_ns = time.perf_counter_ns()
-        self._hist: dict[str, LatencyHistogram] = {}
-        self._counters: dict[str, int] = {}
-        self._ring: deque = deque(maxlen=65536)
-        self._appended = 0  # ring pressure evidence (dropped = appended - len)
-        self._thread_names: dict[int, str] = {}
+        self._epoch_ns = time.perf_counter_ns()  # guarded-by: _lock
+        self._hist: dict[str, LatencyHistogram] = {}  # guarded-by: _lock
+        self._counters: dict[str, int] = {}  # guarded-by: _lock
+        self._ring: deque = deque(maxlen=65536)  # guarded-by: _lock
+        # guarded-by: _lock (ring pressure evidence: dropped = appended - len)
+        self._appended = 0
+        self._thread_names: dict[int, str] = {}  # guarded-by: _lock
 
     # -- configuration ---------------------------------------------------
 
@@ -433,7 +434,7 @@ class TracePlane:
                     }
                 )
 
-    def _note_thread(self, tid: int) -> None:
+    def _note_thread(self, tid: int) -> None:  # ksimlint: lock-held(_lock)
         if tid not in self._thread_names:
             t = threading.current_thread()
             self._thread_names[tid] = t.name
@@ -520,7 +521,7 @@ class TracePlane:
 # Stats providers (non-timing evidence merged into /api/v1/metrics)
 # ---------------------------------------------------------------------------
 
-_providers: dict[str, Callable[[], dict]] = {}
+_providers: dict[str, Callable[[], dict]] = {}  # guarded-by: _providers_lock
 _providers_lock = threading.Lock()
 
 #: Top-level sections of the merged /api/v1/metrics document that a

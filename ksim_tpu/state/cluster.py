@@ -17,6 +17,7 @@ import collections
 import contextlib
 import copy
 import itertools
+import os
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -100,11 +101,21 @@ class ClusterStore:
     # relist, like an etcd compaction would.
     HISTORY_DEPTH = 8192
 
-    def __init__(self) -> None:
+    def __init__(self, *, strict: "bool | None" = None) -> None:
         self._lock = threading.RLock()
-        self._rv = itertools.count(1)
-        self._objects: dict[str, dict[str, JSON]] = {k: {} for k in KINDS}
-        self._watchers: list[tuple[queue.SimpleQueue, frozenset[str]]] = []
+        # Sanitizer-lite (docs/lint.md "Lock discipline", docs/env.md):
+        # strict mode makes every internal mutator assert the store
+        # lock is held by the calling thread.  Debug-only, off by
+        # default; KSIM_STORE_STRICT=1 flips the default (the
+        # concurrency-stress tests and the make-faults matrix run with
+        # it on).
+        self._strict = (
+            os.environ.get("KSIM_STORE_STRICT", "") == "1" if strict is None else strict
+        )
+        self._rv = itertools.count(1)  # guarded-by: _lock
+        self._objects: dict[str, dict[str, JSON]] = {k: {} for k in KINDS}  # guarded-by: _lock
+        self._watchers: list[tuple[queue.SimpleQueue, frozenset[str]]] = []  # guarded-by: _lock
+        # guarded-by: _lock
         self._history: "collections.deque[tuple[int, WatchEvent]]" = (
             collections.deque(maxlen=self.HISTORY_DEPTH)
         )
@@ -113,23 +124,23 @@ class ClusterStore:
         # key).  The scheduler lists every kind every pass and churn
         # replay mutates membership every step — re-sorting thousands of
         # unchanged objects per list() dominated churn-replay host time.
-        self._sorted_keys: dict[str, list[tuple[str, str]]] = {k: [] for k in KINDS}
+        self._sorted_keys: dict[str, list[tuple[str, str]]] = {k: [] for k in KINDS}  # guarded-by: _lock
         # Pod partition by spec.nodeName presence (phase-agnostic; the
         # consumers apply their own phase/queue predicates).  The
         # scheduler walks "all pods" several times per pass only to pick
         # one side of this split — at churn scale those O(pods) walks
         # over a 15k+ population dominated saturated host time.  Values
         # are the same live frozen dicts ``_objects`` holds.
-        self._with_node: dict[str, JSON] = {}
-        self._without_node: dict[str, JSON] = {}
+        self._with_node: dict[str, JSON] = {}  # guarded-by: _lock
+        self._without_node: dict[str, JSON] = {}  # guarded-by: _lock
         # Secondary index: nodeName -> {pod key -> live obj}.  Node-drain
         # requeue asks "which pods are bound to THESE nodes" — walking
         # the whole bound side per drained node (~10s of the 50k churn
         # replay) against a dict-bucket lookup.
-        self._by_node: dict[str, dict[str, JSON]] = {}
-        self._node_of: dict[str, str] = {}
+        self._by_node: dict[str, dict[str, JSON]] = {}  # guarded-by: _lock
+        self._node_of: dict[str, str] = {}  # guarded-by: _lock
         # Open transaction (``transaction()``); None outside one.
-        self._txn: _Txn | None = None
+        self._txn: _Txn | None = None  # guarded-by: _lock
         # Mutation epoch: bumped by EVERY write except those staged in an
         # ``epoch_exempt`` transaction (the device-replay segment
         # reconcile, whose deltas the ReplayDriver's lower-cache tracks
@@ -138,7 +149,7 @@ class ClusterStore:
         # per-pass fallback step, test scaffolding — moves the epoch and
         # strictly invalidates the cached lowered universe at the next
         # segment lower (engine/replay.py _LowerCache).
-        self._mutation_epoch = 0
+        self._mutation_epoch = 0  # guarded-by: _lock
 
     @property
     def mutation_epoch(self) -> int:
@@ -192,19 +203,32 @@ class ClusterStore:
             for ev in txn.events:
                 self._deliver(ev)
 
-    def _touch(self, kind: str, key: str) -> None:
+    def _assert_owned(self) -> None:
+        """Sanitizer-lite hook (strict mode): raise if the calling
+        thread does not hold the store lock.  ``_is_owned`` is the
+        stdlib RLock's own ownership probe — private but stable, and
+        the only way to ask without trying to acquire."""
+        if self._strict and not self._lock._is_owned():
+            raise AssertionError(
+                "ClusterStore internal mutator called without holding the "
+                "store lock (KSIM_STORE_STRICT)"
+            )
+
+    def _touch(self, kind: str, key: str) -> None:  # ksimlint: lock-held(_lock)
         """Record a key's first-touch pre-image (callers hold the lock
         and are about to mutate the key)."""
+        self._assert_owned()
         txn = self._txn
         if txn is not None and (kind, key) not in txn.pre:
             txn.pre[(kind, key)] = self._objects[kind].get(key, _MISSING)
 
-    def _rollback(self, txn: _Txn) -> None:
+    def _rollback(self, txn: _Txn) -> None:  # ksimlint: lock-held(_lock)
         """Restore every touched key to its pre-transaction object and
         repair the incremental indexes (callers hold the lock).  The
         (name, key) sort entry is identical for pre/current objects of
         the same key (the key embeds the name), so membership-only
         repair is exact."""
+        self._assert_owned()
         for (kind, key), pre in txn.pre.items():
             cur = self._objects[kind].get(key, _MISSING)
             if cur is pre:
@@ -224,8 +248,9 @@ class ClusterStore:
 
     # -- pod node-name index ------------------------------------------------
 
-    def _index_pod(self, key: str, obj: JSON | None) -> None:
+    def _index_pod(self, key: str, obj: JSON | None) -> None:  # ksimlint: lock-held(_lock)
         """Maintain the nodeName partition (callers hold the lock)."""
+        self._assert_owned()
         self._with_node.pop(key, None)
         self._without_node.pop(key, None)
         old_node = self._node_of.pop(key, None)
@@ -551,7 +576,8 @@ class ClusterStore:
         with self._lock:
             self._watchers = [(w, ks) for (w, ks) in self._watchers if w is not q]
 
-    def _notify(self, event: WatchEvent) -> None:
+    def _notify(self, event: WatchEvent) -> None:  # ksimlint: lock-held(_lock)
+        self._assert_owned()
         txn = self._txn
         if txn is not None:
             if not txn.epoch_exempt:
@@ -563,7 +589,8 @@ class ClusterStore:
         self._mutation_epoch += 1
         self._deliver(event)
 
-    def _deliver(self, event: WatchEvent) -> None:
+    def _deliver(self, event: WatchEvent) -> None:  # ksimlint: lock-held(_lock)
+        self._assert_owned()
         try:
             rv = int(event.obj["metadata"]["resourceVersion"])
         except (KeyError, ValueError, TypeError):
@@ -617,7 +644,10 @@ class ClusterStore:
                     self._notify(WatchEvent(kind, ADDED, restored))
 
     def _check_kind(self, kind: str) -> None:
-        if kind not in self._objects:
+        # The KINDS key set of _objects is fixed at construction (only
+        # the inner per-kind tables mutate), so this membership probe is
+        # safe before the lock — public mutators call it on their way in.
+        if kind not in self._objects:  # ksimlint: disable=lock-discipline
             raise NotFoundError(f"unknown kind {kind!r}")
 
 

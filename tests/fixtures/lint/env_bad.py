@@ -1,0 +1,5 @@
+"""Reads an env var missing from the fixture docs table."""
+
+import os
+
+FLAG = os.environ.get("KSIM_LINTFIXTURE_UNDOCUMENTED", "") == "1"

@@ -1,0 +1,5 @@
+"""Reads only variables documented in env_docs.md."""
+
+import os
+
+FLAG = os.environ.get("KSIM_LINTFIXTURE_DOCUMENTED", "") == "1"

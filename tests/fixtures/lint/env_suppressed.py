@@ -1,0 +1,7 @@
+"""The same undocumented read, inline-suppressed (plus a documented
+read so the clean docs table has no dead row in this project)."""
+
+import os
+
+FLAG = os.environ.get("KSIM_LINTFIXTURE_UNDOCUMENTED", "") == "1"  # ksimlint: disable=env-contract
+DOCUMENTED = os.environ.get("KSIM_LINTFIXTURE_DOCUMENTED", "") == "1"

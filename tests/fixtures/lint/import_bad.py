@@ -1,0 +1,18 @@
+"""Seeded import-boundary violations: module-scope accelerator import
+in a file the test declares stdlib-only, plus a parent-side function
+importing jax (only child*/_child* payloads may)."""
+
+import json  # stdlib: fine
+import numpy as np  # finding under import-time AND parent-child scopes
+
+
+def parent_helper():
+    import jax  # finding under parent-child scope
+
+    return jax, np, json
+
+
+def child_payload():
+    import jax  # sanctioned: child payload, subprocess-only
+
+    return jax

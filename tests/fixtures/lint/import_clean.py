@@ -1,0 +1,25 @@
+"""Stdlib-only module: lazy accelerator imports inside functions are
+legal under the import-time scope (that is the guarded-bridge idiom),
+and child payloads may import anything."""
+
+import json
+import os
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    import numpy  # annotation-only: never executes at import time
+
+
+def lazy_bridge():
+    try:
+        import jax
+
+        return jax
+    except Exception:
+        return None
+
+
+def _child_payload():
+    import numpy as np
+
+    return np, json, os

@@ -1,0 +1,6 @@
+"""A module-scope forbidden import with an inline suppression."""
+
+# Optional-at-import contract documented here.
+import numpy  # ksimlint: disable=import-boundary
+
+_ = numpy

@@ -1,0 +1,32 @@
+"""Seeded kernel-purity violations (tests/test_lint.py).
+
+The decorator is a local stub: the analyzer matches it by NAME in the
+AST, and this file is never imported.  Expected findings: traced
+branch, print, host coercion, numpy-on-traced, 64-bit dtype, .item(),
+and the traced branch inside the nested scan body.
+"""
+
+
+def device_kernel(fn=None, *, static=()):
+    return fn if fn is not None else (lambda f: f)
+
+
+@device_kernel(static=("cfg",))
+def impure_kernel(cfg, state, ev):
+    import numpy as np
+
+    if cfg.preempt:  # static: NOT a finding
+        pass
+    if state > 0:  # finding: traced branch
+        print("debug")  # finding: host print
+    total = float(state)  # finding: host coercion
+    host = np.sum(ev)  # finding: numpy on a traced value
+    wide = ev.astype("float64")  # finding: 64-bit dtype literal
+    scalar = ev.item()  # finding: host sync
+
+    def body(carry, x):
+        if x:  # finding: traced branch in a scan body
+            return carry
+        return carry
+
+    return total, host, wide, scalar, body

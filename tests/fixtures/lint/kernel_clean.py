@@ -1,0 +1,27 @@
+"""A pure kernel: zero findings expected.  Static-arg branching,
+shape arithmetic, comprehension filters and nested bodies are all
+legal trace-time Python."""
+
+
+def device_kernel(fn=None, *, static=()):
+    return fn if fn is not None else (lambda f: f)
+
+
+@device_kernel(static=("st", "prog"))
+def pure_kernel(st, prog, const, ev, state0):
+    import jax
+    import jax.numpy as jnp
+
+    n_scores = sum(1 for p in prog.plugins if p.enabled)
+    width = const["rows"].shape[0]
+    if st.record == "full":  # static branch: fine
+        extra = jnp.zeros((n_scores, width), jnp.int32)
+    else:
+        extra = jnp.zeros((0, width), jnp.int32)
+
+    def step(carry, e):
+        nxt = jnp.where(e >= 0, carry + e, carry)
+        return nxt, nxt
+
+    final, outs = jax.lax.scan(step, state0, ev)
+    return final, outs, extra

@@ -1,0 +1,12 @@
+"""A kernel-purity violation with an inline suppression."""
+
+
+def device_kernel(fn=None, *, static=()):
+    return fn if fn is not None else (lambda f: f)
+
+
+@device_kernel
+def debug_kernel(state):
+    # Temporary trace-time diagnostic, runs once per compile only.
+    print("tracing", state.shape)  # ksimlint: disable=kernel-purity
+    return state

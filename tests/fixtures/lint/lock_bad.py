@@ -1,0 +1,46 @@
+"""Seeded lock-discipline violations (tests/test_lint.py).
+
+NOT imported by anything — the analyzer reads it as text.  Expected
+findings: the unlocked read in ``bad_read``, the unlocked write in
+``bad_write``, the closure escape in ``bad_closure``, the module-global
+access in ``bad_global``, and the worker-thread self-write in ``_run``.
+"""
+
+import threading
+
+_registry = {}  # guarded-by: _registry_lock
+_registry_lock = threading.Lock()
+
+
+def bad_global(name):
+    return _registry.get(name)  # unlocked module-global access
+
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+        self.counter = 0  # guarded-by: main-thread
+
+    def good(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def good_held(self):  # ksimlint: lock-held(_lock)
+        return len(self._items)
+
+    def bad_read(self):
+        return list(self._items)  # unlocked read
+
+    def bad_write(self, x):
+        self._items.append(x)  # unlocked write
+
+    def bad_closure(self):
+        with self._lock:
+            def peek():
+                return self._items  # closure may outlive the with block
+
+            return peek
+
+    def _run(self):  # ksimlint: worker-thread
+        self.counter += 1  # workers must not write driver state

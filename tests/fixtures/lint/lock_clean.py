@@ -1,0 +1,32 @@
+"""Disciplined version of lock_bad: zero findings expected."""
+
+import threading
+
+_registry = {}  # guarded-by: _registry_lock
+_registry_lock = threading.Lock()
+
+
+def get_entry(name):
+    with _registry_lock:
+        return _registry.get(name)
+
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+        self.counter = 0  # guarded-by: main-thread
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def _merge(self, xs):  # ksimlint: lock-held(_lock)
+        self._items.extend(xs)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items)
+
+    def _run(self):  # ksimlint: worker-thread
+        return self.counter + 1  # reads are fine; no writes

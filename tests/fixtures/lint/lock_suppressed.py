@@ -1,0 +1,13 @@
+"""Same violation as lock_bad.bad_read, inline-suppressed."""
+
+import threading
+
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+
+    def racy_size_hint(self):
+        # Benign approximate read, documented as such.
+        return len(self._items)  # ksimlint: disable=lock-discipline

@@ -1,0 +1,25 @@
+"""Call sites with out-of-registry literals and one dynamic name.
+``FAULTS`` / ``TRACE`` are local stubs — the analyzer matches the
+call shape, the file is never imported."""
+
+
+class _Stub:
+    def check(self, site):
+        pass
+
+    def span(self, name, **kw):
+        pass
+
+    def event(self, name, **kw):
+        pass
+
+
+FAULTS = _Stub()
+TRACE = _Stub()
+
+
+def run(name):
+    FAULTS.check("rogue.site")  # finding: not in SITES
+    TRACE.span("rogue.span")  # finding: not in SPAN_NAMES
+    TRACE.event("rogue.event")  # finding: not in EVENT_NAMES
+    TRACE.event(name)  # finding: non-literal name

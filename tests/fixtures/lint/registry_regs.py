@@ -1,0 +1,8 @@
+"""Mini registries for the registry-literals fixture tests: stands in
+for faults.py (SITES) and obs.py (SPAN_NAMES / EVENT_NAMES)."""
+
+SITES: tuple = ("wired.site",)
+
+SPAN_NAMES: tuple = ("wired.site", "other.span")
+
+EVENT_NAMES: tuple = ("fault.fired", "replay.fallback", "other.event")

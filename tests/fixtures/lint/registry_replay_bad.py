@@ -1,0 +1,18 @@
+"""Stands in for engine/replay.py in the bad registry fixture: one
+unregistered call reason, one dead registry entry, one unregistered
+f-string family."""
+
+FALLBACK_REASONS: frozenset = frozenset({"known_reason", "dead_entry"})
+
+FALLBACK_REASON_PREFIXES: tuple = ("op:",)
+
+
+class Driver:
+    def _reject(self, reason):
+        pass
+
+    def lower(self, op):
+        self._reject("rogue_reason")  # finding: not in FALLBACK_REASONS
+        self._reject(f"host_hook:{op}")  # finding: family not in PREFIXES
+        self._reject(f"op:{op}")  # registered family: fine
+        return "known_reason"  # keeps known_reason alive; dead_entry is not

@@ -353,3 +353,41 @@ def test_transaction_nested_and_restore_refused():
     with pytest.raises(RuntimeError, match="restore"):
         with store.transaction():
             store.restore(boot)
+
+
+def test_strict_mode_asserts_lock_held_on_internal_mutators():
+    """Sanitizer-lite (KSIM_STORE_STRICT / strict=True, docs/lint.md):
+    internal mutators called without the store lock raise, with it (and
+    through every public API path) they work exactly as before."""
+    from ksim_tpu.state.cluster import ADDED, WatchEvent
+
+    store = ClusterStore(strict=True)
+    # Public API acquires the lock itself: unchanged behavior.
+    store.create("pods", make_pod("ok"))
+    store.patch("pods", "ok", "default", lambda o: o["metadata"].setdefault(
+        "labels", {}
+    ).update(x="y"))
+    store.delete("pods", "ok", "default")
+    with store.transaction():
+        store.create("pods", make_pod("txn"))
+    # Internal mutators without the lock: loud AssertionError.
+    ev = WatchEvent("pods", ADDED, make_pod("raw"))
+    with pytest.raises(AssertionError, match="KSIM_STORE_STRICT"):
+        store._notify(ev)
+    with pytest.raises(AssertionError, match="KSIM_STORE_STRICT"):
+        store._index_pod("default/raw", None)
+    with pytest.raises(AssertionError, match="KSIM_STORE_STRICT"):
+        store._touch("pods", "default/raw")
+    # Under the lock the same calls are legal (the lock-held contract).
+    with store._lock:
+        store._notify(ev)
+
+
+def test_strict_mode_default_comes_from_env(monkeypatch):
+    monkeypatch.setenv("KSIM_STORE_STRICT", "1")
+    assert ClusterStore()._strict
+    monkeypatch.delenv("KSIM_STORE_STRICT")
+    assert not ClusterStore()._strict
+    # Explicit argument beats the environment either way.
+    monkeypatch.setenv("KSIM_STORE_STRICT", "1")
+    assert not ClusterStore(strict=False)._strict

@@ -53,7 +53,11 @@ def test_store_crud_watch_reset_hammer():
     """Interleaved create/update/delete/list/watch/restore across threads:
     no exceptions beyond expected conflicts, and the sorted list order
     stays exactly name-sorted afterwards."""
-    store = ClusterStore()
+    # strict=True: sanitizer-lite mode (docs/lint.md) — every internal
+    # mutator asserts the store lock is held, so a locking regression
+    # fails LOUDLY here instead of as a once-in-a-thousand-runs index
+    # corruption.
+    store = ClusterStore(strict=True)
     for i in range(20):
         store.create("nodes", make_node(f"seed-{i:02d}"))
     boot = store.dump()
@@ -117,7 +121,7 @@ def test_scheduler_under_concurrent_churn():
     """The watch-driven scheduler stays consistent while other threads
     churn pods/nodes: every bound pod points at an existing node or a
     node that was deleted after binding; the loop survives to the end."""
-    store = ClusterStore()
+    store = ClusterStore(strict=True)  # lock-held asserts on (docs/lint.md)
     for i in range(6):
         store.create("nodes", make_node(f"n{i}", cpu="8", memory="16Gi"))
     svc = SchedulerService(store, record="selection", preemption=False)

@@ -1,0 +1,432 @@
+"""tools/ksimlint — the AST contract analyzer (docs/lint.md).
+
+Three layers:
+
+- per-rule fixture tests under tests/fixtures/lint/: one seeded-bad,
+  one suppressed, one clean sample per rule, proving each checker
+  actually FIRES and honors ``# ksimlint: disable=`` suppressions;
+- the full-tree scan, in-process, asserting the real codebase carries
+  zero unsuppressed findings (the same gate as ``make lint``);
+- cross-checks pinning the analyzer's AST-side views (kernel registry,
+  taxonomy registries) to the runtime objects the process imports.
+
+The analyzer itself is stdlib-only, so everything here except the
+runtime cross-check runs without touching jax.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tools.ksimlint.core import DEFAULT_TARGETS, Project, mark_suppressed, run
+from tools.ksimlint.rules import (
+    env_contract,
+    import_boundary,
+    kernel_purity,
+    lock_discipline,
+    registry_literals,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def _project(*names: str) -> Project:
+    return Project.load(FIXTURES, tuple(names))
+
+
+def _run_rule(check, project: Project, **kw):
+    """check() + suppression marking; returns (open, suppressed)."""
+    findings = mark_suppressed(project, check(project, **kw))
+    return (
+        [f for f in findings if not f.suppressed],
+        [f for f in findings if f.suppressed],
+    )
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_lock_discipline_fires_on_seeded_violations():
+    open_, suppressed = _run_rule(lock_discipline.check, _project("lock_bad.py"))
+    assert not suppressed
+    lines = {f.line for f in open_}
+    messages = "\n".join(f.message for f in open_)
+    # Unlocked module-global, unlocked read, unlocked write, closure
+    # escape, worker self-write — and nothing from the disciplined
+    # methods.
+    assert len(open_) == 5, messages
+    assert "_registry" in messages
+    assert "self._items" in messages
+    assert "worker-thread function '_run' writes self.counter" in messages
+    # Exactly the seeded lines fired — the with-block, lock-held and
+    # main-thread-read accesses produced nothing.
+    assert lines == {16, 33, 36, 41, 46}, sorted(lines)
+
+
+def test_lock_discipline_suppression_and_clean():
+    open_, suppressed = _run_rule(lock_discipline.check, _project("lock_suppressed.py"))
+    assert not open_ and len(suppressed) == 1
+    open_, suppressed = _run_rule(lock_discipline.check, _project("lock_clean.py"))
+    assert not open_ and not suppressed
+
+
+# ---------------------------------------------------------------------------
+# kernel-purity
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_purity_fires_on_seeded_violations():
+    open_, suppressed = _run_rule(kernel_purity.check, _project("kernel_bad.py"))
+    assert not suppressed
+    messages = [f.message for f in open_]
+    joined = "\n".join(messages)
+    assert sum("Python branch on a traced value" in m for m in messages) == 2
+    assert "print() inside a traced body" in joined
+    assert "float() coerces a traced value" in joined
+    assert "host numpy op np.sum" in joined
+    assert "64-bit dtype literal 'float64'" in joined
+    assert ".item() on a traced value" in joined
+    # The static-arg branch (cfg.preempt) did NOT fire.
+    assert len(open_) == 7, joined
+
+
+def test_kernel_purity_suppression_and_clean():
+    open_, suppressed = _run_rule(kernel_purity.check, _project("kernel_suppressed.py"))
+    assert not open_ and len(suppressed) == 1
+    open_, suppressed = _run_rule(kernel_purity.check, _project("kernel_clean.py"))
+    assert not open_ and not suppressed
+
+
+# ---------------------------------------------------------------------------
+# import-boundary
+# ---------------------------------------------------------------------------
+
+
+def _boundary(target, scope):
+    return (
+        import_boundary.Boundary(
+            target, frozenset({"jax", "jaxlib", "numpy"}), scope
+        ),
+    )
+
+
+def test_import_boundary_fires_per_scope():
+    project = _project("import_bad.py")
+    # import-time: the module-scope numpy import (function bodies and
+    # child payloads are invisible to this scope).
+    open_, _ = _run_rule(
+        import_boundary.check, project,
+        boundaries=_boundary("import_bad.py", "import-time"),
+    )
+    assert len(open_) == 1 and "numpy" in open_[0].message
+    # parent-child: module scope AND the non-child parent function; the
+    # child payload stays sanctioned.
+    open_, _ = _run_rule(
+        import_boundary.check, project,
+        boundaries=_boundary("import_bad.py", "parent-child"),
+    )
+    assert len(open_) == 2
+    assert any("parent_helper" in f.message for f in open_)
+    assert not any("child_payload" in f.message for f in open_)
+
+
+def test_import_boundary_suppression_and_clean():
+    open_, suppressed = _run_rule(
+        import_boundary.check, _project("import_suppressed.py"),
+        boundaries=_boundary("import_suppressed.py", "everywhere"),
+    )
+    assert not open_ and len(suppressed) == 1
+    open_, suppressed = _run_rule(
+        import_boundary.check, _project("import_clean.py"),
+        boundaries=_boundary("import_clean.py", "import-time"),
+    )
+    assert not open_ and not suppressed  # lazy bridge + TYPE_CHECKING legal
+
+
+# ---------------------------------------------------------------------------
+# registry-literals
+# ---------------------------------------------------------------------------
+
+
+def _registry_cfg(replay: str) -> registry_literals.RegistryConfig:
+    return registry_literals.RegistryConfig(
+        faults_module="registry_regs.py",
+        obs_module="registry_regs.py",
+        replay_module=replay,
+    )
+
+
+def test_registry_literals_fires_on_seeded_violations():
+    project = _project(
+        "registry_regs.py", "registry_replay_bad.py", "registry_caller_bad.py"
+    )
+    open_, suppressed = _run_rule(
+        registry_literals.check, project, cfg=_registry_cfg("registry_replay_bad.py")
+    )
+    assert not suppressed
+    joined = "\n".join(f.message for f in open_)
+    assert "'rogue.site' is not declared in SITES" in joined
+    assert "SITES entry 'wired.site' has no FAULTS.check call site" in joined
+    assert "'rogue.span' is not in obs.SPAN_NAMES" in joined
+    assert "'rogue.event' is not in obs.EVENT_NAMES" in joined
+    assert "non-literal name" in joined
+    assert "'rogue_reason' not in FALLBACK_REASONS" in joined
+    assert "'host_hook:' not covered by FALLBACK_REASON_PREFIXES" in joined
+    assert "'dead_entry' appears nowhere" in joined
+
+
+def test_registry_literals_suppression_and_clean():
+    project = _project(
+        "registry_regs.py", "registry_replay_clean.py", "registry_caller_suppressed.py"
+    )
+    open_, suppressed = _run_rule(
+        registry_literals.check, project, cfg=_registry_cfg("registry_replay_clean.py")
+    )
+    # The two rogue call sites are suppressed; the unwired-site finding
+    # for wired.site remains structural (the suppressed calls don't
+    # count as wiring) — assert exactly that split.
+    assert len(suppressed) == 2
+    assert len(open_) == 1 and "no FAULTS.check call site" in open_[0].message
+
+    project = _project(
+        "registry_regs.py", "registry_replay_clean.py", "registry_caller_clean.py"
+    )
+    open_, suppressed = _run_rule(
+        registry_literals.check, project, cfg=_registry_cfg("registry_replay_clean.py")
+    )
+    assert not open_ and not suppressed
+
+
+# ---------------------------------------------------------------------------
+# env-contract
+# ---------------------------------------------------------------------------
+
+
+def test_env_contract_fires_both_directions():
+    open_, _ = _run_rule(
+        env_contract.check, _project("env_bad.py"),
+        cfg=env_contract.EnvConfig(docs_rel="env_docs.md"),
+    )
+    joined = "\n".join(f"{f.path}: {f.message}" for f in open_)
+    assert "env_bad.py: KSIM_LINTFIXTURE_UNDOCUMENTED" in joined
+    assert "env_docs.md: documented variable KSIM_LINTFIXTURE_DEAD" in joined
+
+
+def test_env_contract_suppression_and_clean():
+    open_, suppressed = _run_rule(
+        env_contract.check, _project("env_suppressed.py"),
+        cfg=env_contract.EnvConfig(docs_rel="env_docs_clean.md"),
+    )
+    assert not open_ and len(suppressed) == 1
+    open_, suppressed = _run_rule(
+        env_contract.check, _project("env_clean.py"),
+        cfg=env_contract.EnvConfig(docs_rel="env_docs_clean.md"),
+    )
+    assert not open_ and not suppressed
+
+
+def test_env_contract_missing_docs_is_a_finding():
+    open_, _ = _run_rule(
+        env_contract.check, _project("env_bad.py"),
+        cfg=env_contract.EnvConfig(docs_rel="no_such_docs.md"),
+    )
+    assert len(open_) == 1 and "missing" in open_[0].message
+
+
+# ---------------------------------------------------------------------------
+# The full tree (the same gate as `make lint`)
+# ---------------------------------------------------------------------------
+
+
+def test_full_tree_has_zero_unsuppressed_findings():
+    """The tier-1 in-process equivalent of `make lint`: every rule over
+    ksim_tpu/, bench.py and tools/ — zero unsuppressed findings.  The
+    analyzer is stdlib-only, so this needs no jax and no subprocess."""
+    findings = run(REPO, DEFAULT_TARGETS)
+    open_ = [f for f in findings if not f.suppressed]
+    assert not open_, "\n" + "\n".join(f.format() for f in open_)
+    # The suppressions that exist are the documented, justified ones;
+    # a new suppression should be a conscious reviewable event, so pin
+    # the count.
+    assert len(findings) - len(open_) == 2, [f.format() for f in findings if f.suppressed]
+
+
+def test_cli_human_and_json(tmp_path, capsys):
+    """The CLI surface `make lint` drives: exit 0 + summary on the real
+    tree, exit 1 on a tree with a finding, --json parses."""
+    import json as json_mod
+
+    from tools.ksimlint.__main__ import main
+
+    assert main(["--root", REPO]) == 0
+    capsys.readouterr()
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._d = {}  # guarded-by: _lock\n"
+        "    def f(self):\n"
+        "        return self._d\n"
+    )
+    assert main(["--root", str(tmp_path), "mod.py"]) == 1
+    out = capsys.readouterr().out
+    assert "mod.py:7" in out and "lock-discipline" in out
+    assert main(["--root", str(tmp_path), "mod.py", "--json"]) == 1
+    doc = json_mod.loads(capsys.readouterr().out)
+    assert doc["unsuppressed"] == 1 and doc["findings"][0]["rule"] == "lock-discipline"
+
+
+def test_cli_partial_target_and_typo(capsys):
+    """A single-file run must not mass-flag docs rows the slice doesn't
+    mention (the dead-row direction needs the whole tree), and a typo'd
+    target is a loud usage error (exit 2), never a vacuously green
+    scan of nothing."""
+    from tools.ksimlint.__main__ import main
+
+    assert main(["--root", REPO, "ksim_tpu/obs.py"]) == 0
+    capsys.readouterr()
+    assert main(["--root", REPO, "ksim_tpu/no_such_file.py"]) == 2
+    assert "not found" in capsys.readouterr().err
+    # A typo'd rule name is the same vacuously-green hazard: exit 2.
+    assert main(["--root", REPO, "--rules", "lock-disclipine"]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_import_boundary_relative_imports_resolve(tmp_path):
+    """A relative import is just spelling — it must not bypass the
+    boundary: `from .engine import replay` from pkg/obs.py reaches
+    pkg/engine/replay.py, whose module-scope jax import breaks the
+    import-time contract transitively."""
+    pkg = tmp_path / "pkg"
+    (pkg / "engine").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "obs.py").write_text("from .engine import replay\n")
+    (pkg / "engine" / "__init__.py").write_text("")
+    (pkg / "engine" / "replay.py").write_text("import jax\n")
+    project = Project.load(str(tmp_path), ("pkg",))
+    findings = import_boundary.check(
+        project,
+        boundaries=(
+            import_boundary.Boundary(
+                "pkg/obs.py", frozenset({"jax"}), "import-time"
+            ),
+        ),
+    )
+    assert len(findings) == 1
+    assert "pkg/engine/replay.py:1 imports jax" in findings[0].message
+
+
+def test_lock_discipline_module_guards_cover_methods():
+    """A class method touching a guarded module global without its lock
+    is a finding too (the obs provider-registry shape)."""
+    import textwrap
+
+    from tools.ksimlint.core import SourceFile
+
+    src = textwrap.dedent(
+        """
+        import threading
+
+        _providers = {}  # guarded-by: _providers_lock
+        _providers_lock = threading.Lock()
+
+
+        class Plane:
+            def sneaky(self):
+                return dict(_providers)
+
+            def polite(self):
+                with _providers_lock:
+                    return dict(_providers)
+        """
+    )
+    sf = SourceFile("m.py", "m.py", src)
+    findings = lock_discipline.check(Project("/tmp", {"m.py": sf}, ("m.py",)))
+    assert len(findings) == 1 and findings[0].line == 10
+
+
+def test_kernel_purity_scans_match_statements():
+    """No statement type escapes the kernel scan: a match on a traced
+    subject is host control flow, and case bodies are checked."""
+    import textwrap
+
+    from tools.ksimlint.core import SourceFile
+
+    src = textwrap.dedent(
+        """
+        def device_kernel(fn=None, *, static=()):
+            return fn if fn is not None else (lambda f: f)
+
+
+        @device_kernel
+        def k(x):
+            match x:
+                case 0:
+                    print("zero")
+                case _:
+                    pass
+            return x
+        """
+    )
+    sf = SourceFile("m.py", "m.py", src)
+    findings = kernel_purity.check(Project("/tmp", {"m.py": sf}, ("m.py",)))
+    messages = "\n".join(f.message for f in findings)
+    assert "Python branch on a traced value" in messages
+    assert "print() inside a traced body" in messages
+
+
+# ---------------------------------------------------------------------------
+# Runtime cross-checks (these import the engine, hence jax)
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_registry_matches_ast_scan():
+    """The runtime KERNELS registry (decorator side) and the analyzer's
+    AST scan (enforcement side) see the same kernels with the same
+    static names — a kernel marked but unparsable, or scanned but
+    unregistered, cannot drift silently."""
+    import ksim_tpu.engine.core  # noqa: F401 - registers kernels on import
+    import ksim_tpu.engine.replay  # noqa: F401
+    from ksim_tpu.engine.kernelreg import KERNELS
+
+    project = Project.load(
+        REPO, ("ksim_tpu/engine/core.py", "ksim_tpu/engine/replay.py")
+    )
+    ast_view = {
+        (fn.name, statics)
+        for sf in project.files.values()
+        for fn, statics in kernel_purity.scan_kernels(sf)
+    }
+    runtime_view = {(f.__name__, f.__ksim_kernel_static__) for f in KERNELS}
+    assert runtime_view == ast_view
+    assert ("_segment_fn", ("st", "prog")) in runtime_view
+    assert ("_schedule_fn", ("self",)) in runtime_view
+
+
+def test_device_kernel_decorator_is_identity():
+    from ksim_tpu.engine.kernelreg import KERNELS, device_kernel
+
+    before = len(KERNELS)
+
+    @device_kernel
+    def bare(x):
+        return x
+
+    @device_kernel(static=("cfg",))
+    def with_args(cfg, x):
+        return x
+
+    try:
+        assert bare(1) == 1 and with_args(None, 2) == 2
+        assert bare.__ksim_kernel_static__ == ()
+        assert with_args.__ksim_kernel_static__ == ("cfg",)
+        assert KERNELS[-2:] == [bare, with_args]
+    finally:
+        del KERNELS[before:]

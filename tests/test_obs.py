@@ -8,9 +8,8 @@ global one when they must touch it."""
 
 from __future__ import annotations
 
-import ast
+import functools
 import json
-import re
 import threading
 
 import pytest
@@ -284,80 +283,85 @@ def _repo_root():
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@functools.lru_cache(maxsize=1)
+def _lint_project():
+    """The analyzer's view of the tree (tools/ksimlint, docs/lint.md).
+    These tests are RE-BACKED by the analyzer's call-site scans — the
+    same AST pass `make lint` runs — so the in-suite registry checks
+    can never drift from what the lint rule actually sees (the old
+    inline grep/ast logic lived here and could).  Cached: the tree is
+    immutable while tests run, and three tests share the parse."""
+    from tools.ksimlint.core import Project
+
+    return Project.load(_repo_root())
+
+
 def test_fault_sites_match_source_and_span_taxonomy():
     """Every FAULTS.check("...") literal in the codebase is a declared
     site, every declared site is wired somewhere, and every site has a
     same-named span enclosing it on the timeline — the taxonomies
-    cannot drift apart silently."""
-    import os
-
+    cannot drift apart silently.  Also pins the analyzer's AST-read
+    registries to the imported runtime values: the lint rule checks
+    call sites against what it PARSES, this asserts what it parses is
+    what the process actually runs."""
     from ksim_tpu.faults import SITES
+    from tools.ksimlint.rules import registry_literals as rl
 
-    root = os.path.join(_repo_root(), "ksim_tpu")
-    wired: set[str] = set()
-    for dirpath, _dirs, files in os.walk(root):
-        for fn in files:
-            # faults.py DECLARES the sites (and its docstring shows the
-            # check() idiom); the wiring we're auditing lives elsewhere.
-            if not fn.endswith(".py") or fn == "faults.py":
-                continue
-            with open(os.path.join(dirpath, fn)) as f:
-                wired.update(re.findall(r'FAULTS\.check\(\s*"([^"]+)"', f.read()))
-    assert wired == set(SITES)
+    project = _lint_project()
+    regs = rl.load_registries(project)
+    assert regs.sites == SITES
+    assert regs.span_names == SPAN_NAMES
+    assert regs.event_names == EVENT_NAMES
+
+    scan = rl.scan_fault_sites(project)
+    assert not scan.dynamic, f"non-literal FAULTS.check sites: {scan.dynamic}"
+    assert set(scan.literals) == set(SITES)
     assert set(SITES) <= set(SPAN_NAMES)
     assert "fault.fired" in EVENT_NAMES
+
+
+def test_trace_literals_match_taxonomy():
+    """Every TRACE.span / TRACE.event name spelled at a call site is in
+    the registry (the analyzer's scan, asserted in-suite)."""
+    from tools.ksimlint.rules import registry_literals as rl
+
+    spans, events = rl.scan_trace_literals(_lint_project())
+    assert not spans.dynamic and not events.dynamic
+    assert set(spans.literals) <= set(SPAN_NAMES), (
+        set(spans.literals) - set(SPAN_NAMES)
+    )
+    assert set(events.literals) <= set(EVENT_NAMES), (
+        set(events.literals) - set(EVENT_NAMES)
+    )
 
 
 def test_fallback_reasons_match_replay_source():
     """Every statically spelled fallback reason in engine/replay.py is
     registered in FALLBACK_REASONS (so it reaches the trace taxonomy),
-    and the registry carries no dead entries."""
-    import os
-
+    and the registry carries no dead entries — via the analyzer's scan
+    (it replaced the inline ast walk this test used to carry)."""
     from ksim_tpu.engine.replay import (
         FALLBACK_REASON_PREFIXES,
         FALLBACK_REASONS,
     )
+    from tools.ksimlint.rules import registry_literals as rl
 
-    path = os.path.join(_repo_root(), "ksim_tpu", "engine", "replay.py")
-    with open(path) as f:
-        tree = ast.parse(f.read())
+    project = _lint_project()
+    regs = rl.load_registries(project)
+    assert regs.fallback_reasons == FALLBACK_REASONS
+    assert regs.fallback_prefixes == FALLBACK_REASON_PREFIXES
 
-    call_reasons: set[str] = set()
-    fstring_prefixes: set[str] = set()
-    return_strs: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            fname = (
-                node.func.id
-                if isinstance(node.func, ast.Name)
-                else getattr(node.func, "attr", "")
-            )
-            if fname in ("_Unsupported", "_reject") and node.args:
-                arg = node.args[0]
-                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                    call_reasons.add(arg.value)
-                elif isinstance(arg, ast.JoinedStr) and isinstance(
-                    arg.values[0], ast.Constant
-                ):
-                    fstring_prefixes.add(arg.values[0].value)
-        elif (
-            isinstance(node, ast.Return)
-            and isinstance(node.value, ast.Constant)
-            and isinstance(node.value.value, str)
-        ):
-            return_strs.add(node.value.value)
-
-    unregistered = call_reasons - FALLBACK_REASONS
+    fb = rl.scan_fallback_reasons(project)
+    unregistered = set(fb.call_reasons) - FALLBACK_REASONS
     assert not unregistered, (
         f"fallback reasons missing from FALLBACK_REASONS: {sorted(unregistered)}"
     )
     # The post-dispatch validation discards return their reason as a
     # string (featurize_prediction / preemption_overflow): registry
     # entries must exist SOMEWHERE in the source.
-    dead = FALLBACK_REASONS - call_reasons - return_strs
+    dead = FALLBACK_REASONS - set(fb.call_reasons) - fb.return_strings
     assert not dead, f"FALLBACK_REASONS entries not found in source: {sorted(dead)}"
-    for prefix in fstring_prefixes:
+    for prefix in fb.fstring_prefixes:
         assert any(prefix.startswith(p) for p in FALLBACK_REASON_PREFIXES), (
             f"dynamic fallback reason family {prefix!r} not in "
             f"FALLBACK_REASON_PREFIXES"

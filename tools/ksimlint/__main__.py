@@ -1,0 +1,81 @@
+"""CLI: ``python -m tools.ksimlint [targets...]`` (see docs/lint.md).
+
+Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage
+error.  ``--json`` emits one machine-readable document (all findings,
+suppressed included) for tooling; the human format prints unsuppressed
+findings as ``path:line: [rule] message``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.ksimlint.core import DEFAULT_TARGETS, run
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ksimlint", description="AST contract analyzer (docs/lint.md)"
+    )
+    parser.add_argument(
+        "targets",
+        nargs="*",
+        help=f"files/directories under --root (default: {' '.join(DEFAULT_TARGETS)})",
+    )
+    parser.add_argument(
+        "--root",
+        default=os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        help="repository root (default: derived from this file's location)",
+    )
+    parser.add_argument(
+        "--rules", help="comma-separated rule subset (default: all rules)"
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit one JSON document instead of lines"
+    )
+    parser.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print suppressed findings in the human format",
+    )
+    args = parser.parse_args(argv)
+
+    targets = tuple(args.targets) or DEFAULT_TARGETS
+    rules = tuple(r for r in args.rules.split(",") if r) if args.rules else None
+    try:
+        findings = run(args.root, targets, rules)
+    except (OSError, SyntaxError, ValueError) as e:
+        print(f"ksimlint: {e}", file=sys.stderr)
+        return 2
+
+    open_findings = [f for f in findings if not f.suppressed]
+    suppressed = len(findings) - len(open_findings)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_json() for f in findings],
+                    "unsuppressed": len(open_findings),
+                    "suppressed": suppressed,
+                },
+                indent=2,
+            )
+        )
+    else:
+        shown = findings if args.show_suppressed else open_findings
+        for f in shown:
+            print(f.format())
+        print(
+            f"ksimlint: {len(open_findings)} finding(s), {suppressed} suppressed",
+            file=sys.stderr,
+        )
+    return 1 if open_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

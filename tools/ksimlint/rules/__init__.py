@@ -1,0 +1,21 @@
+"""Rule registry: every module here exposes RULE + check(project)."""
+
+from tools.ksimlint.rules import (
+    env_contract,
+    import_boundary,
+    kernel_purity,
+    lock_discipline,
+    registry_literals,
+)
+
+_MODULES = (
+    lock_discipline,
+    kernel_purity,
+    import_boundary,
+    registry_literals,
+    env_contract,
+)
+
+ALL_RULES = {m.RULE: m.check for m in _MODULES}
+
+__all__ = ["ALL_RULES"]

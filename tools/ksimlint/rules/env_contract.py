@@ -1,0 +1,119 @@
+"""env-contract: every KSIM_* variable is documented, and vice versa.
+
+docs/env.md is the single operator-facing table of the simulator's
+environment knobs (type, default, consumer).  This rule extracts every
+``KSIM_``-prefixed name appearing in any string literal of the analyzed
+tree — environ reads, error messages that tell the operator which
+variable to set, docstrings documenting behavior — and checks both
+directions against the table:
+
+- a name used in source but missing from docs/env.md is an
+  UNDOCUMENTED knob (the scan-unroll / compile-cache / pnts-emulation
+  class of drift this rule was built to end);
+- a table row whose name no longer appears anywhere in source is a
+  DEAD row teaching operators a knob that does nothing.
+
+Names are matched with a full-token regex (the prefix followed by
+upper-case segments, never ending in an underscore), so a starred
+family glob in prose resolves to the real family root and a bare
+dangling prefix never false-positives.  This module spells no variable
+names anywhere (including this docstring): the analyzer's own sources
+are inside the scanned tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from tools.ksimlint.core import Finding, Project
+
+RULE = "env-contract"
+
+#: Full variable tokens only: no trailing underscore, at least one
+#: character after the prefix.
+VAR_RE = re.compile(r"KSIM_[A-Z0-9][A-Z0-9_]*[A-Z0-9]|KSIM_[A-Z0-9]")
+
+
+@dataclass(frozen=True)
+class EnvConfig:
+    docs_rel: str = "docs/env.md"
+
+
+DEFAULT_CONFIG = EnvConfig()
+
+
+def scan_env_literals(project: Project) -> dict:
+    """var name -> first (rel, line) it appears at, over every string
+    constant in the tree (f-string fragments included; comments are not
+    string constants and are ignored)."""
+    first: dict[str, tuple[str, int]] = {}
+    for rel, sf in project.files.items():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for name in VAR_RE.findall(node.value):
+                    first.setdefault(name, (rel, node.lineno))
+    return first
+
+
+def parse_docs_table(text: str) -> dict:
+    """var name -> line number from the markdown table rows (any line
+    starting with ``|`` whose first cell names a KSIM_ variable)."""
+    documented: dict[str, int] = {}
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            continue
+        first_cell = stripped.strip("|").split("|", 1)[0]
+        for name in VAR_RE.findall(first_cell):
+            documented.setdefault(name, lineno)
+    return documented
+
+
+def check(project: Project, cfg: EnvConfig = DEFAULT_CONFIG) -> list[Finding]:
+    findings: list[Finding] = []
+    used = scan_env_literals(project)
+    text = project.read_text(cfg.docs_rel)
+    if text is None:
+        if used:
+            findings.append(
+                Finding(
+                    RULE,
+                    cfg.docs_rel,
+                    1,
+                    f"{cfg.docs_rel} is missing but the tree reads "
+                    f"{len(used)} KSIM_* variables — write the table",
+                )
+            )
+        return findings
+    documented = parse_docs_table(text)
+    for name, (rel, line) in sorted(used.items()):
+        if name not in documented:
+            findings.append(
+                Finding(
+                    RULE,
+                    rel,
+                    line,
+                    f"{name} is read/mentioned here but undocumented — add a "
+                    f"row (name, type, default, consumer) to {cfg.docs_rel}",
+                )
+            )
+    # The dead-row direction compares the docs against the WHOLE tree;
+    # on a partial run (one file, a subtree) "unused" is meaningless
+    # and would mass-flag every row the slice doesn't mention.  A
+    # fixture project overriding docs_rel opts back in (its docs table
+    # belongs to the fixture slice by construction).
+    if project.covers_default_targets() or cfg is not DEFAULT_CONFIG:
+        for name, line in sorted(documented.items()):
+            if name not in used:
+                findings.append(
+                    Finding(
+                        RULE,
+                        cfg.docs_rel,
+                        line,
+                        f"documented variable {name} appears nowhere in the "
+                        "analyzed tree (dead row — delete it or wire it)",
+                    )
+                )
+    return findings

@@ -1,0 +1,271 @@
+"""kernel-purity: ``@device_kernel`` trace-time bodies stay pure.
+
+The byte-identical churn locks (repo CLAUDE.md) rest on the device
+kernels being (a) free of host effects — a ``print`` or ``.item()``
+inside a traced body forces a device sync or fails under jit — and
+(b) f32-deterministic — no hardcoded 64-bit dtypes, no host-numpy math
+on traced values, no Python control flow on traced values (which either
+crashes at trace time or, worse, silently bakes one branch into the
+compiled program).
+
+Kernels are DECLARED, not guessed: the runtime registry decorator
+``ksim_tpu.engine.kernelreg.device_kernel`` marks every scan body /
+jitted program builder, and its ``static=(...)`` names mirror the
+``jax.jit`` static arguments (trace-time Python values — branching on
+them is fine and common).  This rule finds the decorator in the AST, so
+the analyzer never imports the engine.
+
+Checks, over the kernel body INCLUDING nested defs (scan bodies,
+``lax.cond`` branches):
+
+- ``print(...)`` calls;
+- ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` calls;
+- ``float()`` / ``int()`` / ``bool()`` applied to a traced value;
+- ``np.*`` / ``numpy.*`` calls applied to a traced value (host math on
+  a tracer; static shape arithmetic with numpy stays legal);
+- references to 64-bit dtypes (``.float64`` / ``.int64`` attributes or
+  ``"float64"`` / ``"int64"`` literals) — exact mode enables x64
+  globally via jax.config, never by hardcoding dtypes in kernels;
+- ``if`` / ``while`` / ``assert`` statements whose test involves a
+  traced value (use ``lax.cond`` / ``jnp.where``).
+
+"Traced" is a name-level taint: every parameter of the kernel (minus
+the declared statics) and of any nested def seeds the set; assignment
+from a tainted expression taints the targets.  Closure variables and
+statics are trace-time Python — branching on them is not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ksimlint.core import Finding, Project, SourceFile
+
+RULE = "kernel-purity"
+
+DECORATOR = "device_kernel"
+
+_HOST_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+_COERCIONS = frozenset({"float", "int", "bool"})
+_NUMPY_NAMES = frozenset({"np", "numpy"})
+_WIDE_DTYPES = frozenset({"float64", "int64"})
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _decorator_statics(fn) -> "tuple[str, ...] | None":
+    """The declared static names if ``fn`` carries @device_kernel (with
+    or without arguments); None when it is not a registered kernel."""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = target.id if isinstance(target, ast.Name) else getattr(target, "attr", "")
+        if name != DECORATOR:
+            continue
+        statics: list[str] = []
+        if isinstance(dec, ast.Call):
+            for kw in dec.keywords:
+                if kw.arg == "static" and isinstance(kw.value, (ast.Tuple, ast.List)):
+                    statics = [
+                        e.value
+                        for e in kw.value.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    ]
+        return tuple(statics)
+    return None
+
+
+def _param_names(fn) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _names_in(expr: ast.expr) -> set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def scan_kernels(sf: SourceFile) -> "list[tuple[ast.AST, tuple[str, ...]]]":
+    """Every @device_kernel def in the file with its static names (the
+    analyzer-side view of the runtime KERNELS registry; tests cross-check
+    the two)."""
+    out = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, _FUNC):
+            statics = _decorator_statics(node)
+            if statics is not None:
+                out.append((node, statics))
+    return out
+
+
+class _KernelChecker:
+    def __init__(self, sf: SourceFile, kernel, statics: tuple[str, ...]) -> None:
+        self.sf = sf
+        self.kernel = kernel
+        self.tainted: set[str] = set(
+            n for n in _param_names(kernel) if n not in statics
+        )
+        self.findings: list[Finding] = []
+
+    def _flag(self, node, message: str) -> None:
+        self.findings.append(
+            Finding(
+                RULE,
+                self.sf.rel,
+                node.lineno,
+                f"kernel {self.kernel.name!r}: {message}",
+            )
+        )
+
+    def _is_tainted(self, expr: ast.expr) -> bool:
+        return bool(_names_in(expr) & self.tainted)
+
+    def _taint_target(self, target: ast.expr) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                self.tainted.add(n.id)
+
+    # -- expression checks ----------------------------------------------
+
+    def _check_expr(self, expr: ast.expr) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Name):
+                    if func.id == "print":
+                        self._flag(node, "print() inside a traced body")
+                    elif func.id in _COERCIONS and any(
+                        self._is_tainted(a) for a in node.args
+                    ):
+                        self._flag(
+                            node,
+                            f"{func.id}() coerces a traced value to a host "
+                            "scalar (forces a sync / fails under jit)",
+                        )
+                elif isinstance(func, ast.Attribute):
+                    if (
+                        func.attr in _HOST_METHODS
+                        and not node.args
+                        # Only on traced receivers: trace-time host prep
+                        # on a static value (st.mask_np.tolist()) is
+                        # legal Python, like every other check here.
+                        and self._is_tainted(func.value)
+                    ):
+                        self._flag(
+                            node, f".{func.attr}() on a traced value is a host sync"
+                        )
+                    elif (
+                        isinstance(func.value, ast.Name)
+                        and func.value.id in _NUMPY_NAMES
+                        and any(self._is_tainted(a) for a in node.args)
+                    ):
+                        self._flag(
+                            node,
+                            f"host numpy op {ast.unparse(func)} applied to a "
+                            "traced value (use jnp)",
+                        )
+            elif isinstance(node, ast.Attribute) and node.attr in _WIDE_DTYPES:
+                self._flag(
+                    node,
+                    f"64-bit dtype .{node.attr} hardcoded in a kernel (exact "
+                    "mode flips jax_enable_x64 globally; kernels stay "
+                    "dtype-agnostic for the f32 determinism contract)",
+                )
+            elif (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value in _WIDE_DTYPES
+            ):
+                self._flag(node, f"64-bit dtype literal {node.value!r} in a kernel")
+
+    # -- statements ------------------------------------------------------
+
+    def check_body(self, stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            self._check_stmt(stmt)
+
+    def _check_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, _FUNC):
+            # Nested defs are scan bodies / cond branches: every
+            # parameter is traced (scan carries, branch operands).
+            self.tainted.update(_param_names(stmt))
+            self.check_body(stmt.body)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            if self._is_tainted(stmt.test):
+                self._flag(
+                    stmt,
+                    "Python branch on a traced value (lax.cond / jnp.where "
+                    "keep it on-device)",
+                )
+            self._check_expr(stmt.test)
+            self.check_body(stmt.body)
+            self.check_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Assert):
+            if self._is_tainted(stmt.test):
+                self._flag(stmt, "assert on a traced value")
+            self._check_expr(stmt.test)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self._check_expr(value)
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                )
+                if self._is_tainted(value) or isinstance(stmt, ast.AugAssign):
+                    for t in targets:
+                        self._taint_target(t)
+            return
+        if isinstance(stmt, ast.For):
+            self._check_expr(stmt.iter)
+            if self._is_tainted(stmt.iter):
+                self._taint_target(stmt.target)
+            self.check_body(stmt.body)
+            self.check_body(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_expr(item.context_expr)
+            self.check_body(stmt.body)
+            return
+        if isinstance(stmt, ast.Match):
+            # A match on a traced subject is host control flow, exactly
+            # like if/while.
+            if self._is_tainted(stmt.subject):
+                self._flag(
+                    stmt,
+                    "Python branch on a traced value (lax.cond / jnp.where "
+                    "keep it on-device)",
+                )
+            self._check_expr(stmt.subject)
+            for case in stmt.cases:
+                self.check_body(case.body)
+            return
+        # Generic fallback — no statement type may escape the scan: every
+        # nested statement list is checked as a body, every expression
+        # field is checked for host effects (Return/Expr/Raise/Delete/
+        # Global/... all land here).
+        for _field, value in ast.iter_fields(stmt):
+            if isinstance(value, ast.expr):
+                self._check_expr(value)
+            elif isinstance(value, list):
+                stmts = [v for v in value if isinstance(v, ast.stmt)]
+                if stmts:
+                    self.check_body(stmts)
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        self._check_expr(v)
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files.values():
+        for kernel, statics in scan_kernels(sf):
+            checker = _KernelChecker(sf, kernel, statics)
+            checker.check_body(kernel.body)
+            findings.extend(checker.findings)
+    return findings

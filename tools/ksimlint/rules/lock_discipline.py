@@ -1,0 +1,288 @@
+"""lock-discipline: ``# guarded-by:`` state only touched under its lock.
+
+The Clang thread-safety (``guarded_by``) idea over Python ASTs, scoped
+to what this codebase actually relies on (docs/lint.md "Lock
+discipline"):
+
+- An attribute initialized in ``__init__`` with a trailing
+  ``# guarded-by: <lock>`` comment may only be read or written through
+  ``self.<attr>`` inside a ``with self.<lock>:`` block, or inside a
+  method annotated ``# ksimlint: lock-held(<lock>)`` (a helper whose
+  documented contract is "callers hold the lock").  ``__init__`` itself
+  is exempt: construction happens-before publication.
+- A module-level name annotated ``# guarded-by: <lock>`` may only be
+  used inside functions under ``with <lock>:`` (module scope itself is
+  exempt — that is single-threaded import time).
+- ``# guarded-by: main-thread`` declares thread-confined state (the
+  ReplayDriver's worker/prelower bookkeeping): no lock exists, the
+  contract is that only the owning thread writes it.  Enforcement rides
+  on the worker rule below; the annotation also documents the attribute
+  for readers.
+- A function annotated ``# ksimlint: worker-thread`` (the replay
+  dispatch worker and ``ReplayDriver._run``) must be side-effect-free
+  on its instance: NO store to any ``self.<attr>`` — the round-8
+  containment contract that lets an abandoned watchdog worker finish
+  late without corrupting the degraded run's accounting.
+
+Lexical soundness limits (accepted, documented in docs/lint.md): calls
+are not followed (a lock-held helper calling an unannotated mutator is
+checked at the mutator, not the call), nested ``def``/``lambda`` bodies
+conservatively reset the held-lock set (a closure may run after the
+``with`` exits), and cross-object accesses (``other.store._x``) are out
+of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.ksimlint.core import Finding, Project, SourceFile
+
+RULE = "lock-discipline"
+
+GUARD_RE = re.compile(r"guarded-by:\s*([A-Za-z_][\w-]*)")
+LOCK_HELD_RE = re.compile(r"ksimlint:\s*lock-held\(([A-Za-z_]\w*)\)")
+WORKER_RE = re.compile(r"ksimlint:\s*worker-thread")
+
+MAIN_THREAD = "main-thread"
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _stmt_guard(sf: SourceFile, stmt: ast.stmt) -> "str | None":
+    """The guarded-by annotation on an assignment: a trailing comment on
+    any of the statement's lines, or a comment-only line directly above
+    (for assignments whose first line has no room)."""
+    start = stmt.lineno
+    if start - 1 in sf.comment_only:
+        start -= 1
+    m = sf.directive_in_range(start, getattr(stmt, "end_lineno", stmt.lineno), GUARD_RE)
+    return m.group(1) if m else None
+
+
+def _def_directive(sf: SourceFile, fn, pattern: re.Pattern):
+    """Match a directive on the ``def`` line span (signature lines up to
+    the first body statement)."""
+    end = fn.body[0].lineno - 1 if fn.body else fn.lineno
+    return sf.directive_in_range(fn.lineno, max(fn.lineno, end), pattern)
+
+
+def _assign_targets(stmt: ast.stmt):
+    if isinstance(stmt, ast.Assign):
+        return stmt.targets
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        return [stmt.target]
+    return []
+
+
+def _is_self_attr(node: ast.expr) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def _with_held(stmt, lock_exprs: dict[str, str]) -> set[str]:
+    """Lock names among ``lock_exprs`` acquired by this With statement
+    (matched on the unparsed context expression, e.g. ``self._lock``)."""
+    held: set[str] = set()
+    for item in stmt.items:
+        expr = ast.unparse(item.context_expr)
+        for lock, text in lock_exprs.items():
+            if expr == text:
+                held.add(lock)
+    return held
+
+
+class _AccessChecker(ast.NodeVisitor):
+    """Walk one function body tracking lexically held locks."""
+
+    def __init__(
+        self,
+        sf: SourceFile,
+        guards: dict[str, str],
+        lock_exprs: dict[str, str],
+        held: frozenset[str],
+        self_attr: bool,
+        findings: list[Finding],
+    ) -> None:
+        self.sf = sf
+        self.guards = guards  # attr/name -> lock
+        self.lock_exprs = lock_exprs  # lock -> unparse text to match in With
+        self.held = held
+        self.self_attr = self_attr  # True: guard self.<attr>; False: bare names
+        self.findings = findings
+
+    def _sub(self, held: frozenset[str]) -> "_AccessChecker":
+        return _AccessChecker(
+            self.sf, self.guards, self.lock_exprs, held, self.self_attr, self.findings
+        )
+
+    # -- scope / lock structure -----------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        inner = self._sub(self.held | _with_held(node, self.lock_exprs))
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncWith = visit_With
+
+    def _visit_nested(self, node) -> None:
+        # Conservative: a nested def/lambda may execute after the
+        # enclosing with block exits — it inherits nothing, unless it
+        # carries its own lock-held annotation.
+        held: frozenset[str] = frozenset()
+        if not isinstance(node, ast.Lambda):
+            m = _def_directive(self.sf, node, LOCK_HELD_RE)
+            if m:
+                held = frozenset({m.group(1)})
+        inner = self._sub(held)
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            inner.visit(stmt)
+
+    def visit_FunctionDef(self, node) -> None:
+        self._visit_nested(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- the accesses ----------------------------------------------------
+
+    def _flag(self, node, name: str, lock: str) -> None:
+        what = f"self.{name}" if self.self_attr else name
+        self.findings.append(
+            Finding(
+                RULE,
+                self.sf.rel,
+                node.lineno,
+                f"{what} is guarded by {lock!r} but accessed without "
+                f"holding it (wrap in `with {self.lock_exprs[lock]}:` or "
+                f"annotate the method `# ksimlint: lock-held({lock})`)",
+            )
+        )
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.self_attr and _is_self_attr(node):
+            lock = self.guards.get(node.attr)
+            if lock is not None and lock != MAIN_THREAD and lock not in self.held:
+                self._flag(node, node.attr, lock)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if not self.self_attr:
+            lock = self.guards.get(node.id)
+            if lock is not None and lock != MAIN_THREAD and lock not in self.held:
+                self._flag(node, node.id, lock)
+
+
+def _class_guards(sf: SourceFile, cls: ast.ClassDef) -> dict[str, str]:
+    """attr -> lock from annotated assignments in __init__ (and annotated
+    class-body assignments)."""
+    guards: dict[str, str] = {}
+    for stmt in cls.body:
+        if isinstance(stmt, _FUNC) and stmt.name == "__init__":
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    lock = _stmt_guard(sf, sub)
+                    if lock:
+                        for tgt in _assign_targets(sub):
+                            if _is_self_attr(tgt):
+                                guards[tgt.attr] = lock
+        elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            lock = _stmt_guard(sf, stmt)
+            if lock:
+                for tgt in _assign_targets(stmt):
+                    if isinstance(tgt, ast.Name):
+                        guards[tgt.id] = lock
+    return guards
+
+
+def _check_class(sf: SourceFile, cls: ast.ClassDef, findings: list[Finding]) -> None:
+    guards = _class_guards(sf, cls)
+    if not guards:
+        return
+    lock_exprs = {
+        lock: f"self.{lock}" for lock in set(guards.values()) if lock != MAIN_THREAD
+    }
+    for stmt in cls.body:
+        if not isinstance(stmt, _FUNC) or stmt.name == "__init__":
+            continue
+        held: frozenset[str] = frozenset()
+        m = _def_directive(sf, stmt, LOCK_HELD_RE)
+        if m:
+            held = frozenset({m.group(1)})
+        checker = _AccessChecker(sf, guards, lock_exprs, held, True, findings)
+        for sub in stmt.body:
+            checker.visit(sub)
+
+
+def _check_module_guards(sf: SourceFile, findings: list[Finding]) -> None:
+    guards: dict[str, str] = {}
+    for stmt in sf.tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            lock = _stmt_guard(sf, stmt)
+            if lock:
+                for tgt in _assign_targets(stmt):
+                    if isinstance(tgt, ast.Name):
+                        guards[tgt.id] = lock
+    if not guards:
+        return
+    lock_exprs = {lock: lock for lock in set(guards.values()) if lock != MAIN_THREAD}
+    # Every function at module OR class scope (methods touch module
+    # globals too); functions nested inside functions are reached by
+    # the checker's own recursion, not enumerated here.
+    def outer_functions(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, _FUNC):
+                yield stmt
+            elif isinstance(stmt, ast.ClassDef):
+                yield from outer_functions(stmt.body)
+
+    for stmt in outer_functions(sf.tree.body):
+        held: frozenset[str] = frozenset()
+        m = _def_directive(sf, stmt, LOCK_HELD_RE)
+        if m:
+            held = frozenset({m.group(1)})
+        checker = _AccessChecker(sf, guards, lock_exprs, held, False, findings)
+        for sub in stmt.body:
+            checker.visit(sub)
+
+
+def _check_worker_functions(sf: SourceFile, findings: list[Finding]) -> None:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, _FUNC) and _def_directive(sf, node, WORKER_RE):
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Attribute)
+                    and _is_self_attr(sub)
+                    and isinstance(sub.ctx, (ast.Store, ast.Del))
+                ):
+                    findings.append(
+                        Finding(
+                            RULE,
+                            sf.rel,
+                            sub.lineno,
+                            f"worker-thread function {node.name!r} writes "
+                            f"self.{sub.attr} — dispatch workers must be "
+                            "side-effect-free on the instance (apply state "
+                            "on the main thread after join)",
+                        )
+                    )
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files.values():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                _check_class(sf, node, findings)
+        _check_module_guards(sf, findings)
+        _check_worker_functions(sf, findings)
+    return findings
