@@ -12,12 +12,15 @@ test:
 # 52781/42829 counts stepwise (repo CLAUDE.md) — with the incremental
 # lower-cache + double-buffered prelower fully ON (round 10), plus the
 # counter-based O(delta) guard (steady-state featurize rows scale with
-# window events, not universe size).  ~10-20 min on CPU.
+# window events, not universe size) — and the FLEET parity lock (round
+# 12): 8 lanes x 6k events through the vmapped fleet path, every lane
+# byte-identical to 2524/471 with the shared universe lowered once per
+# window (counter-based guard).  ~15-25 min on CPU.
 # The analyzer gates the lock run: a lock/kernel/registry contract
 # violation is exactly the class of bug the 50k stepwise run exists to
 # catch, and lint finds it in seconds instead of minutes.
 lock-check: lint
-	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_device_vs_per_pass -q -rs -m slow
+	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_device_vs_per_pass tests/test_behavior_locks.py::test_churn_fleet_lock_6k_lanes8 -q -rs -m slow
 
 # The fault suite (docs/faults.md) on CPU in the sanitized environment
 # (tests/helpers.sanitized_cpu_env drops the axon sitecustomize that

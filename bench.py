@@ -345,6 +345,98 @@ def child_churn(
     return out
 
 
+def child_churn_fleet(seed: int, n_nodes: int, n_events: int, lanes: int) -> dict:
+    """Fleet replay rung (engine/fleet.py): the SAME churn stream on S
+    independent trajectories, one vmapped device dispatch per window,
+    shared universe lowered once.  Runs the solo device replay first so
+    the record carries the aggregate-throughput comparison the fleet
+    exists for: ``aggregate_speedup = lanes * solo_wall / fleet_wall``
+    (>= 3x at S=8 is the round-12 target), plus per-lane counts (every
+    lane must land the solo counts — the parity lock's bench twin), the
+    lanes-on-device fraction, and the cohort leader's lower_cache /
+    prelower / dev_const evidence (the lowered-once claim, readable
+    straight from this record)."""
+    import time
+
+    import jax
+
+    from ksim_tpu.scenario import ScenarioRunner, churn_scenario
+
+    _child_setup()
+    jax.config.update("jax_enable_x64", False)
+    kw = dict(
+        max_pods_per_pass=1024,
+        pod_bucket_min=128,
+        device_replay=True,
+        preemption=True,
+    )
+
+    def stream():
+        return churn_scenario(
+            seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100
+        )
+
+    # One untimed warm-up replay first: the timed solo run would
+    # otherwise carry all jit tracing/compilation that the in-process
+    # fleet run then reuses for free (dedupe mode dispatches the very
+    # same compiled program), biasing aggregate_speedup upward — both
+    # timed runs must start equally warm for the comparison to mean
+    # anything.
+    ScenarioRunner(**kw).run(stream())
+    t0 = time.perf_counter()
+    solo = ScenarioRunner(**kw)
+    rs = solo.run(stream())
+    solo_wall = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    fleet = ScenarioRunner(**kw, fleet=lanes)
+    rf = fleet.run(stream())
+    fleet_wall = time.perf_counter() - t1
+    leader = max(
+        (ln.driver for ln in fleet.fleet_lanes), key=lambda d: len(d.lower_log)
+    )
+    out = {
+        "events": n_events,
+        "nodes": n_nodes,
+        "lanes": lanes,
+        "solo_wall_s": round(solo_wall, 1),
+        "fleet_wall_s": round(fleet_wall, 1),
+        "trajectories_per_sec": round(lanes / fleet_wall, 3) if fleet_wall else None,
+        "aggregate_speedup": (
+            round(lanes * solo_wall / fleet_wall, 2) if fleet_wall else None
+        ),
+        "solo_counts": [rs.pods_scheduled, rs.unschedulable_attempts],
+        "lane_counts": [
+            [r.pods_scheduled, r.unschedulable_attempts] for r in rf.lanes
+        ],
+        "lanes_match_solo": all(
+            (r.pods_scheduled, r.unschedulable_attempts)
+            == (rs.pods_scheduled, rs.unschedulable_attempts)
+            for r in rf.lanes
+        ),
+        "fleet": fleet.fleet_driver.stats(),
+        "platform": jax.devices()[0].platform,
+        # The cohort leader's incremental-lowering evidence: with S
+        # convergent lanes, lower_cache hits + lane_lowerings==[N,0,...]
+        # in "fleet" above IS the lowered-once-per-window guard.
+        "lower_cache": leader.stats()["lower_cache"],
+        "prelower": leader.stats()["prelower"],
+        "dev_const": leader.stats()["dev_const"],
+    }
+    if rf.phase_seconds:
+        out["phases"] = {
+            name: {"seconds": rf.phase_seconds[name], "count": rf.phase_counts[name]}
+            for name in sorted(rf.phase_seconds)
+        }
+    print(
+        f"[churn_fleet {n_events}ev/{n_nodes}n x{lanes}] solo {solo_wall:.1f}s, "
+        f"fleet {fleet_wall:.1f}s ({out['aggregate_speedup']}x aggregate, "
+        f"lanes_on_device {out['fleet']['lanes_on_device']})",
+        file=sys.stderr,
+        flush=True,
+    )
+    return out
+
+
 def _proc_watermarks() -> dict:
     """This process's /proc watermarks (stdlib + procfs only, guarded
     for non-Linux): the memory-map count — XLA:CPU executables each mmap
@@ -392,6 +484,13 @@ def _child_main(args: argparse.Namespace) -> None:
                 args.churn_device,
                 args.churn_preempt,
                 args.churn_record_full,
+            )
+        elif args.child == "churn_fleet":
+            out = child_churn_fleet(
+                args.seed,
+                args.churn_nodes,
+                args.churn_events,
+                args.fleet_lanes,
             )
         else:  # pragma: no cover
             raise ValueError(f"unknown child mode {args.child!r}")
@@ -595,6 +694,13 @@ def main() -> None:
     ap.add_argument("--churn-device", action="store_true")
     ap.add_argument("--churn-preempt", action="store_true")
     ap.add_argument("--churn-record-full", action="store_true")
+    # Fleet width for the churn_fleet rung; KSIM_FLEET steers it through
+    # the environment (the stdlib-only parent just forwards the number).
+    try:
+        default_fleet = int(os.environ.get("KSIM_FLEET", "8"))
+    except ValueError:
+        default_fleet = 8
+    ap.add_argument("--fleet-lanes", type=int, default=default_fleet)
     try:
         default_budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     except ValueError:
@@ -606,7 +712,9 @@ def main() -> None:
         help="wall-clock budget (s); rungs stop in time to emit the JSON line",
     )
     # Internal: subprocess payload modes.
-    ap.add_argument("--child", choices=["probe", "rung", "churn"], default=None)
+    ap.add_argument(
+        "--child", choices=["probe", "rung", "churn", "churn_fleet"], default=None
+    )
     ap.add_argument("--pods", type=int, default=0)
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--slice-pods", type=int, default=0)
@@ -799,6 +907,7 @@ def main() -> None:
         child_args,
         timeout: float,
         min_budget: float = 90,
+        mode: str = "churn",
     ) -> None:
         """Shared scaffolding of the secondary churn rungs: the budget
         guard, the child launch, and the mid-run-fallback protocol (a
@@ -814,7 +923,7 @@ def main() -> None:
             return
 
         def launch(resized: bool) -> dict:
-            return orch.run_child("churn", child_args(resized), env, timeout)
+            return orch.run_child(mode, child_args(resized), env, timeout)
 
         result = launch(fallback)
         if "error" in result:
@@ -876,6 +985,30 @@ def main() -> None:
             CHURN_TIMEOUT,
         )
 
+    def run_churn_fleet_stage() -> None:
+        """Fleet replay rung (round 12, engine/fleet.py): S independent
+        trajectories of the 6k prefix at 2k nodes through one vmapped
+        dispatch per window, next to the SOLO device replay of the same
+        stream — the record carries trajectories/sec, the aggregate
+        speedup vs running the lanes solo (>= 3x at S=8 is the target),
+        per-lane counts (all must match solo), the lanes-on-device
+        fraction, and the cohort leader's lowered-once evidence.  Always
+        the 6k prefix: the rung runs lanes+1 trajectories' worth of
+        device compute, and the fleet claims are about amortization, not
+        stream length."""
+        run_secondary_churn_rung(
+            "churn_fleet",
+            lambda resized: [
+                "--seed", str(args.seed),
+                "--churn-events", str(min(args.churn_events, 6_000)),
+                "--churn-nodes", str(min(args.churn_nodes, CPU_CHURN_CAP[1])),
+                "--fleet-lanes", str(args.fleet_lanes),
+            ],
+            CHURN_TIMEOUT,
+            min_budget=120,
+            mode="churn_fleet",
+        )
+
     def run_churn_exact_stage() -> None:
         """Bounded exact-mode (x64) churn: demonstrates in the driver
         record that the replay counts are mode- and platform-identical
@@ -915,6 +1048,7 @@ def main() -> None:
     # a wedged child here must not starve the 10kx5k rung's budget.
     run_churn_device_stage()
     run_churn_device_full_stage()
+    run_churn_fleet_stage()
     run_churn_exact_stage()
     if fallback:
         # The north-star shape still gets a measured record on CPU: the

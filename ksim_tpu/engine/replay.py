@@ -1014,6 +1014,38 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
     return final_state, outs
 
 
+@partial(jax.jit, static_argnums=(0, 1))
+@device_kernel(static=("st", "prog"))
+def _fleet_segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
+    """Fleet replay: advance S INDEPENDENT trajectories by K steps in one
+    dispatch — ``_segment_fn`` vmapped over a leading lane axis on the
+    carried cluster state (``state0``).
+
+    ``const`` AND ``ev`` are closed over, i.e. broadcast across lanes:
+    the fleet's contract is that every grouped lane shares ONE lowered
+    plan (engine/fleet.py lowers it once via the cohort leader), so the
+    universe tables and the per-step event streams are lane-identical by
+    construction.  Keeping ``ev`` unbatched is load-bearing, not just a
+    transfer saving: the per-step inactive-tail ``lax.cond`` predicates
+    on ``ev['active']``, and under vmap a cond with a BATCHED predicate
+    lowers to select — both branches execute — while an unbatched
+    predicate keeps the real conditional, so tail padding stays free in
+    the batched program exactly as it is solo.  (The same select
+    semantics are why priority-flat windows lower preempt-free —
+    ``_lower``'s ``preempt_plan`` screen.)  Per-lane event DELTAS are
+    the ROADMAP's fleet round 2; they will stack ``ev`` and re-split
+    this axis handling.
+
+    The kernels are RNG-free and every per-lane reduction runs over the
+    same axes in the same order as the solo program, so each lane's
+    slice of the outputs is byte-identical to its solo ``_segment_fn``
+    dispatch — the fleet parity lock (tests/test_replay_device.py,
+    `make lock-check`)."""
+    import jax
+
+    return jax.vmap(lambda s: _segment_fn(st, prog, const, ev, s))(state0)
+
+
 # ---------------------------------------------------------------------------
 # Host driver: segment lowering, dispatch, reconcile
 # ---------------------------------------------------------------------------
@@ -1097,10 +1129,21 @@ class ReplayDriver:
         *,
         k: int = SEGMENT_STEPS,
         requeue_on_node_delete: bool = True,
+        lane: "int | None" = None,
+        lane_faults=None,
     ) -> None:
         self.store = store
         self.service = service
         self.k = max(int(k), 1)
+        # Fleet-lane identity (engine/fleet.py): stamped on every span
+        # and fallback event this driver emits so a Chrome trace from an
+        # S-lane run stays attributable, and the lane's PRIVATE fault
+        # plane (parsed from the per-lane KSIM_FLEET_FAULTS spec) checked
+        # next to the process-global FAULTS at the replay sites — a lane
+        # fault degrades only this lane.
+        self.lane = lane
+        self._lane_faults = lane_faults
+        self._span_tags = {} if lane is None else {"lane": lane}
         # The segment program bakes the runner's drain-requeue semantics
         # in; a no-requeue runner must take the per-pass path for any
         # segment containing node deletes.
@@ -1170,6 +1213,10 @@ class ReplayDriver:
         self.dev_const_hits = 0  # guarded-by: main-thread
         self.dev_const_misses = 0  # guarded-by: main-thread
         self.lower_log: list[dict] = []  # guarded-by: main-thread
+        # Last _reject reason — the fleet coordinator mirrors a shared
+        # (cohort-leader) rejection onto every follower lane's histogram
+        # so per-lane evidence matches what each solo run would record.
+        self._last_reject: "str | None" = None  # guarded-by: main-thread
         # The live driver's degradation evidence rides in the merged
         # /api/v1/metrics document (latest driver wins — one per
         # ScenarioRunner run).  Weakly referenced: the module-global
@@ -1220,9 +1267,15 @@ class ReplayDriver:
 
     def _reject(self, reason: str) -> None:
         self.unsupported[reason] = self.unsupported.get(reason, 0) + 1
+        self._last_reject = reason
         # Every degradation is a timeline event: reason + which window
         # (the lower/dispatch spans of the same segment share the seq).
-        TRACE.event("replay.fallback", reason=reason, segment=self._segment_seq)
+        TRACE.event(
+            "replay.fallback",
+            reason=reason,
+            segment=self._segment_seq,
+            **self._span_tags,
+        )
 
     def service_supported(self) -> bool:
         svc = self.service
@@ -1555,6 +1608,26 @@ class ReplayDriver:
         return out
 
     def _try_segment_impl(self, batches: list[list[Any]]):
+        plan = self.prepare_segment(batches)
+        if plan is None:
+            return None
+        return self.dispatch_segment(plan, batches)
+
+    def prepare_segment(
+        self, batches: list[list[Any]], *, check_lane_faults: bool = True
+    ) -> "_SegmentPlan | None":
+        """The lowering half of ``try_segment``: breaker / support / op
+        screens plus the classified lowering taxonomy, ending in a
+        dispatch-ready ``_SegmentPlan`` (device-const reuse attached) or
+        None with the reason recorded.  Split from the dispatch half so
+        the fleet coordinator (engine/fleet.py) can lower a shared
+        window ONCE on the cohort leader and dispatch all lanes in one
+        program.  The fleet passes ``check_lane_faults=False``: it gates
+        EVERY cohort lane's private plane itself (including the
+        leader's) so a lane-armed replay.lower fault degrades exactly
+        one lane — a check here too would both double-count the
+        leader's schedule and land the injected fault inside the SHARED
+        lowering, degrading the whole cohort."""
         if self.breaker_tripped:
             # Sticky: after the breaker opens, every window falls back
             # immediately — no lowering work, no watchdog tax.
@@ -1578,8 +1651,11 @@ class ReplayDriver:
                 "replay.lower",
                 segment=self._segment_seq,
                 steps=min(len(batches), wlen),
+                **self._span_tags,
             ) as sp:
                 FAULTS.check("replay.lower")
+                if check_lane_faults and self._lane_faults is not None:
+                    self._lane_faults.check("replay.lower")
                 if spec is None:
                     spec = self._parse_window(batches[:wlen])
                 m = min(spec.n, wlen)
@@ -1607,9 +1683,18 @@ class ReplayDriver:
             and self._dev_consts_x64 == bool(jax.config.jax_enable_x64)
         ):
             plan.dev_reuse = self._dev_consts
+        return plan
+
+    def dispatch_segment(self, plan: "_SegmentPlan", batches: list[list[Any]]):
+        """The dispatch half of ``try_segment``: the watchdogged device
+        run plus post-dispatch accounting.  Returns the SegmentOutcome
+        or None (reason recorded, breaker fed)."""
         try:
             with TRACE.span(
-                "replay.dispatch", segment=self._segment_seq, steps=plan.n_steps
+                "replay.dispatch",
+                segment=self._segment_seq,
+                steps=plan.n_steps,
+                **self._span_tags,
             ):
                 res = self._run_watchdogged(plan, batches)
         except ReplayParityError:
@@ -1621,19 +1706,7 @@ class ReplayDriver:
             return self._note_device_error(e)
         # The dispatch came back healthy (even if validation discarded
         # the segment): the backend is alive — reset the breaker window.
-        self._consecutive_device_errors = 0
-        self.device_round_trips += 1
-        if self._dev_cache_on is None:
-            # Safe to probe now: the dispatch initialized the backend on
-            # the watchdogged worker, so this is an instant lookup.
-            self._dev_cache_on = jax.default_backend() == "cpu"
-        if self._dev_cache_on and plan.dev_map_out is not None:
-            # Adopt this dispatch's device buffers for id-keyed reuse by
-            # the next one (main thread: _run never mutates the driver).
-            self._dev_consts = plan.dev_map_out
-            self._dev_consts_x64 = bool(jax.config.jax_enable_x64)
-            self.dev_const_hits += plan.dev_hits
-            self.dev_const_misses += plan.dev_misses
+        self.note_dispatch_healthy(plan)
         if isinstance(res, str):
             # Post-dispatch validation discard (featurize_prediction /
             # preemption_overflow): store untouched, fall back.
@@ -1644,6 +1717,26 @@ class ReplayDriver:
         # here would double-book them).
         self._last_plan = plan
         return res
+
+    def note_dispatch_healthy(self, plan: "_SegmentPlan", *, adopt: bool = True) -> None:
+        """Main-thread accounting for one healthy dispatch join: breaker
+        window reset, round-trip count, device-const buffer adoption.
+        Shared by the solo path above and the fleet's group dispatch
+        (where every lane's driver gets the reset but only the plan
+        OWNER — the cohort leader — adopts the buffers, ``adopt``)."""
+        self._consecutive_device_errors = 0
+        self.device_round_trips += 1
+        if self._dev_cache_on is None:
+            # Safe to probe now: the dispatch initialized the backend on
+            # the watchdogged worker, so this is an instant lookup.
+            self._dev_cache_on = jax.default_backend() == "cpu"
+        if adopt and self._dev_cache_on and plan.dev_map_out is not None:
+            # Adopt this dispatch's device buffers for id-keyed reuse by
+            # the next one (main thread: _run never mutates the driver).
+            self._dev_consts = plan.dev_map_out
+            self._dev_consts_x64 = bool(jax.config.jax_enable_x64)
+            self.dev_const_hits += plan.dev_hits
+            self.dev_const_misses += plan.dev_misses
 
     def _run_watchdogged(self, plan: "_SegmentPlan", future: list[list[Any]]):
         """Run ``_run`` on a worker thread bounded by the watchdog, and
@@ -1918,6 +2011,35 @@ class ReplayDriver:
         if len(row_of) != len(universe_pods):
             raise _Unsupported("duplicate_pod_keys")
 
+        # On-device preemption statics, window-scoped: a PRIORITY-FLAT
+        # window can never enter DefaultPreemption's search — a
+        # candidate node needs a bound pod of strictly LOWER priority
+        # than the preemptor (`prow["priority"] < prio_p`), and no pod
+        # carries a prior nomination — so it lowers preempt-free: the
+        # bounded victim search is neither compiled nor traced.  Besides
+        # the solo compile win, this is what keeps FLEET dispatch honest
+        # (round 12): under jax.vmap a lax.cond lowers to select — BOTH
+        # branches execute for every pod attempt — so for the search's
+        # no-candidate case to stay free in a batched program it must be
+        # absent from the statics, not merely predicated off.
+        # record="full" keeps the search statics regardless: with
+        # preemption enabled the host path writes a postfilter-result
+        # annotation for every failed attempt, which only the preempt
+        # decode path reproduces.
+        preempt_plan = self._preempt_active
+        prios = None
+        if preempt_plan:
+            prios = [priority_of(p) for p in universe_pods]
+            if (
+                self._record_mode == "selection"
+                and not any(
+                    p.get("status", {}).get("nominatedNodeName")
+                    for p in cur_pods
+                )
+                and (not prios or prios.count(prios[0]) == len(prios))
+            ):
+                preempt_plan = False
+
         # Featurize the universe once (persistent device featurizer:
         # per-pod rows memoize, bound aggregates update by delta; with
         # the identity-stable cached universe, fresh row builds are
@@ -1966,7 +2088,7 @@ class ReplayDriver:
                     raise _Unsupported(f"host_hook:{attr}")
         prog = _Program(plugins, self._record_mode)
 
-        if self._preempt_active:
+        if preempt_plan:
             from ksim_tpu.scheduler.preemption import (
                 ORACLE_FIT_FILTER_NAMES,
                 VOLUME_FIT_FILTER_NAMES,
@@ -2098,7 +2220,7 @@ class ReplayDriver:
             j = slot_of.get(nm)
             if j is not None:
                 rank_row[j] = slot
-        need_names = self._preempt_active or self._record_mode == "full"
+        need_names = preempt_plan or self._record_mode == "full"
         live_sorted: list[str] = sorted(node_names)
         live_slots = (
             np.asarray([slot_of[nm] for nm in live_sorted], np.int64)
@@ -2159,7 +2281,7 @@ class ReplayDriver:
             n_tk=ipa.node_dom.shape[1],
             n_dom=n_dom_pad,
             record=self._record_mode,
-            preempt=self._preempt_active,
+            preempt=preempt_plan,
             c_max=PREEMPT_CANDIDATES,
             v_max=PREEMPT_VICTIMS,
         )
@@ -2204,7 +2326,7 @@ class ReplayDriver:
             )
             if K * q * N * per_cell > FULL_RECORD_BYTES:
                 raise _Unsupported("full_record_bytes")
-        if self._preempt_active:
+        if preempt_plan:
             from ksim_tpu.scheduler.preemption import (
                 more_important_key,
                 pod_eligible_to_preempt,
@@ -2234,8 +2356,7 @@ class ReplayDriver:
             imp_rank = np.full(P, _I32_MAX, np.int32)
             start_rank = np.zeros(P, np.int32)
             preempt_ok = np.zeros(P, bool)
-            prios = [priority_of(p) for p in universe_pods]
-            priority[:U] = prios
+            priority[:U] = prios  # computed with the preempt_plan screen above
             for r, j in enumerate(
                 sorted(range(U), key=lambda j: mik(universe_pods[j]))
             ):
@@ -2438,61 +2559,31 @@ class ReplayDriver:
         either way).  Runs on the watchdog worker thread: it must not
         mutate driver state — ``try_segment`` applies all accounting on
         the main thread after a successful join."""
-        from ksim_tpu.engine.core import (
-            _aux_host,
-            _pack_tree_to_device,
-            _pull_tree_to_host,
-        )
+        if self._lane_faults is not None:
+            # The lane's private plane fires here — inside the
+            # watchdogged worker like the global plane — so a
+            # lane-armed hang schedule is watchdog-bounded on the solo
+            # path.  The check lives in _run, NOT _device_exec: the
+            # fleet's group dispatch calls _device_exec directly after
+            # gating every lane's plane on the coordinator thread, and
+            # a second check here would double-count the leader's
+            # schedule.
+            self._lane_faults.check("replay.dispatch")
+        pulled_state, pulled = self._device_exec(plan)
+        return self._decode_outputs(plan, pulled_state, pulled)
+
+    def _device_exec(self, plan: "_SegmentPlan"):  # ksimlint: worker-thread
+        """The device half of a dispatch: pack constants (id-keyed
+        buffer reuse), execute the compiled segment program, pull the
+        carried state + per-step outputs back to host numpy.  Worker
+        thread; side-effect-free on the driver (packing evidence rides
+        on the plan)."""
+        from ksim_tpu.engine.core import _pull_tree_to_host
 
         FAULTS.check("replay.dispatch")
-        aux_host, _axes = _aux_host(plan.aux)
-        const = dict(plan.const)
-        extra = {
-            k: const[k] for k in ("resolv", "empty_start_rank") if k in const
-        }
-        # Constant buffers (node statics, pod rows, aux tables) that are
-        # the SAME host arrays as the previous dispatch — the featurizer
-        # family caches and the lowered-universe cache keep them
-        # identity-stable when the underlying objects survived — reuse
-        # their device buffers instead of re-transferring; everything
-        # else (always the per-segment ev/state0 streams) packs into the
-        # usual single byte-buffer transfer.  The id-keyed map pins its
-        # host arrays, so a recycled id can never alias a fresh array.
-        cacheable = (const["node"], const["pods"], extra, aux_host)
-        transient = (plan.ev, plan.state0)
-        c_leaves, c_def = jax.tree_util.tree_flatten(cacheable)
-        t_leaves, t_def = jax.tree_util.tree_flatten(transient)
-        reuse = plan.dev_reuse
-        dev_c: list[Any] = [None] * len(c_leaves)
-        miss_idx: list[int] = []
-        for i, a in enumerate(c_leaves):
-            ent = reuse.get(id(a)) if reuse else None
-            if ent is not None and ent[0] is a:
-                dev_c[i] = ent[1]
-            else:
-                miss_idx.append(i)
-        packed = _pack_tree_to_device([c_leaves[i] for i in miss_idx] + t_leaves)
-        for pos, i in enumerate(miss_idx):
-            dev_c[i] = packed[pos]
-        plan.dev_hits = len(c_leaves) - len(miss_idx)
-        plan.dev_misses = len(miss_idx)
-        # Collected only when the driver will adopt it: with the reuse
-        # cache off, holding this map in the retained plan would pin a
-        # full segment's constant device buffers across the next window
-        # — the KSIM_H2D_CACHE pinning pathology (engine/core.py) the
-        # off-default exists to avoid.
-        plan.dev_map_out = (
-            {id(a): (a, d) for a, d in zip(c_leaves, dev_c)}
-            if plan.dev_collect
-            else None
+        const_dev, (ev_dev, state_dev) = _pack_plan_buffers(
+            plan, (plan.ev, plan.state0)
         )
-        node_dev, pods_dev, extra_dev, aux_dev = jax.tree_util.tree_unflatten(
-            c_def, dev_c
-        )
-        ev_dev, state_dev = jax.tree_util.tree_unflatten(
-            t_def, packed[len(miss_idx):]
-        )
-        const_dev = {"node": node_dev, "pods": pods_dev, "aux": aux_dev, **extra_dev}
         final_state, outs = _segment_fn(
             plan.statics, plan.prog, const_dev, ev_dev, state_dev
         )
@@ -2505,7 +2596,18 @@ class ReplayDriver:
                 outs,
             )
         )
+        return pulled_state, pulled
 
+    def _decode_outputs(  # ksimlint: worker-thread
+        self, plan: "_SegmentPlan", pulled_state, pulled
+    ) -> "SegmentOutcome | str":
+        """The host half of a dispatch: validate the featurize/overflow
+        predictions and decode the pulled tensors into a SegmentOutcome
+        (or a discard-reason string).  Runs on the watchdog worker for
+        solo dispatches; the fleet calls it once per LANE on the main
+        thread with that lane's slice of the stacked outputs — the
+        decode only reads the (shared) plan, the lane's pulled arrays,
+        and the lane's own service backoff table."""
         st = plan.statics
         eligible = np.asarray(pulled["eligible"])
         for k in range(plan.n_steps):
@@ -2742,6 +2844,121 @@ class ReplayDriver:
                 "run on the per-pass host path",
                 self._consecutive_reconcile_faults, self.breaker_threshold,
             )
+
+
+def _plan_const_parts(plan: "_SegmentPlan"):
+    """The plan's universe-constant trees in canonical order (node
+    statics, pod rows, the optional preemption extras, the packed aux
+    host tree) — the id-keyed-reuse "cacheable" half of a dispatch's
+    inputs, shared by the solo and fleet executors."""
+    from ksim_tpu.engine.core import _aux_host
+
+    aux_host, _axes = _aux_host(plan.aux)
+    const = dict(plan.const)
+    extra = {k: const[k] for k in ("resolv", "empty_start_rank") if k in const}
+    return (const["node"], const["pods"], extra, aux_host)
+
+
+def _const_dev_dict(cacheable_dev) -> dict:
+    node_dev, pods_dev, extra_dev, aux_dev = cacheable_dev
+    return {"node": node_dev, "pods": pods_dev, "aux": aux_dev, **extra_dev}
+
+
+def _pack_plan_buffers(plan: "_SegmentPlan", transient):
+    """ONE transfer protocol for both executors: constant buffers (node
+    statics, pod rows, aux tables) that are the SAME host arrays as the
+    previous dispatch — the featurizer family caches and the
+    lowered-universe cache keep them identity-stable when the
+    underlying objects survived — reuse their device buffers instead of
+    re-transferring; everything else (the caller's per-segment
+    ``transient`` tree: event streams + the solo or lane-stacked carry)
+    packs into the usual single byte-buffer transfer.  The id-keyed map
+    pins its host arrays, so a recycled id can never alias a fresh
+    array.  Reuse evidence (dev_hits/dev_misses) and the next window's
+    reuse map (dev_map_out, only when the driver will adopt it — with
+    the cache off, retaining it would pin a full segment's constant
+    buffers across the next window: the KSIM_H2D_CACHE pinning
+    pathology, engine/core.py) ride on the plan.
+
+    Returns ``(const_dev, transient_dev)``."""
+    from ksim_tpu.engine.core import _pack_tree_to_device
+
+    cacheable = _plan_const_parts(plan)
+    c_leaves, c_def = jax.tree_util.tree_flatten(cacheable)
+    t_leaves, t_def = jax.tree_util.tree_flatten(transient)
+    reuse = plan.dev_reuse
+    dev_c: list[Any] = [None] * len(c_leaves)
+    miss_idx: list[int] = []
+    for i, a in enumerate(c_leaves):
+        ent = reuse.get(id(a)) if reuse else None
+        if ent is not None and ent[0] is a:
+            dev_c[i] = ent[1]
+        else:
+            miss_idx.append(i)
+    packed = _pack_tree_to_device([c_leaves[i] for i in miss_idx] + t_leaves)
+    for pos, i in enumerate(miss_idx):
+        dev_c[i] = packed[pos]
+    plan.dev_hits = len(c_leaves) - len(miss_idx)
+    plan.dev_misses = len(miss_idx)
+    plan.dev_map_out = (
+        {id(a): (a, d) for a, d in zip(c_leaves, dev_c)}
+        if plan.dev_collect
+        else None
+    )
+    const_dev = _const_dev_dict(jax.tree_util.tree_unflatten(c_def, dev_c))
+    transient_dev = jax.tree_util.tree_unflatten(t_def, packed[len(miss_idx):])
+    return const_dev, transient_dev
+
+
+def _fleet_exec(plan: "_SegmentPlan", lanes_state0, mesh=None):
+    """One vmapped dispatch advancing S independent trajectories by the
+    plan's K steps (engine/fleet.py's group dispatch; runs on the fleet
+    watchdog worker thread).
+
+    ``lanes_state0`` is one carried-state tree per lane, all
+    shape-identical to the plan's own: the scan carry stacks along a
+    new leading lane axis while the universe constants AND the per-step
+    event streams transfer once and broadcast across lanes
+    (``_fleet_segment_fn`` closes over them — see its docstring for why
+    broadcasting ``ev`` is load-bearing under vmap).  With ``mesh`` (a
+    ``KSIM_FLEET_DP`` dp-mesh), the lane axis is laid over the mesh's
+    ``dp`` axis instead — lanes spread across devices, constants and
+    events replicated — and the id-keyed device-buffer reuse map is
+    bypassed (it holds single-device buffers).
+
+    Returns ``(pulled_state, pulled)`` exactly as a solo dispatch would,
+    with a leading lane axis on every leaf; the caller decodes each
+    lane's slice through ``ReplayDriver._decode_outputs``.  Module
+    function, side-effect-free on every driver (packing evidence rides
+    on the plan, applied by the fleet on the main thread)."""
+    from ksim_tpu.engine.core import _pull_tree_to_host
+
+    FAULTS.check("replay.dispatch")
+    st_s = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *lanes_state0)
+    if mesh is not None:
+        from ksim_tpu.engine import sharding
+
+        cacheable = _plan_const_parts(plan)
+        const_dev = _const_dev_dict(sharding.replicate_tree(cacheable, mesh))
+        ev_dev = sharding.replicate_tree(plan.ev, mesh)
+        state_dev = sharding.shard_lane_axis(st_s, mesh)
+        plan.dev_hits = 0
+        plan.dev_misses = len(jax.tree_util.tree_leaves(cacheable))
+        plan.dev_map_out = None
+    else:
+        const_dev, (ev_dev, state_dev) = _pack_plan_buffers(plan, (plan.ev, st_s))
+    final_state, outs = _fleet_segment_fn(
+        plan.statics, plan.prog, const_dev, ev_dev, state_dev
+    )
+    return _pull_tree_to_host(
+        (
+            {
+                k: final_state[k]
+                for k in ("alive", "bound", "attempts", "retry_at", "pass_count")
+            },
+            outs,
+        )
+    )
 
 
 @dataclass

@@ -67,6 +67,42 @@ def shard_node_state(state: NodeStateView, mesh: Mesh) -> NodeStateView:
     )
 
 
+def fleet_mesh(dp: int) -> Mesh:
+    """A pure data-parallel mesh for fleet replay (``KSIM_FLEET_DP``):
+    the stacked trajectory (lane) axis lays over ``dp`` devices, tp=1 —
+    each lane's segment scan runs whole on one device, GSPMD only splits
+    the lane axis.  Raises if the host has fewer than ``dp`` devices."""
+    devices = jax.devices()
+    if len(devices) < dp:
+        raise ValueError(
+            f"KSIM_FLEET_DP={dp} but only {len(devices)} device(s) present"
+        )
+    return Mesh(np.asarray(devices[:dp]).reshape(dp, 1), (DP, TP))
+
+
+def shard_lane_axis(tree, mesh: Mesh):
+    """Lay every leaf's LEADING (lane) axis over the mesh's dp axis;
+    later axes stay unsharded (a lane's cluster state lives whole on its
+    device — the fleet's dp parallelism is across trajectories, not
+    inside one)."""
+
+    def put(a):
+        spec = P(DP, *([None] * (a.ndim - 1))) if a.ndim else P()
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def replicate_tree(tree, mesh: Mesh):
+    """Replicate every leaf across the whole mesh (the fleet's shared
+    universe constants: every lane reads the same tables)."""
+
+    def put(a):
+        return jax.device_put(a, NamedSharding(mesh, P(*([None] * a.ndim))))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
 def shard_aux(aux: dict, axes: dict, mesh: Mesh) -> dict:
     """Shard encoding arrays by their declared leading-axis kind
     ("node" -> TP, "pod" -> DP, None -> replicated) — see the AXES

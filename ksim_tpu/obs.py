@@ -110,6 +110,10 @@ EVENT_NAMES: tuple[str, ...] = (
     #                             (args.reason: fallback / rollback /
     #                             epoch_mismatch / epoch_raced /
     #                             sched_config / no_plan)
+    "replay.fleet_lane_fallback",  # one fleet lane left the convergent
+    #                                cohort (args.lane, args.reason) and
+    #                                continues on the solo device path
+    #                                (engine/fleet.py)
 )
 
 _KNOWN_NAMES = frozenset(SPAN_NAMES) | frozenset(EVENT_NAMES)

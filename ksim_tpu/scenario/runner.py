@@ -61,6 +61,13 @@ class ScenarioResult:
     # reported side by side, not additive).
     phase_seconds: dict[str, float] = field(default_factory=dict)
     phase_counts: dict[str, int] = field(default_factory=dict)
+    # Fleet replay (engine/fleet.py): the per-lane ScenarioResults, in
+    # lane order.  The top-level counts/steps are then AGGREGATES over
+    # the lanes (events/scheduled/unschedulable summed; ``steps`` stays
+    # empty — per-trajectory step records live on the lanes) and the
+    # phase split covers the whole fleet run (spans are shared across
+    # lanes by design — the group dispatch IS one span).
+    lanes: "list[ScenarioResult] | None" = None
 
     @property
     def events_per_second(self) -> float:
@@ -90,6 +97,8 @@ class ScenarioRunner:
         pod_bucket_min: int | None = None,
         device_replay: bool = False,
         device_segment_steps: int | None = None,
+        fleet: int | None = None,
+        fleet_faults: str | None = None,
     ) -> None:
         """``device_replay=True`` routes supported step segments through
         the device-resident path (engine/replay.py): K steps of event
@@ -100,7 +109,32 @@ class ScenarioRunner:
         back to this per-pass path automatically; DefaultPreemption
         (``preemption=True``) and ``record="full"`` segments stay
         on-device since round 7 (on-device victim search + streamed
-        result tensors)."""
+        result tensors).
+
+        ``fleet=S`` (requires ``device_replay=True``) replays S
+        INDEPENDENT trajectories — each with its own store, service and
+        replay driver — advancing the whole fleet K steps per vmapped
+        device dispatch with the shared universe lowered once per window
+        (engine/fleet.py).  ``run`` then returns the aggregate result
+        with the per-lane results on ``.lanes``; per-lane chaos arms via
+        ``fleet_faults`` / ``KSIM_FLEET_FAULTS`` (``lane:site=schedule``
+        entries), per-lane streams via ``run(..., lane_ops=...)``.  Lane
+        0 reuses this runner's own store/service, so existing evidence
+        surfaces (``.store``, ``.replay_driver``) stay meaningful."""
+        if fleet is not None:
+            if fleet < 2:
+                raise ValueError("fleet needs at least 2 lanes")
+            if not device_replay:
+                raise ValueError("fleet replay requires device_replay=True")
+            if store is not None or service is not None:
+                raise ValueError(
+                    "fleet lanes build their own stores/services; pass the "
+                    "service CONFIG (record/preemption/...) instead"
+                )
+        elif fleet_faults is not None:
+            # A lane fault spec with no fleet would be silently dropped —
+            # the vacuously-green chaos sweep parse_fleet_faults refuses.
+            raise ValueError("fleet_faults requires fleet=S")
         self.store = store if store is not None else ClusterStore()
         self.service = (
             service
@@ -117,9 +151,28 @@ class ScenarioRunner:
         self._drained_nodes: set[str] = set()
         self._device_replay = device_replay
         self._device_segment_steps = device_segment_steps
+        self._fleet = fleet
+        self._fleet_faults = fleet_faults
+        # Per-lane service construction config (fleet lanes must match
+        # lane 0's scheduling semantics exactly).
+        self._lane_cfg = dict(
+            record=record,
+            preemption=preemption,
+            max_pods_per_pass=max_pods_per_pass,
+            pod_bucket_min=pod_bucket_min,
+        )
+        # Fleet-lane identity: set on per-lane runners so the reconcile
+        # and per-pass spans (and the lane's private fault plane) stay
+        # attributable per trajectory.
+        self._lane: int | None = None
+        self._lane_faults = None
         # The last run's ReplayDriver (evidence counters: device_steps,
         # fallback_steps, device_round_trips, unsupported reasons).
         self.replay_driver = None
+        # Fleet evidence (set by a fleet run): the FleetDriver (stats())
+        # and the FleetLane list (per-lane runners/drivers/results).
+        self.fleet_driver = None
+        self.fleet_lanes = None
 
     # -- one operation ------------------------------------------------------
 
@@ -217,7 +270,8 @@ class ScenarioRunner:
     def _run_step(self, step: int, batch: list[Operation], result: ScenarioResult) -> bool:
         """The per-pass step body: apply ops, flush, one scheduling pass.
         Returns the done flag."""
-        with TRACE.span("runner.step", step=step, ops=len(batch)):
+        tags = {} if self._lane is None else {"lane": self._lane}
+        with TRACE.span("runner.step", step=step, ops=len(batch), **tags):
             return self._run_step_traced(step, batch, result)
 
     def _run_step_traced(
@@ -359,11 +413,13 @@ class ScenarioRunner:
 
         evictions: list[tuple[str, str]] = []
         step_nodes: list = []
+        tags = {} if self._lane is None else {"lane": self._lane}
         try:
             with TRACE.span(
                 "replay.reconcile",
                 segment=driver._segment_seq,
                 steps=len(seg.steps),
+                **tags,
             ), self.store.transaction(epoch_exempt=True):
                 # epoch_exempt: the segment's own staged writes are the
                 # deltas the driver's lower-cache already tracks; only
@@ -372,6 +428,11 @@ class ScenarioRunner:
                 # the explicit invalidation path (note_reconcile_fault).
                 for batch, outcome in zip(batches, seg.steps):
                     FAULTS.check("replay.reconcile")
+                    if self._lane_faults is not None:
+                        # The lane's PRIVATE plane (fleet chaos): an
+                        # injected fault here rolls back ONLY this
+                        # lane's segment.
+                        self._lane_faults.check("replay.reconcile")
                     self._stage_device_step(batch, outcome, evictions)
                     # Captured per step for the deferred slot advance:
                     # live node dicts are frozen (replace-on-write), so
@@ -399,12 +460,26 @@ class ScenarioRunner:
             self._record_device_step(step, batch, outcome, result)
         return True
 
-    def run(self, ops: Iterable[Operation]) -> ScenarioResult:
+    def run(
+        self,
+        ops: Iterable[Operation],
+        *,
+        lane_ops: "dict[int, Iterable[Operation]] | None" = None,
+    ) -> ScenarioResult:
         """Apply operations grouped by step; one scheduling pass per step
         (every pending pod is attempted each pass, like the upstream
         queue's flush on cluster events).  With ``device_replay`` on,
         supported K-step segments run as single device dispatches (see
-        engine/replay.py); everything else takes this per-pass loop."""
+        engine/replay.py); everything else takes this per-pass loop.
+
+        With ``fleet=S`` the stream replays on every lane (``lane_ops``
+        overrides individual lanes' streams — those lanes run the solo
+        device path, outside the shared-universe cohort) and the result
+        carries the per-lane results on ``.lanes``."""
+        if self._fleet is not None:
+            return self._run_fleet(ops, lane_ops)
+        if lane_ops:
+            raise ValueError("lane_ops requires fleet=S")
         result = ScenarioResult()
         # Per-phase wall-clock split rides on the trace plane's latency
         # histograms; timing-only mode costs two clock reads per span at
@@ -413,10 +488,7 @@ class ScenarioRunner:
         TRACE.ensure_timing()
         phase0 = TRACE.phase_totals()
         t0 = time.perf_counter()
-        by_step: dict[int, list[Operation]] = {}
-        for op in ops:
-            by_step.setdefault(op.step, []).append(op)
-        keys = sorted(by_step)
+        by_step, keys = self._group_by_step(ops)
         driver = None
         if self._device_replay:
             from ksim_tpu.engine.replay import SEGMENT_STEPS, ReplayDriver
@@ -475,3 +547,115 @@ class ScenarioRunner:
                 result.phase_seconds[name] = round(total - prev_total, 6)
                 result.phase_counts[name] = count - prev_count
         return result
+
+    @staticmethod
+    def _group_by_step(ops: Iterable[Operation]) -> tuple[dict, list]:
+        by_step: dict[int, list[Operation]] = {}
+        for op in ops:
+            by_step.setdefault(op.step, []).append(op)
+        return by_step, sorted(by_step)
+
+    def _run_fleet(self, ops, lane_ops) -> ScenarioResult:
+        """Fleet replay (engine/fleet.py): S independent trajectories,
+        the shared universe lowered once per window, one vmapped
+        dispatch per cohort window, per-lane reconcile into each lane's
+        own store.  Parity contract: every lane's counts/annotations
+        are byte-identical to its solo ``device_replay=True`` run."""
+        import os
+
+        from ksim_tpu.engine.fleet import FleetDriver, FleetLane, parse_fleet_faults
+        from ksim_tpu.engine.replay import SEGMENT_STEPS, ReplayDriver
+
+        n = self._fleet
+        if lane_ops:
+            # Same refusal parse_fleet_faults makes for out-of-range
+            # lanes: a typoed index would silently replay the BASE
+            # stream on every lane and the sweep would be vacuous.
+            bad = sorted(k for k in lane_ops if not 0 <= k < n)
+            if bad:
+                raise ValueError(
+                    f"lane_ops lanes {bad} outside the fleet (0..{n - 1})"
+                )
+        spec = self._fleet_faults
+        if spec is None:
+            spec = os.environ.get("KSIM_FLEET_FAULTS", "")
+        planes = parse_fleet_faults(spec, n) if spec else {}
+        base_by_step, base_keys = self._group_by_step(ops)
+        lanes: list[FleetLane] = []
+        for idx in range(n):
+            if idx == 0:
+                lane_runner = ScenarioRunner(
+                    store=self.store,
+                    service=self.service,
+                    requeue_on_node_delete=self._requeue,
+                    device_replay=True,
+                    device_segment_steps=self._device_segment_steps,
+                )
+            else:
+                lane_runner = ScenarioRunner(
+                    requeue_on_node_delete=self._requeue,
+                    device_replay=True,
+                    device_segment_steps=self._device_segment_steps,
+                    **self._lane_cfg,
+                )
+            lane_runner._lane = idx
+            lane_runner._lane_faults = planes.get(idx)
+            lane_runner.service._trace_lane = idx
+            own = lane_ops.get(idx) if lane_ops else None
+            if own is not None:
+                # A per-lane stream: this trajectory is divergent from
+                # the start and rides the solo device path.
+                by_step, keys = self._group_by_step(own)
+                shared = False
+            else:
+                # Cohort lanes share the base dict — the SAME batch list
+                # objects, which is what lets the leader's speculative
+                # prelower spec match by identity for every lane.
+                by_step, keys = base_by_step, base_keys
+                shared = True
+            driver = ReplayDriver(
+                lane_runner.store,
+                lane_runner.service,
+                k=self._device_segment_steps or SEGMENT_STEPS,
+                requeue_on_node_delete=self._requeue,
+                lane=idx,
+                lane_faults=planes.get(idx),
+            )
+            lane_runner.replay_driver = driver
+            lanes.append(
+                FleetLane(
+                    idx=idx,
+                    runner=lane_runner,
+                    driver=driver,
+                    keys=keys,
+                    by_step=by_step,
+                    result=ScenarioResult(),
+                    faults=planes.get(idx),
+                    shared_stream=shared,
+                    convergent=shared,
+                )
+            )
+        fleet = FleetDriver(lanes)
+        self.fleet_driver = fleet
+        self.fleet_lanes = lanes
+        self.replay_driver = lanes[0].driver
+        TRACE.ensure_timing()
+        phase0 = TRACE.phase_totals()
+        t0 = time.perf_counter()
+        fleet.run()
+        wall = time.perf_counter() - t0
+        agg = ScenarioResult(lanes=[ln.result for ln in lanes])
+        for ln in lanes:
+            ln.result.wall_seconds = wall  # fleet lanes finish together
+            agg.events_applied += ln.result.events_applied
+            agg.pods_scheduled += ln.result.pods_scheduled
+            agg.unschedulable_attempts += ln.result.unschedulable_attempts
+        # Solo semantics per lane: succeeded = a doneOperation completed.
+        agg.succeeded = all(ln.result.succeeded for ln in lanes)
+        agg.wall_seconds = wall
+        for name, (total, count) in TRACE.phase_totals().items():
+            prev_total, prev_count = phase0.get(name, (0.0, 0))
+            if count > prev_count:
+                agg.phase_seconds[name] = round(total - prev_total, 6)
+                agg.phase_counts[name] = count - prev_count
+        return agg

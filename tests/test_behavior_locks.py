@@ -167,3 +167,72 @@ def test_churn_lock_50k_stepwise_device_vs_per_pass():
     assert steady, driver.lower_log
     for entry in steady:
         assert entry["rows_built"] <= entry["events"] + 32, entry
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["dedupe", "vmap"])
+def test_churn_fleet_lock_6k_lanes8(mode, monkeypatch):
+    """The fleet parity lock (`make lock-check`, round 12): 8 lanes of
+    the locked 6k prefix through BOTH cohort dispatch modes — every
+    lane must land 2524/471 with stepwise triples identical to the solo
+    device run, the whole fleet on-device, and the shared universe
+    lowered ONCE per window (counter-based guard: only the cohort
+    leader's driver ever lowers; every follower records zero).
+
+    The ``vmap`` leg runs the genuinely lane-stacked
+    ``_fleet_segment_fn`` program (KSIM_FLEET_VMAP=1) — the proof that
+    the carry, the RNG-free kernels and the reconcile boundaries are
+    lane-INDEPENDENT, not merely that one trajectory fans out.  The
+    ``dedupe`` leg locks the production default (one dispatch, S
+    decodes/reconciles, each lane's verify_segment proving its own
+    store)."""
+    jax.config.update("jax_enable_x64", False)
+    if mode == "vmap":
+        monkeypatch.setenv("KSIM_FLEET_VMAP", "1")
+    else:
+        monkeypatch.delenv("KSIM_FLEET_VMAP", raising=False)
+    kw = dict(max_pods_per_pass=1024, pod_bucket_min=128, preemption=True)
+
+    def stream():
+        return churn_scenario(0, n_nodes=2000, n_events=6000, ops_per_step=100)
+
+    solo_r = ScenarioRunner(device_replay=True, **kw)
+    solo = solo_r.run(stream())
+    assert (solo.pods_scheduled, solo.unschedulable_attempts) == (
+        LOCK_SCHEDULED,
+        LOCK_UNSCHEDULABLE,
+    )
+    solo_sig = [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in solo.steps
+    ]
+    fleet_r = ScenarioRunner(device_replay=True, fleet=8, **kw)
+    agg = fleet_r.run(stream())
+    assert agg.pods_scheduled == 8 * LOCK_SCHEDULED
+    assert agg.unschedulable_attempts == 8 * LOCK_UNSCHEDULABLE
+    for ln in fleet_r.fleet_lanes:
+        r = ln.result
+        assert (r.pods_scheduled, r.unschedulable_attempts) == (
+            LOCK_SCHEDULED,
+            LOCK_UNSCHEDULABLE,
+        ), f"lane {ln.idx}"
+        sig = [
+            (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in r.steps
+        ]
+        assert sig == solo_sig, f"lane {ln.idx} stepwise divergence"
+        assert ln.convergent
+        assert ln.driver.fallback_steps == 0, ln.driver.unsupported
+    stats = fleet_r.fleet_driver.stats()
+    assert stats["cohort_mode"] == mode
+    # The lowered-once-per-window guard: one driver (the cohort leader)
+    # did ALL the lowering; 7 followers did none — and the leader's
+    # lowered-universe cache stayed hot exactly as the solo run's does.
+    lowerings = stats["lane_lowerings"]
+    assert sum(lowerings) == max(lowerings) > 0, stats
+    assert lowerings.count(0) == 7, stats
+    assert stats["lanes_on_device"] == 1.0, stats
+    assert stats["group_dispatches"] == stats["shared_lowerings"]
+    leader = max(
+        (ln.driver for ln in fleet_r.fleet_lanes), key=lambda d: len(d.lower_log)
+    )
+    cache = leader.stats()["lower_cache"]
+    assert cache["misses"] == 1 and cache["invalidations"] == 0, cache

@@ -13,6 +13,8 @@ import signal
 import subprocess
 import sys
 import time
+
+import pytest
 from pathlib import Path
 
 from tests.helpers import sanitized_cpu_env
@@ -124,3 +126,96 @@ def test_bench_churn_child_reports_breaker_under_permanent_dispatch_fault(tmp_pa
     assert rec["device_steps"] == 0
     assert rec["fallback_steps"] == rec["steps"]
     assert rec["pods_scheduled"] > 0  # the host path carried the stream
+
+
+@pytest.mark.slow
+def test_bench_churn_fleet_child_records_fleet_evidence(tmp_path):
+    """Round 12: the churn_fleet child's JSON record carries the fleet
+    evidence the acceptance contract names — trajectories/sec, the
+    aggregate-speedup comparison vs solo, per-lane counts matching the
+    solo run, the lanes-on-device fraction, and the cohort leader's
+    lower_cache/prelower counters (the lowered-once guard)."""
+    out = tmp_path / "fleet.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_fleet", "--out", str(out),
+            "--seed", "0", "--churn-events", "300", "--churn-nodes", "64",
+            "--fleet-lanes", "3",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=sanitized_cpu_env(),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["lanes"] == 3
+    assert rec["lanes_match_solo"] is True
+    assert rec["trajectories_per_sec"] > 0
+    assert rec["aggregate_speedup"] > 0
+    assert rec["fleet"]["lanes_on_device"] == 1.0
+    assert rec["fleet"]["group_dispatches"] >= 1
+    # Lowered once per window: exactly one driver carries lowerings.
+    lowerings = rec["fleet"]["lane_lowerings"]
+    assert sum(lowerings) == max(lowerings) > 0
+    assert "lower_cache" in rec and "prelower" in rec and "phases" in rec
+
+
+def test_bench_churn_fleet_child_survives_dead_device(tmp_path):
+    """The one-JSON-line-under-any-hardware contract, fleet edition: a
+    churn_fleet child whose every dispatch fails (the wedged-tunnel
+    stand-in, armed through the environment) still writes its record —
+    every lane carried by the per-pass host path, breakers tripped,
+    counts intact."""
+    out = tmp_path / "fleet_dead.json"
+    env = sanitized_cpu_env(
+        {
+            "KSIM_FAULTS": "replay.dispatch=always@device",
+            "KSIM_REPLAY_BREAKER_N": "2",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_fleet", "--out", str(out),
+            "--seed", "0", "--churn-events", "300", "--churn-nodes", "64",
+            "--fleet-lanes", "3",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["lanes_match_solo"] is True  # the host path carried all lanes
+    assert rec["fleet"]["lanes_on_device"] == 0.0
+    assert all(s == 0 for s in rec["fleet"]["lane_device_steps"])
+
+
+@pytest.mark.slow
+def test_bench_emits_json_when_probe_backend_is_dead():
+    """A wedged/absent accelerator at PROBE time (the chip-tunnel
+    failure mode the stdlib-only parent exists for): the probe child
+    fails backend init, the orchestrator falls back to the sanitized
+    CPU environment, and the one JSON line still appears."""
+    env = sanitized_cpu_env({"BENCH_BUDGET_S": "360"})
+    # Point the probe at a backend this host does not have: jax raises
+    # inside the probe subprocess, which is exactly a dead-chip probe.
+    env["JAX_PLATFORMS"] = "tpu"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--only", "200x20", "--repeats", "1"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = _last_json_line(proc.stdout)
+    assert out["metric"] == "sched_pairs_per_sec"
+    assert out["value"] > 0
+    assert out["platform"] == "cpu"  # the fallback environment ran it

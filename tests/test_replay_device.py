@@ -392,3 +392,240 @@ def test_sampling_k_validated_against_real_node_count():
             feats, default_plugins(feats), record="selection",
             sampling_k=feats.nodes.count + 1,
         )
+
+
+# ---------------------------------------------------------------------------
+# Fleet replay (round 12, engine/fleet.py): S independent trajectories,
+# one vmapped dispatch, per-lane parity with the solo device path.
+# ---------------------------------------------------------------------------
+
+
+def _small_churn():
+    return churn_scenario(0, n_nodes=48, n_events=200, ops_per_step=20)
+
+
+def test_fleet_lanes_byte_identical_to_solo_device():
+    """The fleet parity lock's in-suite form: every lane of a 3-lane
+    fleet lands per-step (scheduled, unschedulable, pending) triples and
+    totals byte-identical to the SOLO device-replay run of the same
+    stream — and the shared universe is lowered ONCE per window (only
+    the cohort leader's driver ever lowers; the counter-based guard)."""
+    jax.config.update("jax_enable_x64", False)
+    kw = dict(max_pods_per_pass=1024, pod_bucket_min=128, device_segment_steps=8)
+    solo_r = ScenarioRunner(device_replay=True, **kw)
+    solo = solo_r.run(_small_churn())
+    assert solo_r.replay_driver.device_steps >= 8
+    fleet_r = ScenarioRunner(device_replay=True, fleet=3, **kw)
+    agg = fleet_r.run(_small_churn())
+    assert agg.lanes is not None and len(agg.lanes) == 3
+    for ln in fleet_r.fleet_lanes:
+        assert _steps_sig(ln.result) == _steps_sig(solo), f"lane {ln.idx}"
+        assert (
+            ln.result.pods_scheduled,
+            ln.result.unschedulable_attempts,
+        ) == (solo.pods_scheduled, solo.unschedulable_attempts)
+        assert ln.driver.device_steps == solo_r.replay_driver.device_steps
+    # Aggregate = sum of lanes.
+    assert agg.pods_scheduled == 3 * solo.pods_scheduled
+    # Lowered once, not per lane: every follower's driver did ZERO
+    # lowerings and built no device featurizer.
+    stats = fleet_r.fleet_driver.stats()
+    lowerings = stats["lane_lowerings"]
+    assert sum(lowerings) == lowerings[0] > 0, stats
+    assert stats["lanes_on_device"] == 1.0
+    assert stats["group_dispatches"] == len(solo_r.replay_driver.lower_log)
+    for ln in fleet_r.fleet_lanes[1:]:
+        assert ln.driver._featurizer is None
+
+
+@pytest.mark.slow
+def test_fleet_full_record_annotations_byte_identical():
+    """record="full" through the fleet: every lane's decoded result
+    annotations (filter/score/finalscore maps, history, selected node)
+    must be byte-identical to the solo device run's store contents."""
+
+    def stream():
+        return churn_scenario(0, n_nodes=24, n_events=160, ops_per_step=16)
+
+    def annos(store):
+        return {
+            p["metadata"]["name"]: p["metadata"].get("annotations", {})
+            for p in store.list("pods")
+        }
+
+    jax.config.update("jax_enable_x64", False)
+    kw = dict(record="full", max_pods_per_pass=64, pod_bucket_min=32,
+              device_replay=True, device_segment_steps=8)
+    solo_r = ScenarioRunner(**kw)
+    solo = solo_r.run(stream())
+    assert solo_r.replay_driver.device_steps >= 4
+    fleet_r = ScenarioRunner(fleet=2, **kw)
+    fleet_r.run(stream())
+    a_solo = annos(solo_r.store)
+    for ln in fleet_r.fleet_lanes:
+        assert _steps_sig(ln.result) == _steps_sig(solo)
+        a_lane = annos(ln.runner.store)
+        assert set(a_lane) == set(a_solo)
+        for name in a_solo:
+            assert a_lane[name] == a_solo[name], (
+                f"lane {ln.idx} annotations diverged for {name}"
+            )
+
+
+@pytest.mark.slow
+def test_fleet_lane_ops_override_runs_divergent_lane():
+    """A per-lane stream (run(..., lane_ops=...)) rides the solo device
+    path outside the cohort and matches ITS OWN solo run; base lanes
+    still share one lowering."""
+    jax.config.update("jax_enable_x64", False)
+    kw = dict(max_pods_per_pass=1024, pod_bucket_min=128, device_segment_steps=8)
+
+    def other_stream():
+        return churn_scenario(7, n_nodes=32, n_events=120, ops_per_step=20)
+
+    solo_base = ScenarioRunner(device_replay=True, **kw).run(_small_churn())
+    solo_other = ScenarioRunner(device_replay=True, **kw).run(other_stream())
+    fleet_r = ScenarioRunner(device_replay=True, fleet=3, **kw)
+    fleet_r.run(_small_churn(), lane_ops={1: other_stream()})
+    lanes = fleet_r.fleet_lanes
+    assert _steps_sig(lanes[0].result) == _steps_sig(solo_base)
+    assert _steps_sig(lanes[1].result) == _steps_sig(solo_other)
+    assert _steps_sig(lanes[2].result) == _steps_sig(solo_base)
+    assert not lanes[1].convergent and not lanes[1].shared_stream
+    # The divergent lane lowered for itself; the cohort shared one.
+    assert len(lanes[1].driver.lower_log) > 0
+    assert len(lanes[2].driver.lower_log) == 0
+
+
+def test_fleet_rejects_bad_config():
+    with pytest.raises(ValueError, match="device_replay"):
+        ScenarioRunner(fleet=2)
+    with pytest.raises(ValueError, match="at least 2"):
+        ScenarioRunner(device_replay=True, fleet=1)
+    from ksim_tpu.state.cluster import ClusterStore
+
+    with pytest.raises(ValueError, match="own stores"):
+        ScenarioRunner(store=ClusterStore(), device_replay=True, fleet=2)
+    with pytest.raises(ValueError, match="lane_ops requires fleet"):
+        ScenarioRunner().run(iter(()), lane_ops={0: iter(())})
+    with pytest.raises(ValueError, match="lane 5 outside"):
+        ScenarioRunner(
+            device_replay=True, fleet=2, fleet_faults="5:replay.lower=always"
+        ).run(iter(()))
+    # Same refusal for lane_ops: a typoed index would silently replay
+    # the base stream everywhere and the sweep would be vacuous.
+    with pytest.raises(ValueError, match=r"lane_ops lanes \[4\] outside"):
+        ScenarioRunner(device_replay=True, fleet=4).run(
+            iter(()), lane_ops={4: iter(())}
+        )
+    # ...and for a lane fault spec with no fleet to arm it on.
+    with pytest.raises(ValueError, match="fleet_faults requires fleet"):
+        ScenarioRunner(device_replay=True, fleet_faults="0:replay.lower=always")
+
+
+def _preempt_then_create_free_stream():
+    """Step 1 schedules a low-priority pod; step 2's critical pod must
+    PREEMPT it mid-segment (nominated, stays pending); step 3 is
+    create-free, so the lowering predicts no featurize — but the
+    nominated pod is still eligible, and the device run must discard."""
+    yield Operation(
+        step=0, op="create", kind="nodes",
+        obj=make_node("n0", cpu="2", memory="8Gi"),
+    )
+    low = make_pod("low", cpu="1500m", memory=None, priority=1)
+    low["metadata"]["creationTimestamp"] = "2024-01-01T00:00:00Z"
+    yield Operation(step=1, op="create", kind="pods", obj=low)
+    crit = make_pod("crit", cpu="1500m", memory=None, priority=100)
+    crit["metadata"]["creationTimestamp"] = "2024-01-01T00:00:01Z"
+    yield Operation(step=2, op="create", kind="pods", obj=crit)
+    yield Operation(
+        step=3, op="create", kind="nodes",
+        obj=make_node("n1", cpu="2", memory="8Gi"),
+    )
+
+
+def test_residual_preemption_then_create_free_step_discards_segment():
+    """Regression pin for the documented residual (ROADMAP "known
+    residuals"): a mid-segment preemption followed by a create-free step
+    breaks the featurize prediction and DISCARDS the segment — the
+    stream falls back per-pass with identical outcomes.  This pins the
+    behavior (fallback, not wrong counts) until a workload motivates
+    lifting it."""
+    base, dev, driver = _run_pair(
+        _preempt_then_create_free_stream, x64=False, k=8, preemption=True
+    )
+    assert _steps_sig(dev) == _steps_sig(base)
+    assert driver.unsupported.get("featurize_prediction", 0) >= 1
+    # The per-pass path really preempted (the residual needs a real
+    # mid-segment preemption to trigger).
+    assert base.pods_scheduled >= 2
+
+
+def test_residual_featurize_prediction_inherited_per_lane_in_fleet():
+    """Fleet-mode twin of the residual pin: the discard is deterministic
+    over identical lanes, so EVERY lane inherits the documented
+    fallback (per lane, convergently) and lands the per-pass counts."""
+    jax.config.update("jax_enable_x64", False)
+    solo_r = ScenarioRunner(device_replay=True, device_segment_steps=8, preemption=True)
+    solo = solo_r.run(_preempt_then_create_free_stream())
+    fleet_r = ScenarioRunner(
+        device_replay=True, device_segment_steps=8, preemption=True, fleet=2
+    )
+    fleet_r.run(_preempt_then_create_free_stream())
+    for ln in fleet_r.fleet_lanes:
+        assert _steps_sig(ln.result) == _steps_sig(solo), f"lane {ln.idx}"
+        assert ln.driver.unsupported.get("featurize_prediction", 0) >= 1
+        assert ln.convergent  # a shared discard degrades convergently
+
+
+@pytest.mark.slow
+def test_fleet_dp_mesh_lanes_match_single_device(monkeypatch):
+    """KSIM_FLEET_DP lays the lane axis over a dp-device mesh (the
+    conftest forces 8 virtual CPU devices): the sharded group dispatch
+    must land byte-identical per-lane outcomes, and the mesh must
+    actually have been built (not the silent single-device fallback)."""
+    jax.config.update("jax_enable_x64", False)
+    kw = dict(max_pods_per_pass=1024, pod_bucket_min=128, device_segment_steps=8)
+    solo = ScenarioRunner(device_replay=True, **kw).run(_small_churn())
+    monkeypatch.setenv("KSIM_FLEET_DP", "2")
+    fleet_r = ScenarioRunner(device_replay=True, fleet=2, **kw)
+    fleet_r.run(_small_churn())
+    fd = fleet_r.fleet_driver
+    assert fd.dp == 2
+    with fd._mesh_lock:
+        assert fd._mesh is not None and not fd._mesh_failed
+    assert fd.stats()["lanes_on_device"] == 1.0
+    for ln in fleet_r.fleet_lanes:
+        assert _steps_sig(ln.result) == _steps_sig(solo), f"lane {ln.idx}"
+
+
+def test_fleet_vmap_cohort_tiny_stream(monkeypatch):
+    """KSIM_FLEET_VMAP=1 drives the cohort through the genuinely
+    lane-stacked ``_fleet_segment_fn`` (vmapped carry) — tiny stream so
+    the batched compile stays tier-1 cheap; the 6k x 8-lane vmapped leg
+    lives in `make lock-check`.  Every lane must match the solo device
+    run byte-identically."""
+
+    def stream():
+        for i in range(3):
+            yield Operation(
+                step=0, op="create", kind="nodes",
+                obj=make_node(f"n-{i}", cpu="4", memory="8Gi"),
+            )
+        for step in range(1, 6):
+            yield Operation(
+                step=step, op="create", kind="pods",
+                obj=make_pod(f"p-{step}", cpu="500m", memory="512Mi"),
+            )
+
+    jax.config.update("jax_enable_x64", False)
+    solo_r = ScenarioRunner(device_replay=True, device_segment_steps=4)
+    solo = solo_r.run(stream())
+    assert solo_r.replay_driver.device_steps == 6
+    monkeypatch.setenv("KSIM_FLEET_VMAP", "1")
+    fleet_r = ScenarioRunner(device_replay=True, device_segment_steps=4, fleet=3)
+    fleet_r.run(stream())
+    assert fleet_r.fleet_driver.stats()["cohort_mode"] == "vmap"
+    assert fleet_r.fleet_driver.stats()["lanes_on_device"] == 1.0
+    for ln in fleet_r.fleet_lanes:
+        assert _steps_sig(ln.result) == _steps_sig(solo), f"lane {ln.idx}"

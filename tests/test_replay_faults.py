@@ -298,3 +298,100 @@ def test_breaker_state_is_per_driver(monkeypatch):
     r2.run(_small_stream())
     assert not r2.replay_driver.breaker_tripped
     assert r2.replay_driver.device_steps > 0
+
+
+# ---------------------------------------------------------------------------
+# Fleet replay per-lane chaos (round 12, engine/fleet.py): a lane's
+# PRIVATE fault plane (KSIM_FLEET_FAULTS) degrades that lane alone.
+# Slow-marked for the tier-1 budget; `make faults` runs them (-m '').
+# ---------------------------------------------------------------------------
+
+
+def _fleet_sig(res):
+    return [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in res.steps
+    ]
+
+
+def _fleet_churn():
+    return churn_scenario(0, n_nodes=48, n_events=200, ops_per_step=20)
+
+
+@pytest.mark.slow
+def test_fleet_lane_fault_degrades_only_that_lane():
+    """Per-lane chaos (KSIM_FLEET_FAULTS syntax): an injected dispatch
+    fault on lane 2 degrades lane 2 alone — it diverges to the solo
+    path, walks the device_error ladder, and still lands byte-identical
+    counts; every other lane stays in the convergent cohort with zero
+    degradation."""
+    jax.config.update("jax_enable_x64", False)
+    kw = dict(max_pods_per_pass=1024, pod_bucket_min=128, device_segment_steps=8)
+    solo_r = ScenarioRunner(device_replay=True, **kw)
+    solo = solo_r.run(_fleet_churn())
+    fleet_r = ScenarioRunner(
+        device_replay=True, fleet=4, fleet_faults="2:replay.dispatch=call:1", **kw
+    )
+    fleet_r.run(_fleet_churn())
+    lanes = fleet_r.fleet_lanes
+    for ln in lanes:
+        assert _fleet_sig(ln.result) == _fleet_sig(solo), f"lane {ln.idx}"
+    assert lanes[2].driver.device_errors == 1
+    assert lanes[2].driver.unsupported.get("device_error") == 1
+    assert not lanes[2].convergent
+    assert lanes[2].driver.fallback_steps >= 1
+    for ln in (lanes[0], lanes[1], lanes[3]):
+        assert ln.driver.device_errors == 0
+        assert ln.driver.fallback_steps == 0
+        assert ln.convergent
+    assert fleet_r.fleet_driver.stats()["divergences"] == 1
+
+
+@pytest.mark.slow
+def test_fleet_lane_reconcile_fault_rolls_back_only_that_lane():
+    """A per-lane injected reconcile fault rolls back ONE lane's segment
+    (its store byte-identical to the window start, the head step re-run
+    per-pass) while the cohort commits; all lanes still converge on the
+    solo counts."""
+    jax.config.update("jax_enable_x64", False)
+    kw = dict(max_pods_per_pass=1024, pod_bucket_min=128, device_segment_steps=8)
+    solo_r = ScenarioRunner(device_replay=True, **kw)
+    solo = solo_r.run(_fleet_churn())
+    fleet_r = ScenarioRunner(
+        device_replay=True, fleet=3, fleet_faults="1:replay.reconcile=call:1", **kw
+    )
+    fleet_r.run(_fleet_churn())
+    lanes = fleet_r.fleet_lanes
+    for ln in lanes:
+        assert _fleet_sig(ln.result) == _fleet_sig(solo), f"lane {ln.idx}"
+    assert lanes[1].driver.unsupported.get("reconcile_fault") == 1
+    assert not lanes[1].convergent
+    assert lanes[0].driver.unsupported.get("reconcile_fault") is None
+    assert lanes[2].driver.unsupported.get("reconcile_fault") is None
+
+
+@pytest.mark.slow
+def test_fleet_leader_lane_lower_fault_degrades_leader_alone():
+    """Review regression (round 12): a replay.lower fault armed on the
+    COHORT LEADER's lane must fire exactly on its scheduled call and
+    degrade the leader alone — not double-count through the shared
+    lowering and not blast the whole cohort with lowering_fault."""
+    jax.config.update("jax_enable_x64", False)
+    kw = dict(max_pods_per_pass=1024, pod_bucket_min=128, device_segment_steps=8)
+    solo_r = ScenarioRunner(device_replay=True, **kw)
+    solo = solo_r.run(_fleet_churn())
+    fleet_r = ScenarioRunner(
+        device_replay=True, fleet=3, fleet_faults="0:replay.lower=call:1", **kw
+    )
+    fleet_r.run(_fleet_churn())
+    lanes = fleet_r.fleet_lanes
+    for ln in lanes:
+        assert _fleet_sig(ln.result) == _fleet_sig(solo), f"lane {ln.idx}"
+    assert lanes[0].driver.unsupported.get("lowering_fault") == 1
+    assert lanes[0].driver.fallback_steps == 1
+    assert not lanes[0].convergent
+    for ln in lanes[1:]:
+        assert "lowering_fault" not in ln.driver.unsupported, ln.driver.unsupported
+        assert ln.driver.fallback_steps == 0
+        assert ln.convergent
+    # The lane plane fired exactly once (no gate+prepare double count).
+    assert lanes[0].faults.fired("replay.lower") == 1

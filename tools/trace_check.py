@@ -39,7 +39,7 @@ LOCK = (2524, 471)
 # ---------------------------------------------------------------------------
 
 
-def _child(events: int, nodes: int, out_path: str) -> None:
+def _child(events: int, nodes: int, out_path: str, fleet: int = 0) -> None:
     # Scripts put THEIR directory (tools/) on sys.path, not the repo.
     if _REPO not in sys.path:
         sys.path.insert(0, _REPO)
@@ -57,6 +57,7 @@ def _child(events: int, nodes: int, out_path: str) -> None:
         pod_bucket_min=128,
         device_replay=True,
         preemption=True,
+        fleet=fleet or None,
     )
     res = runner.run(
         churn_scenario(0, n_nodes=nodes, n_events=events, ops_per_step=100)
@@ -66,17 +67,20 @@ def _child(events: int, nodes: int, out_path: str) -> None:
     # write means the result JSON below can promise the file exists).
     if TRACE.out_path:
         TRACE.export_chrome(TRACE.out_path)
+    record = {
+        "scheduled": res.pods_scheduled,
+        "unschedulable": res.unschedulable_attempts,
+        "steps": len(res.steps),
+        "phases": res.phase_seconds,
+        **drv.stats(),
+    }
+    if fleet:
+        record["lane_counts"] = [
+            [r.pods_scheduled, r.unschedulable_attempts] for r in res.lanes
+        ]
+        record["fleet"] = runner.fleet_driver.stats()
     with open(out_path, "w") as f:
-        json.dump(
-            {
-                "scheduled": res.pods_scheduled,
-                "unschedulable": res.unschedulable_attempts,
-                "steps": len(res.steps),
-                "phases": res.phase_seconds,
-                **drv.stats(),
-            },
-            f,
-        )
+        json.dump(record, f)
 
 
 # ---------------------------------------------------------------------------
@@ -93,7 +97,9 @@ def _sanitized_env() -> dict:
     return sanitized_cpu_env()
 
 
-def _run_child(events: int, nodes: int, env: dict, tmp: str, tag: str) -> tuple[dict, dict]:
+def _run_child(
+    events: int, nodes: int, env: dict, tmp: str, tag: str, fleet: int = 0
+) -> tuple[dict, dict]:
     """One traced child replay; returns (result record, trace doc)."""
     trace_path = os.path.join(tmp, f"trace_{tag}.json")
     result_path = os.path.join(tmp, f"result_{tag}.json")
@@ -101,7 +107,7 @@ def _run_child(events: int, nodes: int, env: dict, tmp: str, tag: str) -> tuple[
     cmd = [
         sys.executable, os.path.abspath(__file__),
         "--child", "--events", str(events), "--nodes", str(nodes),
-        "--out", result_path,
+        "--out", result_path, "--fleet", str(fleet),
     ]
     proc = subprocess.run(cmd, cwd=_REPO, env=env, timeout=CHILD_TIMEOUT_S)
     if proc.returncode != 0:
@@ -131,9 +137,10 @@ def main() -> None:
     ap.add_argument("--events", type=int, default=6000)
     ap.add_argument("--nodes", type=int, default=2000)
     ap.add_argument("--out", type=str, default="")
+    ap.add_argument("--fleet", type=int, default=0)
     args = ap.parse_args()
     if args.child:
-        _child(args.events, args.nodes, args.out)
+        _child(args.events, args.nodes, args.out, args.fleet)
         return
 
     env = _sanitized_env()
@@ -208,6 +215,46 @@ def main() -> None:
         print(
             f"trace-check: armed run OK — fault.fired x{names2['fault.fired']}, "
             f"fallback reasons {sorted(r for r in reasons if r)}"
+        )
+
+        # -- run 3: a 2-lane FLEET replay (round 12) -------------------
+        # Per-lane span attribution: every replay.dispatch span of a
+        # fleet run must name the lanes it advanced, and every
+        # replay.reconcile span the ONE lane it reconciled — a Chrome
+        # trace from an S-lane run is useless if the phases are not
+        # attributable per trajectory.
+        result3, trace3 = _run_child(1000, 500, env, tmp, "fleet", fleet=2)
+        fleet_stats = result3.get("fleet", {})
+        if fleet_stats.get("group_dispatches", 0) < 1:
+            _fail(f"fleet run dispatched no groups (stats: {fleet_stats})")
+        if any(c != result3["lane_counts"][0] for c in result3["lane_counts"]):
+            _fail(f"fleet lanes diverged: {result3['lane_counts']}")
+        dispatch_spans = [
+            ev
+            for ev in trace3["traceEvents"]
+            if ev.get("name") == "replay.dispatch" and ev.get("ph") == "X"
+        ]
+        reconcile_spans = [
+            ev
+            for ev in trace3["traceEvents"]
+            if ev.get("name") == "replay.reconcile" and ev.get("ph") == "X"
+        ]
+        if not dispatch_spans or not reconcile_spans:
+            _fail("fleet run recorded no dispatch/reconcile spans")
+        for ev in dispatch_spans:
+            if "lane" not in ev.get("args", {}):
+                _fail(f"fleet replay.dispatch span without lane attribution: {ev}")
+        lanes_seen = set()
+        for ev in reconcile_spans:
+            lane = ev.get("args", {}).get("lane")
+            if lane is None:
+                _fail(f"fleet replay.reconcile span without lane attribution: {ev}")
+            lanes_seen.add(lane)
+        if lanes_seen != {0, 1}:
+            _fail(f"fleet reconcile spans cover lanes {lanes_seen}, expected {{0, 1}}")
+        print(
+            f"trace-check: fleet run OK — {fleet_stats['group_dispatches']} group "
+            f"dispatches, reconcile lanes {sorted(lanes_seen)}"
         )
     print("trace-check: PASS")
 
