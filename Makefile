@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace
+.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs
 
 test:
 	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
@@ -34,8 +34,18 @@ faults:
 	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
 	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
 	'tests/test_replay_faults.py', 'tests/test_fault_injection.py', \
-	'tests/test_replay_cache.py', \
+	'tests/test_replay_cache.py', 'tests/test_jobs.py', \
 	'-q', '-m', ''], env=sanitized_cpu_env({'KSIM_STORE_STRICT': '1'})))"
+
+# The job-plane suite (docs/jobs.md) on CPU in the sanitized env, slow
+# tests included (-m '' overrides the default 'not slow'): lifecycle
+# over HTTP, queue backpressure, cancel-mid-segment rollback, SSE
+# progress, the shared compile cache, and the per-tenant fault
+# containment matrix (KSIM_JOBS_FAULTS).
+jobs:
+	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
+	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
+	'tests/test_jobs.py', '-q', '-m', ''], env=sanitized_cpu_env()))"
 
 # Trace-plane validation (docs/observability.md): the locked 6k prefix
 # through the device path with KSIM_TRACE_OUT set, in the sanitized CPU

@@ -437,6 +437,123 @@ def child_churn_fleet(seed: int, n_nodes: int, n_events: int, lanes: int) -> dic
     return out
 
 
+def child_churn_jobs(
+    seed: int, n_nodes: int, n_events: int, n_jobs: int, workers: int
+) -> dict:
+    """Job-plane rung (ksim_tpu/jobs): ``n_jobs`` concurrent copies of
+    the churn stream submitted as tenant scenario documents through the
+    bounded queue onto a ``workers``-wide pool, every job on the device
+    path.  Evidence the record must carry: sustained jobs/min, per-job
+    p50/p99 latency FROM EACH JOB'S PRIVATE trace plane, per-job
+    scheduled/unschedulable counts with a ``jobs_match_solo`` flag (a
+    solo replay of the same stream runs AFTER the fleet of jobs — the
+    jobs must start cold so the compile-once proof is about THEM), and
+    the process-wide ``compile_cache`` counters: ``shared_rungs`` >= 1
+    means at least one shape rung compiled once and served multiple
+    tenants (engine/compilecache.py)."""
+    import time
+
+    import jax
+
+    from ksim_tpu.engine.compilecache import COMPILE_CACHE
+    from ksim_tpu.jobs import JobManager
+    from ksim_tpu.scenario import (
+        ScenarioRunner,
+        churn_scenario,
+        spec_from_operations,
+    )
+
+    _child_setup()
+    jax.config.update("jax_enable_x64", False)
+
+    def stream():
+        return churn_scenario(
+            seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100
+        )
+
+    doc = {
+        "spec": {
+            "simulator": {
+                "recordMode": "selection",
+                "preemption": True,
+                "maxPodsPerPass": 1024,
+                "podBucketMin": 128,
+                "deviceReplay": True,
+            },
+            "scenario": spec_from_operations(list(stream())),
+        }
+    }
+    jm = JobManager(workers=workers, queue_limit=n_jobs + 2)
+    t0 = time.perf_counter()
+    jobs = [jm.submit(doc) for _ in range(n_jobs)]
+    finished = jm.join(timeout=CHURN_TIMEOUT - 90)
+    wall = time.perf_counter() - t0
+    jm.shutdown(timeout=5)
+    # Solo baseline AFTER the jobs (it reuses their warm executables —
+    # cheap — and keeps the jobs' own compile_cache evidence cold-start).
+    solo = ScenarioRunner(
+        max_pods_per_pass=1024,
+        pod_bucket_min=128,
+        device_replay=True,
+        preemption=True,
+    )
+    rs = solo.run(stream())
+    per_job = []
+    job_counts = []
+    for j in jobs:
+        state, result, err = j.result_view()
+        counts = None
+        lat = {}
+        job_wall = None
+        if result:
+            counts = [
+                result["result"]["podsScheduled"],
+                result["result"]["unschedulableAttempts"],
+            ]
+            job_wall = result["result"]["wallSeconds"]
+            lat = result.get("latency", {})
+        job_counts.append(counts)
+        per_job.append(
+            {
+                "id": j.id,
+                "state": state,
+                "error": err,
+                "counts": counts,
+                "wall_s": job_wall,
+                "step_p50_s": lat.get("runner.step", {}).get("p50_seconds"),
+                "step_p99_s": lat.get("runner.step", {}).get("p99_seconds"),
+                "dispatch_p50_s": lat.get("replay.dispatch", {}).get("p50_seconds"),
+                "dispatch_p99_s": lat.get("replay.dispatch", {}).get("p99_seconds"),
+            }
+        )
+    solo_counts = [rs.pods_scheduled, rs.unschedulable_attempts]
+    out = {
+        "events": n_events,
+        "nodes": n_nodes,
+        "jobs": n_jobs,
+        "workers": workers,
+        "all_finished": finished,
+        "wall_s": round(wall, 1),
+        "jobs_per_min": round(n_jobs / wall * 60, 2) if wall else None,
+        "solo_counts": solo_counts,
+        "job_counts": job_counts,
+        "jobs_match_solo": all(c == solo_counts for c in job_counts),
+        "per_job": per_job,
+        "compile_cache": COMPILE_CACHE.snapshot(),
+        "queue": jm.queue.stats(),
+        "platform": jax.devices()[0].platform,
+    }
+    print(
+        f"[churn_jobs {n_events}ev/{n_nodes}n x{n_jobs} jobs/{workers} workers] "
+        f"{wall:.1f}s ({out['jobs_per_min']} jobs/min, match_solo="
+        f"{out['jobs_match_solo']}, compile_cache shared_rungs="
+        f"{out['compile_cache']['shared_rungs']})",
+        file=sys.stderr,
+        flush=True,
+    )
+    return out
+
+
 def _proc_watermarks() -> dict:
     """This process's /proc watermarks (stdlib + procfs only, guarded
     for non-Linux): the memory-map count — XLA:CPU executables each mmap
@@ -491,6 +608,14 @@ def _child_main(args: argparse.Namespace) -> None:
                 args.churn_nodes,
                 args.churn_events,
                 args.fleet_lanes,
+            )
+        elif args.child == "churn_jobs":
+            out = child_churn_jobs(
+                args.seed,
+                args.churn_nodes,
+                args.churn_events,
+                args.jobs_count,
+                args.jobs_workers,
             )
         else:  # pragma: no cover
             raise ValueError(f"unknown child mode {args.child!r}")
@@ -701,6 +826,10 @@ def main() -> None:
     except ValueError:
         default_fleet = 8
     ap.add_argument("--fleet-lanes", type=int, default=default_fleet)
+    # Job-plane rung shape (the stdlib-only parent forwards the numbers;
+    # the child reads no environment for them).
+    ap.add_argument("--jobs-count", type=int, default=8)
+    ap.add_argument("--jobs-workers", type=int, default=4)
     try:
         default_budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     except ValueError:
@@ -713,7 +842,9 @@ def main() -> None:
     )
     # Internal: subprocess payload modes.
     ap.add_argument(
-        "--child", choices=["probe", "rung", "churn", "churn_fleet"], default=None
+        "--child",
+        choices=["probe", "rung", "churn", "churn_fleet", "churn_jobs"],
+        default=None,
     )
     ap.add_argument("--pods", type=int, default=0)
     ap.add_argument("--nodes", type=int, default=0)
@@ -1009,6 +1140,29 @@ def main() -> None:
             mode="churn_fleet",
         )
 
+    def run_churn_jobs_stage() -> None:
+        """Job-plane rung (round 13, ksim_tpu/jobs): 8 concurrent 6k
+        churn streams as tenant jobs through the bounded queue on a
+        4-worker pool — sustained jobs/min, per-job p50/p99 from each
+        job's PRIVATE trace plane, per-job counts + jobs_match_solo,
+        and the process-wide compile_cache counters proving same-rung
+        tenants compile once (shared_rungs >= 1).  Always the 6k
+        prefix: the rung runs jobs+1 trajectories' worth of compute and
+        the service claims are about concurrency, not stream length."""
+        run_secondary_churn_rung(
+            "churn_jobs",
+            lambda resized: [
+                "--seed", str(args.seed),
+                "--churn-events", str(min(args.churn_events, 6_000)),
+                "--churn-nodes", str(min(args.churn_nodes, CPU_CHURN_CAP[1])),
+                "--jobs-count", str(args.jobs_count),
+                "--jobs-workers", str(args.jobs_workers),
+            ],
+            CHURN_TIMEOUT,
+            min_budget=120,
+            mode="churn_jobs",
+        )
+
     def run_churn_exact_stage() -> None:
         """Bounded exact-mode (x64) churn: demonstrates in the driver
         record that the replay counts are mode- and platform-identical
@@ -1049,6 +1203,7 @@ def main() -> None:
     run_churn_device_stage()
     run_churn_device_full_stage()
     run_churn_fleet_stage()
+    run_churn_jobs_stage()
     run_churn_exact_stage()
     if fallback:
         # The north-star shape still gets a measured record on CPU: the

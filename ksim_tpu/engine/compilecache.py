@@ -1,0 +1,174 @@
+"""Process-wide compiled-executable cache evidence + compile-once gate.
+
+jax's jit cache already reuses a compiled executable for identical
+(statics, input avals) within one process — but it is silent (no
+hit/miss evidence reaches the bench JSON or /api/v1/metrics) and it
+does not serialize FIRST calls: two tenant jobs hitting the same shape
+rung concurrently can both pay the multi-second XLA trace+compile
+before either lands in the cache.  This module adds the missing layer
+for the job plane (ksim_tpu/jobs): a process-global registry keyed by
+the bucketed shape ladder + profile token that
+
+- counts ``hits``/``misses`` per rung (a miss = the first dispatch of a
+  key, i.e. the one that compiles) and records which OWNERS (tenant
+  jobs, via the scoped trace plane's ``job`` tag) used each rung — the
+  "compile once, serve every tenant on that rung" claim becomes
+  machine-checkable straight from the bench record
+  (``shared_rungs``/``shared_single_compile_rungs``);
+- serializes the first call per key: one leader runs the compiling
+  dispatch, concurrent same-rung callers WAIT (bounded) for it, then
+  dispatch against jax's now-warm jit cache.  A leader that dies
+  removes its entry (``aborts``) so the next caller retries as leader
+  rather than deadlocking behind a tombstone.
+
+The module is stdlib-only: callers (engine/replay.py ``_device_exec``)
+build the key from hashable statics + the input trees' dtype/shape
+signature, so nothing here ever imports jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+__all__ = ["CompileCache", "COMPILE_CACHE"]
+
+#: Bound on the follower wait for a leader's in-flight compile.  The
+#: replay watchdog (KSIM_REPLAY_WATCHDOG_S, default 300 s — "generous:
+#: first dispatch includes XLA compile") covers the same window from
+#: the dispatch side, so a stuck leader degrades through the existing
+#: device_error ladder instead of wedging followers forever.
+_WAIT_DEFAULT_S = 300.0
+
+
+class _Entry:
+    """One shape rung's state: the leader-compiled gate + per-key
+    evidence.  Mutated only under the owning cache's lock (the ready
+    Event is the one cross-thread signal and is safe bare)."""
+
+    __slots__ = ("ready", "hits", "owners")
+
+    def __init__(self) -> None:
+        self.ready = threading.Event()
+        self.hits = 0
+        self.owners: set = set()
+
+
+class CompileCache:
+    """Counting, compile-once-serializing front of the jit cache."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[Any, _Entry] = {}  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.waits = 0  # guarded-by: _lock (followers that blocked on a leader)
+        self.aborts = 0  # guarded-by: _lock (leader dispatches that raised)
+
+    def run(
+        self,
+        key: Any,
+        fn: Callable[[], Any],
+        *,
+        owner: "str | None" = None,
+        wait_s: float = _WAIT_DEFAULT_S,
+    ) -> Any:
+        """Run ``fn`` (the jitted dispatch) under the compile-once gate.
+
+        The first caller of ``key`` is the LEADER: it counts a miss and
+        runs ``fn`` directly — jax traces+compiles, then caches.  Every
+        later caller counts a hit; if the leader's first call is still
+        in flight it waits (up to ``wait_s``) before dispatching, so a
+        rung is compiled once no matter how many tenants race onto it.
+        A leader that raises removes the entry and re-raises — the next
+        caller becomes the new leader (counted in ``aborts``)."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                ent = self._entries[key] = _Entry()
+                if owner is not None:
+                    ent.owners.add(owner)
+                self.misses += 1
+                leader = True
+            else:
+                ent.hits += 1
+                if owner is not None:
+                    ent.owners.add(owner)
+                self.hits += 1
+                leader = False
+            ready = ent.ready
+        if leader:
+            try:
+                out = fn()
+            except BaseException:
+                with self._lock:
+                    self.aborts += 1
+                    self._entries.pop(key, None)
+                # Wake any followers parked on this generation; they
+                # dispatch themselves (jax may still have cached a
+                # partial trace — correctness is jax's, we only lose
+                # one dedupe opportunity).
+                ready.set()
+                raise
+            ready.set()
+            return out
+        if not ready.is_set():
+            with self._lock:
+                self.waits += 1
+            ready.wait(wait_s)
+        return fn()
+
+    def snapshot(self) -> dict:
+        """JSON-ready evidence (the ``compile_cache`` section of
+        /api/v1/metrics and the bench JSON): aggregate counters plus
+        the cross-tenant sharing proof — ``shared_rungs`` = keys used
+        by >= 2 distinct owners, ``shared_single_compile_rungs`` = the
+        subset that also compiled exactly once (present entries never
+        re-miss; an aborted leader removes its key, so every LIVE
+        entry's compile count is exactly 1)."""
+        with self._lock:
+            rungs = len(self._entries)
+            shared = sum(1 for e in self._entries.values() if len(e.owners) >= 2)
+            shared_hot = sum(
+                1
+                for e in self._entries.values()
+                if len(e.owners) >= 2 and e.hits > 0
+            )
+            max_owners = max(
+                (len(e.owners) for e in self._entries.values()), default=0
+            )
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "waits": self.waits,
+                "aborts": self.aborts,
+                "rungs": rungs,
+                "shared_rungs": shared,
+                "shared_single_compile_rungs": shared_hot,
+                "max_owners_per_rung": max_owners,
+            }
+
+    def reset(self) -> None:
+        """Drop entries and counters (tests; bench children start cold
+        by construction — fresh process — so production never calls
+        this)."""
+        with self._lock:
+            self._entries.clear()
+            self.hits = 0
+            self.misses = 0
+            self.waits = 0
+            self.aborts = 0
+
+
+#: The process-wide cache every segment dispatch consults — one compile
+#: per shape rung regardless of how many runners/tenants share the
+#: process.  engine/replay.py owns the key construction.
+COMPILE_CACHE = CompileCache()
+
+# Self-register as a /api/v1/metrics evidence provider: any process
+# that imports this module (the replay executor, the HTTP server)
+# serves the rung counters live.  obs is stdlib-only like this module,
+# and never imports back — no cycle.
+from ksim_tpu.obs import register_provider  # noqa: E402  (after the global)
+
+register_provider("compile_cache", COMPILE_CACHE.snapshot)

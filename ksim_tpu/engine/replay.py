@@ -87,6 +87,7 @@ from ksim_tpu.errors import (
     ReplayFallback,
     SimulatorError,
 )
+from ksim_tpu.engine.compilecache import COMPILE_CACHE
 from ksim_tpu.engine.kernelreg import device_kernel
 from ksim_tpu.faults import FAULTS
 from ksim_tpu.obs import TRACE, register_provider
@@ -1261,6 +1262,11 @@ class ReplayDriver:
                 "hits": self.dev_const_hits,
                 "misses": self.dev_const_misses,
             },
+            # PROCESS-WIDE (shared by every driver/tenant in the
+            # process): the compiled-executable cache's rung counters —
+            # misses = actual compiles, shared_rungs = rungs serving
+            # more than one tenant (engine/compilecache.py).
+            "compile_cache": COMPILE_CACHE.snapshot(),
         }
 
     # -- support checks ------------------------------------------------------
@@ -1761,10 +1767,15 @@ class ReplayDriver:
             self._prelower_next(plan, future)
             return out
         box: dict[str, Any] = {}
+        # A job-scoped caller's trace override is thread-local; carry it
+        # onto the worker so dispatch-side spans/events (fault.fired,
+        # the lane plane's checks) stay attributed to the owning job.
+        scope = TRACE.scope()
 
         def work() -> None:  # ksimlint: worker-thread
             try:
-                box["out"] = self._run(plan)
+                with TRACE.scoped(scope):
+                    box["out"] = self._run(plan)
             except BaseException as e:  # classified by the caller
                 box["err"] = e
 
@@ -2577,15 +2588,23 @@ class ReplayDriver:
         buffer reuse), execute the compiled segment program, pull the
         carried state + per-step outputs back to host numpy.  Worker
         thread; side-effect-free on the driver (packing evidence rides
-        on the plan)."""
+        on the plan).  The dispatch goes through the process-wide
+        compile-once gate (engine/compilecache.py): the first caller of
+        a shape rung compiles, concurrent same-rung callers — other
+        tenant jobs on the same bucketed shapes — wait and reuse."""
         from ksim_tpu.engine.core import _pull_tree_to_host
 
         FAULTS.check("replay.dispatch")
         const_dev, (ev_dev, state_dev) = _pack_plan_buffers(
             plan, (plan.ev, plan.state0)
         )
-        final_state, outs = _segment_fn(
-            plan.statics, plan.prog, const_dev, ev_dev, state_dev
+        final_state, outs = COMPILE_CACHE.run(
+            _compile_cache_key("solo", plan, (const_dev, ev_dev, state_dev)),
+            lambda: _segment_fn(
+                plan.statics, plan.prog, const_dev, ev_dev, state_dev
+            ),
+            owner=TRACE.scope_tags().get("job"),
+            wait_s=self.watchdog_s if self.watchdog_s > 0 else 300.0,
         )
         pulled_state, pulled = _pull_tree_to_host(
             (
@@ -2846,6 +2865,20 @@ class ReplayDriver:
             )
 
 
+def _compile_cache_key(kind: str, plan: "_SegmentPlan", dev_tree) -> tuple:
+    """The shape-rung identity of one dispatch, for the process-wide
+    compile-once gate (engine/compilecache.py): the hashable program
+    statics, the profile token (``_Program`` hashes on its plugin
+    signature, so two tenants with equal scheduler configs share), the
+    x64 mode, and the dtype/shape signature of every input leaf — the
+    bucketed shape ladder makes these collide across same-rung tenants
+    by construction.  ``kind`` separates the solo and lane-stacked
+    (fleet) programs, which compile differently for identical inputs."""
+    leaves = jax.tree_util.tree_leaves(dev_tree)
+    sig = tuple((str(a.dtype), tuple(a.shape)) for a in leaves)
+    return (kind, plan.statics, plan.prog, bool(jax.config.jax_enable_x64), sig)
+
+
 def _plan_const_parts(plan: "_SegmentPlan"):
     """The plan's universe-constant trees in canonical order (node
     statics, pod rows, the optional preemption extras, the packed aux
@@ -2947,8 +2980,12 @@ def _fleet_exec(plan: "_SegmentPlan", lanes_state0, mesh=None):
         plan.dev_map_out = None
     else:
         const_dev, (ev_dev, state_dev) = _pack_plan_buffers(plan, (plan.ev, st_s))
-    final_state, outs = _fleet_segment_fn(
-        plan.statics, plan.prog, const_dev, ev_dev, state_dev
+    final_state, outs = COMPILE_CACHE.run(
+        _compile_cache_key("fleet", plan, (const_dev, ev_dev, state_dev)),
+        lambda: _fleet_segment_fn(
+            plan.statics, plan.prog, const_dev, ev_dev, state_dev
+        ),
+        owner=TRACE.scope_tags().get("job"),
     )
     return _pull_tree_to_host(
         (

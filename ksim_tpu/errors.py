@@ -29,6 +29,15 @@ class DeviceUnavailableError(SimulatorError):
     rather than crash: the condition is environmental, not a bug."""
 
 
+class RunCancelled(Exception):
+    """A scenario run was cancelled cooperatively (the job plane's
+    DELETE /api/v1/jobs/<id>).  Deliberately NOT a SimulatorError: the
+    replay's classified fault handlers absorb SimulatorErrors into
+    per-pass fallbacks, and a cancellation must propagate out of the
+    run — after the in-flight segment transaction rolled back — rather
+    than be retried on the host path."""
+
+
 class ReplayFallback(SimulatorError):
     """A replay segment cannot (or must not) run on-device and should
     take the per-pass host path instead.  ``reason`` is the stable

@@ -21,6 +21,9 @@ Sites currently wired (see docs/faults.md for the full table):
 - ``service.schedule``  the scheduling pass (scheduler/service.py)
 - ``writeback.push``    live-cluster write-back push (syncer/writeback.py)
 - ``kubeapi.request``   any kube-apiserver HTTP request (syncer/kubeapi.py)
+- ``jobs.run``          a tenant job starting on a job-plane worker
+                        (ksim_tpu/jobs/manager.py; a fault here fails
+                        that one job, never the worker pool)
 
 Schedules are deterministic by construction — "fail call N" and "fail
 the first K calls" count per-site calls, "hang" sleeps (simulating a
@@ -80,6 +83,7 @@ SITES: tuple[str, ...] = (
     "service.schedule",
     "writeback.push",
     "kubeapi.request",
+    "jobs.run",
 )
 
 

@@ -1,0 +1,23 @@
+"""Tenant job plane: queue + worker pool + per-job isolation planes.
+
+See ksim_tpu/jobs/manager.py for the subsystem docstring and
+docs/jobs.md for the API, queue semantics and tenancy model."""
+
+from ksim_tpu.jobs.manager import (
+    JOB_FAULT_SITES,
+    TERMINAL_STATES,
+    Job,
+    JobManager,
+    parse_job_faults,
+)
+from ksim_tpu.jobs.queue import JobQueue, JobQueueFull
+
+__all__ = [
+    "JOB_FAULT_SITES",
+    "TERMINAL_STATES",
+    "Job",
+    "JobManager",
+    "JobQueue",
+    "JobQueueFull",
+    "parse_job_faults",
+]

@@ -1,0 +1,642 @@
+"""Tenant job plane: a bounded queue + worker pool over ScenarioRunner.
+
+Simulation-as-a-service (ROADMAP "concurrent replays behind the API
+server"): N tenants submit scenario jobs concurrently, a fixed worker
+pool keeps the hardware hot, and every job runs in full isolation —
+
+- its own ``ClusterStore`` + ``SchedulerService`` + ``ScenarioRunner``
+  (built from the job's inline spec; tenant specs may NOT reference
+  server files or import plugin modules),
+- its own **TracePlane** (private ring + latency histograms, every
+  record tagged ``job=<id>``), installed for the worker thread via the
+  global plane's scoped override (``obs.TracePlane.scoped``) — no call
+  site anywhere in the pipeline changes,
+- its own **FaultPlane** (``KSIM_JOBS_FAULTS``), checked next to the
+  process-global one at ``jobs.run`` and the replay sites, so a chaos
+  schedule degrades ONE tenant while its neighbors' counts stay locked,
+- a cooperative **cancel** flag the runner honors between steps and
+  INSIDE the segment reconcile (a mid-segment cancel rolls the
+  in-flight store transaction back — the job's store stays consistent).
+
+What jobs share is exactly what SHOULD be shared: the process-wide
+compiled-executable cache (engine/compilecache.py) — two tenants on the
+same bucketed shape rung compile once — and the worker pool itself.
+
+The HTTP surface lives in server/http.py (``/api/v1/jobs``): submit /
+status / result / cancel plus an SSE stream of the job's progress and
+trace events, fed by the job plane's record sink.
+
+Environment (docs/env.md "Job plane"): ``KSIM_JOBS_WORKERS``,
+``KSIM_JOBS_QUEUE``, ``KSIM_JOBS_RING``, ``KSIM_JOBS_KEEP``,
+``KSIM_JOBS_EVENTS``, ``KSIM_JOBS_FAULTS``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any
+
+from ksim_tpu.errors import RunCancelled
+from ksim_tpu.faults import FAULTS, FaultPlane
+from ksim_tpu.jobs.queue import JobQueue, JobQueueFull
+from ksim_tpu.obs import TRACE, TracePlane
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Job", "JobManager", "JobQueueFull", "parse_job_faults"]
+
+#: Final job states (no transitions out).
+TERMINAL_STATES = frozenset({"succeeded", "failed", "cancelled"})
+
+#: Sites a tenant-job private plane may arm.  The private plane is only
+#: CHECKED at these (jobs/manager.py + the runner/driver's lane-plane
+#: checks); accepting any other site would arm a schedule that can
+#: never fire — the vacuously-green chaos run every parser in this
+#: repo refuses.
+JOB_FAULT_SITES = frozenset(
+    {"jobs.run", "replay.lower", "replay.dispatch", "replay.reconcile"}
+)
+
+
+def parse_job_faults(spec: str) -> dict[int, FaultPlane]:
+    """Parse ``KSIM_JOBS_FAULTS`` into per-job-ordinal fault planes.
+
+    Syntax mirrors ``KSIM_FLEET_FAULTS``: comma/semicolon-separated
+    ``<ordinal>:<site>=<schedule>[@error]`` entries where ``ordinal``
+    is the job's 0-based SUBMISSION index — e.g.
+    ``"0:replay.dispatch=always@device"`` arms only the first job
+    submitted.  Sites outside ``JOB_FAULT_SITES`` and malformed entries
+    raise."""
+    planes: dict[int, FaultPlane] = {}
+    for part in spec.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        ord_s, sep, rest = part.partition(":")
+        if not sep or not ord_s.strip().isdigit():
+            raise ValueError(
+                f"KSIM_JOBS_FAULTS entry {part!r}: expected "
+                f"<job-ordinal>:<site>=<schedule>"
+            )
+        site = rest.partition("=")[0].strip()
+        if site not in JOB_FAULT_SITES:
+            raise ValueError(
+                f"KSIM_JOBS_FAULTS entry {part!r}: site {site!r} is not a "
+                f"job-plane site (have {sorted(JOB_FAULT_SITES)})"
+            )
+        planes.setdefault(int(ord_s), FaultPlane()).configure(rest)
+    return planes
+
+
+def _parse_job_spec(doc: Any) -> tuple[list, dict, int]:
+    """Validate a tenant job document -> (operations, simulator spec,
+    priority).  Accepts the SchedulerSimulation-ish shape::
+
+        {"spec": {"priority": 0,
+                  "simulator": {...},          # recordMode/preemption/
+                                               # deviceReplay/fleet/
+                                               # schedulerConfig/
+                                               # initialSnapshot (INLINE)
+                  "scenario": {"operations": [...]}}}
+
+    or a bare ``{"operations": [...]}``.  File-path fields are REFUSED:
+    tenants must not make the server read its own filesystem (the
+    KEP-184 mounted-file workflow is the operator's
+    ``cmd/simulation.py``, not this surface)."""
+    from ksim_tpu.scenario.spec import ScenarioSpecError, operations_from_spec
+
+    if not isinstance(doc, dict):
+        raise ScenarioSpecError("job document must be a mapping")
+    spec = doc.get("spec") or doc
+    sim = spec.get("simulator") or {}
+    for scope in (spec, sim):
+        for banned in (
+            "initialSnapshotPath",
+            "scenarioTemplateFilePath",
+            "scenarioResultFilePath",
+        ):
+            if banned in scope:
+                raise ScenarioSpecError(
+                    f"{banned} is not allowed in a tenant job spec — inline "
+                    "the document (the job plane never reads server files)"
+                )
+    if sim.get("fleet"):
+        # The fleet runner builds every lane's store/service itself —
+        # a config/snapshot silently dropped here would run the wrong
+        # simulation and still report Succeeded.  Refuse until fleet
+        # lanes learn to carry them (ROADMAP "service round 2").
+        for unsupported in ("schedulerConfig", "initialSnapshot"):
+            if sim.get(unsupported):
+                raise ScenarioSpecError(
+                    f"simulator.{unsupported} is not supported together with "
+                    "simulator.fleet (fleet lanes build default-config stores)"
+                )
+    scenario = spec.get("scenario")
+    if scenario is None and "operations" in spec:
+        scenario = {"operations": spec["operations"]}
+    if scenario is None:
+        raise ScenarioSpecError(
+            "job spec needs an inline scenario (spec.scenario.operations)"
+        )
+    ops = operations_from_spec(scenario)
+    try:
+        priority = int(spec.get("priority", 0))
+    except (TypeError, ValueError):
+        raise ScenarioSpecError("spec.priority must be an integer") from None
+    return ops, dict(sim), priority
+
+
+class Job:
+    """One tenant job: spec + isolation planes + the event log the SSE
+    stream replays.  Mutable state lives under ``_cond`` (the SSE
+    readers wait on it); the trace/fault planes and the parsed ops are
+    construction-time constants."""
+
+    def __init__(
+        self,
+        job_id: str,
+        ordinal: int,
+        ops: list,
+        sim: dict,
+        priority: int,
+        *,
+        ring_cap: int,
+        max_events: int,
+        faults: "FaultPlane | None",
+    ) -> None:
+        self.id = job_id
+        self.ordinal = ordinal
+        self.ops = ops
+        self.sim = sim
+        self.priority = priority
+        self.faults = faults
+        self.cancel = threading.Event()
+        self.created = time.time()
+        self.steps_total = len({op.step for op in ops})
+        # The job's PRIVATE trace plane: ring + histograms, every record
+        # tagged with the job id; the sink feeds the SSE event log.
+        self.trace = TracePlane(tags={"job": job_id})
+        self.trace.configure_from_env(
+            {"KSIM_TRACE_RING": str(ring_cap), "KSIM_TRACE": "1"}
+        )
+        self.trace.set_sink(self._on_record)
+        self._max_events = max_events
+        self._cond = threading.Condition()
+        self.state = "queued"  # guarded-by: _cond
+        self.error: "str | None" = None  # guarded-by: _cond
+        self.result: "dict | None" = None  # guarded-by: _cond
+        self.started: "float | None" = None  # guarded-by: _cond
+        self.finished: "float | None" = None  # guarded-by: _cond
+        self.steps_done = 0  # guarded-by: _cond
+        self._events: list[dict] = []  # guarded-by: _cond
+        self._dropped = 0  # guarded-by: _cond
+        # Diagnostics handles, set by the worker (the job's own store/
+        # runner — tests assert cancel-rollback consistency through
+        # them; None for queued jobs).
+        self.store = None
+        self.runner = None
+
+    # -- event log (the SSE source) --------------------------------------
+
+    def _emit_locked(self, ev: dict, vital: bool) -> None:  # ksimlint: lock-held(_cond)
+        if not vital and len(self._events) >= self._max_events:
+            self._dropped += 1
+            return
+        ev = dict(ev, seq=len(self._events), job=self.id)
+        self._events.append(ev)
+        self._cond.notify_all()
+
+    def emit(self, ev: dict, *, vital: bool = False) -> None:
+        with self._cond:
+            self._emit_locked(ev, vital)
+
+    def _on_record(self, rec: dict) -> None:
+        """The job plane's record sink (called OUTSIDE the plane lock):
+        reconcile/step spans become monotonically increasing progress
+        events, instant trace events forward to the stream (droppable
+        once the log caps out)."""
+        name = rec.get("name")
+        args = rec.get("args") or {}
+        if rec.get("ph") == "X":
+            if name == "runner.step":
+                self._note_steps(1)
+            elif name == "replay.reconcile" and "error" not in args:
+                # Committed segments only: a rolled-back reconcile exits
+                # its span with the error recorded, and its steps re-run
+                # (head per-pass, rest on-device) — counting it would
+                # double-book and break monotonic-progress semantics.
+                self._note_steps(int(args.get("steps") or 0))
+            return
+        self.emit({"event": "trace", "name": name, "args": args})
+
+    def _note_steps(self, n: int) -> None:
+        if n <= 0:
+            return
+        with self._cond:
+            self.steps_done += n
+            self._emit_locked(
+                {
+                    "event": "progress",
+                    "steps_done": self.steps_done,
+                    "steps_total": self.steps_total,
+                },
+                True,
+            )
+
+    # -- state machine ---------------------------------------------------
+
+    def claim(self) -> bool:
+        """queued -> running (the worker's atomic take); False if the
+        job was cancelled while queued."""
+        with self._cond:
+            if self.state != "queued" or self.cancel.is_set():
+                return False
+            self.state = "running"
+            self.started = time.time()
+            self._emit_locked({"event": "state", "state": "running"}, True)
+            return True
+
+    def finish(
+        self,
+        state: str,
+        *,
+        error: "str | None" = None,
+        result: "dict | None" = None,
+    ) -> None:
+        with self._cond:
+            if self.state in TERMINAL_STATES:
+                return
+            self.state = state
+            self.error = error
+            self.result = result
+            self.finished = time.time()
+            ev = {"event": "state", "state": state}
+            if error:
+                ev["error"] = error
+            self._emit_locked(ev, True)
+
+    def request_cancel(self) -> str:
+        """Set the cancel flag; a QUEUED job finalizes immediately, a
+        RUNNING one stops at the runner's next checkpoint (rolling back
+        any in-flight segment).  Returns the state after the request."""
+        self.cancel.set()
+        with self._cond:
+            if self.state == "queued":
+                self.state = "cancelled"
+                self.finished = time.time()
+                self._emit_locked({"event": "state", "state": "cancelled"}, True)
+            return self.state
+
+    # -- views -----------------------------------------------------------
+
+    def status(self) -> dict:
+        with self._cond:
+            return {
+                "id": self.id,
+                "state": self.state,
+                "priority": self.priority,
+                "created": round(self.created, 3),
+                "started": round(self.started, 3) if self.started else None,
+                "finished": round(self.finished, 3) if self.finished else None,
+                "progress": {
+                    "steps_done": self.steps_done,
+                    "steps_total": self.steps_total,
+                },
+                "events": len(self._events),
+                "events_dropped": self._dropped,
+                "cancel_requested": self.cancel.is_set(),
+                "error": self.error,
+            }
+
+    def result_view(self) -> tuple[str, "dict | None", "str | None"]:
+        with self._cond:
+            return self.state, self.result, self.error
+
+    def events_since(
+        self, idx: int, timeout: "float | None" = None
+    ) -> tuple[list[dict], int, bool]:
+        """(new events from ``idx``, next index, end-of-stream).  Blocks
+        up to ``timeout`` when nothing new exists and the job is still
+        live — the SSE handler's poll step."""
+        with self._cond:
+            if idx >= len(self._events) and self.state not in TERMINAL_STATES:
+                self._cond.wait(timeout)
+            evs = list(self._events[idx:])
+            nxt = idx + len(evs)
+            done = self.state in TERMINAL_STATES and nxt >= len(self._events)
+            return evs, nxt, done
+
+    def wait_done(self, timeout: "float | None" = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self.state not in TERMINAL_STATES:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def trace_summary(self) -> dict:
+        """The per-job plane snapshot trimmed for the merged metrics
+        document: event counters, ring pressure, and per-span latency
+        quantiles (the job's OWN p50/p99, not the process's)."""
+        snap = self.trace.snapshot()
+        return {
+            "events": snap["events"],
+            "ring": snap["ring"],
+            "histograms": {
+                name: {
+                    k: h[k]
+                    for k in ("count", "mean_seconds", "p50_seconds", "p99_seconds")
+                    if k in h
+                }
+                for name, h in snap["histograms"].items()
+            },
+        }
+
+
+class JobManager:
+    """The worker pool + registry behind ``/api/v1/jobs``."""
+
+    def __init__(
+        self,
+        *,
+        workers: "int | None" = None,
+        queue_limit: "int | None" = None,
+        ring_cap: "int | None" = None,
+        keep: "int | None" = None,
+        max_events: "int | None" = None,
+        fault_spec: "str | None" = None,
+    ) -> None:
+        env = os.environ
+        if workers is None:
+            workers = int(env.get("KSIM_JOBS_WORKERS", "2"))
+        if queue_limit is None:
+            queue_limit = int(env.get("KSIM_JOBS_QUEUE", "16"))
+        if ring_cap is None:
+            ring_cap = int(env.get("KSIM_JOBS_RING", "4096"))
+        if keep is None:
+            keep = int(env.get("KSIM_JOBS_KEEP", "64"))
+        if max_events is None:
+            max_events = int(env.get("KSIM_JOBS_EVENTS", "8192"))
+        if fault_spec is None:
+            fault_spec = env.get("KSIM_JOBS_FAULTS", "")
+        self._ring_cap = max(ring_cap, 16)
+        self._keep = max(keep, 1)
+        self._max_events = max(max_events, 64)
+        self._fault_planes = parse_job_faults(fault_spec) if fault_spec else {}
+        self.queue = JobQueue(queue_limit)
+        self._lock = threading.Lock()
+        self._jobs: "OrderedDict[str, Job]" = OrderedDict()  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._active = 0  # guarded-by: _lock
+        self._threads: list[threading.Thread] = []
+        for i in range(max(int(workers), 0)):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"jobs-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, doc: Any, *, priority: "int | None" = None) -> Job:
+        """Validate + enqueue one tenant job document.  Raises
+        ``ScenarioSpecError`` on a bad spec (HTTP 400) and
+        ``JobQueueFull`` on a saturated queue (HTTP 429).
+
+        The submission ordinal (the ``KSIM_JOBS_FAULTS`` key) commits
+        only on a SUCCESSFUL enqueue: a refused submission must not
+        shift which job an armed chaos schedule lands on (that would be
+        the vacuously-green sweep the fault parsers exist to refuse).
+        The whole reserve-build-enqueue sequence runs under the manager
+        lock, so concurrent submits cannot interleave ordinals with
+        rejections; lock order is ``_lock`` → ``queue._cond`` →
+        ``job._cond``, matching every other path."""
+        ops, sim, spec_priority = _parse_job_spec(doc)
+        if priority is None:
+            priority = spec_priority
+        with self._lock:
+            ordinal = self._seq
+            faults = self._fault_planes.get(ordinal)
+            if faults is not None and sim.get("fleet"):
+                from ksim_tpu.scenario.spec import ScenarioSpecError
+
+                # The private plane is checked on the SOLO replay path
+                # only; silently dropping it for a fleet job would run
+                # the chaos schedule against nothing.
+                raise ScenarioSpecError(
+                    f"KSIM_JOBS_FAULTS arms job ordinal {ordinal}, but the "
+                    "submitted job is a fleet job — per-lane chaos uses "
+                    "KSIM_FLEET_FAULTS (docs/faults.md)"
+                )
+            job = Job(
+                f"job-{ordinal:06d}",
+                ordinal,
+                ops,
+                sim,
+                priority,
+                ring_cap=self._ring_cap,
+                max_events=self._max_events,
+                faults=faults,
+            )
+            # The queued event lands BEFORE the queue hand-off: once
+            # put() returns, a worker may claim (and emit "running")
+            # immediately, and the SSE log's state order must match
+            # reality.
+            job.emit({"event": "state", "state": "queued"}, vital=True)
+            self.queue.put(job, priority=priority)  # JobQueueFull -> no ordinal
+            self._seq += 1
+            self._jobs[job.id] = job
+            self._prune_locked()
+        TRACE.event(
+            "jobs.enqueue", job=job.id, priority=priority, depth=self.queue.depth()
+        )
+        return job
+
+    def _prune_locked(self) -> None:  # ksimlint: lock-held(_lock)
+        """Bound the registry: drop the oldest TERMINAL jobs beyond the
+        retention limit (live jobs are never dropped — the bounded
+        queue is what limits those)."""
+        if len(self._jobs) <= self._keep:
+            return
+        for jid in list(self._jobs):
+            if len(self._jobs) <= self._keep:
+                break
+            j = self._jobs[jid]
+            if j.status()["state"] in TERMINAL_STATES:
+                del self._jobs[jid]
+
+    # -- the workers -----------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            job = self.queue.get()
+            if job is None:
+                return
+            if not job.claim():
+                continue  # cancelled while queued
+            with self._lock:
+                self._active += 1
+            try:
+                self._run_job(job)
+            finally:
+                with self._lock:
+                    self._active -= 1
+
+    def _run_job(self, job: Job) -> None:
+        """Run one job inside its scoped planes.  The global TRACE's
+        scoped override routes every span/event of the whole pipeline —
+        runner, service, replay driver, even the dispatch worker thread
+        (the executor re-installs the scope there) — onto the job's
+        private plane, tagged ``job=<id>``."""
+        try:
+            with TRACE.scoped(job.trace):
+                with TRACE.span("jobs.run", steps=job.steps_total):
+                    FAULTS.check("jobs.run")
+                    if job.faults is not None:
+                        job.faults.check("jobs.run")
+                    res, runner = self._execute(job)
+            job.finish("succeeded", result=self._result_doc(job, res, runner))
+        except RunCancelled:
+            job.finish("cancelled")
+            logger.info("job %s cancelled", job.id)
+        except Exception as e:
+            logger.exception("job %s failed", job.id)
+            job.finish("failed", error=f"{type(e).__name__}: {e}")
+
+    def _execute(self, job: Job):
+        """Build the job's isolated simulator stack from its spec and
+        replay the scenario.  Imported lazily: the manager is
+        constructible (and the queue/metrics surface usable) without
+        pulling the scheduler/jax stack into a process that never runs
+        a job."""
+        from ksim_tpu.scenario.runner import ScenarioRunner
+        from ksim_tpu.scheduler.service import SchedulerService
+        from ksim_tpu.state.cluster import ClusterStore
+
+        sim = job.sim
+        fleet = sim.get("fleet")
+        if fleet:
+            runner = ScenarioRunner(
+                record=sim.get("recordMode", "selection"),
+                preemption=bool(sim.get("preemption", False)),
+                max_pods_per_pass=sim.get("maxPodsPerPass"),
+                pod_bucket_min=sim.get("podBucketMin"),
+                device_replay=True,
+                fleet=int(fleet),
+                cancel=job.cancel,
+            )
+        else:
+            store = ClusterStore()
+            if sim.get("initialSnapshot"):
+                from ksim_tpu.state.snapshot import SnapshotService
+
+                SnapshotService(store).load(sim["initialSnapshot"])
+            service = SchedulerService(
+                store,
+                config=sim.get("schedulerConfig"),
+                record=sim.get("recordMode", "selection"),
+                preemption=bool(sim.get("preemption", False)),
+                max_pods_per_pass=sim.get("maxPodsPerPass"),
+                pod_bucket_min=sim.get("podBucketMin"),
+            )
+            runner = ScenarioRunner(
+                store=store,
+                service=service,
+                device_replay=bool(sim.get("deviceReplay", False)),
+                cancel=job.cancel,
+                private_faults=job.faults,
+            )
+            job.store = store
+        job.runner = runner
+        res = runner.run(job.ops)
+        return res, runner
+
+    def _result_doc(self, job: Job, res, runner) -> dict:
+        doc: dict = {
+            "phase": "Succeeded",
+            "done": res.succeeded,
+            "result": {
+                "eventsApplied": res.events_applied,
+                "podsScheduled": res.pods_scheduled,
+                "unschedulableAttempts": res.unschedulable_attempts,
+                "wallSeconds": round(res.wall_seconds, 3),
+                "steps": len(res.steps),
+            },
+            "phases": dict(res.phase_seconds),
+            # The job's OWN latency quantiles (its private histograms).
+            "latency": job.trace_summary()["histograms"],
+        }
+        if res.lanes is not None:
+            doc["lanes"] = [
+                [r.pods_scheduled, r.unschedulable_attempts] for r in res.lanes
+            ]
+        drv = getattr(runner, "replay_driver", None)
+        if drv is not None:
+            doc["replay"] = drv.stats()  # includes the shared compile_cache
+        return doc
+
+    # -- lookups & lifecycle --------------------------------------------
+
+    def get(self, job_id: str) -> "Job | None":
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def jobs(self) -> list[Job]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def cancel(self, job_id: str) -> "str | None":
+        """Request cancellation; returns the post-request state, or
+        None for an unknown job."""
+        job = self.get(job_id)
+        if job is None:
+            return None
+        already_done = job.status()["state"] in TERMINAL_STATES
+        state = job.request_cancel()
+        if not already_done:
+            TRACE.event("job.cancelled", job=job.id, state=state)
+        return state
+
+    def join(self, timeout: "float | None" = None) -> bool:
+        """Wait for every registered job to reach a terminal state
+        (tests / bench).  True when all finished inside the timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for job in self.jobs():
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return False
+            if not job.wait_done(remaining):
+                return False
+        return True
+
+    def snapshot(self) -> dict:
+        """The ``jobs`` section of /api/v1/metrics: queue depth, worker
+        occupancy, and per-job status + private-plane summaries."""
+        with self._lock:
+            jobs = list(self._jobs.values())
+            active = self._active
+        return {
+            "queue": self.queue.stats(),
+            "workers": {"pool": len(self._threads), "active": active},
+            "jobs": {
+                j.id: dict(j.status(), trace=j.trace_summary()) for j in jobs
+            },
+        }
+
+    def shutdown(self, timeout: "float | None" = 5.0) -> None:
+        """Stop accepting work, cancel everything live, and join the
+        workers (daemon threads — a stuck dispatch cannot block process
+        exit, it is simply abandoned like the replay watchdog's)."""
+        self.queue.close()
+        for job in self.jobs():
+            job.request_cancel()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for t in self._threads:
+            remaining = None if deadline is None else max(deadline - time.monotonic(), 0.1)
+            t.join(remaining)

@@ -1,0 +1,86 @@
+"""Bounded priority/FIFO job queue (stdlib-only).
+
+The admission edge of the job plane: ``put`` REFUSES (``JobQueueFull``
+-> HTTP 429) instead of blocking — a tenant submitting into a saturated
+simulator must get backpressure it can act on, not a hung request
+holding an HTTP handler thread.  Ordering is priority-then-FIFO: larger
+``priority`` pops first, ties resolve in submission order (a strict
+FIFO is the all-default-priority special case).
+
+Cancellation of QUEUED jobs is lazy: the manager flips the job's state
+and the worker-side ``get`` hands the entry back anyway — the worker
+re-checks and skips it (removing from a heap's middle is O(n) and the
+entry is dead weight for at most one pop).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Any
+
+__all__ = ["JobQueue", "JobQueueFull"]
+
+
+class JobQueueFull(Exception):
+    """The bounded queue refused a submission (HTTP 429 upstream)."""
+
+
+class JobQueue:
+    """Thread-safe bounded priority queue with a close() for shutdown."""
+
+    def __init__(self, limit: int) -> None:
+        self.limit = max(int(limit), 0)  # 0 = unbounded
+        self._cond = threading.Condition()
+        self._heap: list[tuple[int, int, Any]] = []  # guarded-by: _cond
+        self._seq = 0  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
+        self.submitted = 0  # guarded-by: _cond
+        self.rejected = 0  # guarded-by: _cond
+
+    def put(self, item: Any, *, priority: int = 0) -> None:
+        """Enqueue or raise ``JobQueueFull`` — never blocks."""
+        with self._cond:
+            if self._closed:
+                raise JobQueueFull("job queue is shut down")
+            if self.limit and len(self._heap) >= self.limit:
+                self.rejected += 1
+                raise JobQueueFull(
+                    f"job queue full ({len(self._heap)}/{self.limit})"
+                )
+            heapq.heappush(self._heap, (-priority, self._seq, item))
+            self._seq += 1
+            self.submitted += 1
+            self._cond.notify()
+
+    def get(self, timeout: "float | None" = None) -> Any:
+        """Pop the highest-priority (then oldest) entry; blocks up to
+        ``timeout`` (forever when None).  Returns None on timeout or
+        once the queue is closed and drained — the worker exit signal."""
+        with self._cond:
+            while not self._heap:
+                if self._closed:
+                    return None
+                if not self._cond.wait(timeout):
+                    return None
+            return heapq.heappop(self._heap)[2]
+
+    def close(self) -> None:
+        """Refuse new submissions and wake every blocked ``get`` (they
+        drain the remaining entries, then return None)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._heap)
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "depth": len(self._heap),
+                "capacity": self.limit,
+                "submitted": self.submitted,
+                "rejected": self.rejected,
+            }

@@ -77,7 +77,7 @@ __all__ = [
 # Taxonomy (docs/observability.md keeps the prose table in sync)
 # ---------------------------------------------------------------------------
 
-#: Interval (span) names.  The six fault-plane injection sites
+#: Interval (span) names.  The fault-plane injection sites
 #: (faults.SITES) each fire INSIDE the span of the same name, so a
 #: fault event always has an enclosing phase on the timeline.
 SPAN_NAMES: tuple[str, ...] = (
@@ -93,6 +93,9 @@ SPAN_NAMES: tuple[str, ...] = (
     "service.schedule",  # one scheduling pass (scheduler/service.py)
     "writeback.push",  # live-cluster write-back push
     "kubeapi.request",  # any kube-apiserver HTTP request
+    "jobs.run",  # one tenant job end-to-end on a job-plane worker
+    #              (ksim_tpu/jobs/manager.py; recorded on the JOB's
+    #              private plane via the worker's scoped override)
 )
 
 #: Instant event names.
@@ -114,6 +117,11 @@ EVENT_NAMES: tuple[str, ...] = (
     #                                cohort (args.lane, args.reason) and
     #                                continues on the solo device path
     #                                (engine/fleet.py)
+    "jobs.enqueue",  # a tenant job entered the job queue (args.job,
+    #                  args.priority — ksim_tpu/jobs/manager.py)
+    "job.cancelled",  # a tenant job was cancelled (queued or mid-run;
+    #                   mid-segment cancellation rolls the in-flight
+    #                   segment transaction back first)
 )
 
 _KNOWN_NAMES = frozenset(SPAN_NAMES) | frozenset(EVENT_NAMES)
@@ -282,8 +290,33 @@ class _Span:
         return False
 
 
+class _PlaneScope:
+    """Context manager installing an override plane for the current
+    thread (``TracePlane.scoped``); restores the previous override on
+    exit, so scopes nest."""
+
+    __slots__ = ("_plane", "_override", "_prev")
+
+    def __init__(self, plane: "TracePlane", override: "TracePlane | None") -> None:
+        self._plane = plane
+        self._override = override
+        self._prev = None
+
+    def __enter__(self):
+        tls = self._plane._tls
+        self._prev = getattr(tls, "scope", None)
+        tls.scope = self._override
+        return self._override
+
+    def __exit__(self, *exc):
+        self._plane._tls.scope = self._prev
+        return False
+
+
 class TracePlane:
-    """Bounded, thread-safe process-global trace storage.
+    """Bounded, thread-safe trace storage — instance-scoped since
+    round 13 (the job plane), with the process-global ``TRACE`` as the
+    default instance.
 
     Three independently useful layers, one ``_active`` gate:
 
@@ -294,9 +327,29 @@ class TracePlane:
     Thread-safe: spans/events land from the scheduler watch loop, the
     write-back thread, HTTP handler threads, and the replay dispatch
     worker concurrently; one leaf lock guards all storage (nothing
-    under it calls out, so it cannot participate in a lock cycle)."""
+    under it calls out, so it cannot participate in a lock cycle).
 
-    def __init__(self) -> None:
+    **Scoped override** (multi-tenancy): ``TRACE.scoped(plane)``
+    installs ``plane`` as the CURRENT THREAD's recording target — every
+    ``span``/``event``/``ensure_timing``/``phase_totals`` call on the
+    default plane delegates to it until the scope exits.  Call sites
+    keep addressing the module-global ``TRACE``; a tenant-job worker
+    (ksim_tpu/jobs) wraps its run in a scope and gets a private ring,
+    private histograms, and per-record ``tags`` (e.g. ``job=<id>``)
+    without a single call-site change.  The replay executor propagates
+    the scope onto its watchdogged dispatch worker
+    (engine/replay.py ``_run_watchdogged``), so spans/events emitted
+    there stay attributed to the owning job.  Reads of a SPECIFIC
+    plane's storage (``snapshot``/``ring_records``/``export_chrome``)
+    never delegate — an HTTP handler asking the global plane gets the
+    global plane.
+
+    ``tags`` merge into every recorded span/event's args (the job id on
+    every record); ``sink`` — set via ``set_sink`` — receives each
+    record dict AFTER the storage lock is released (it may fan records
+    into an SSE stream; a raising sink is swallowed)."""
+
+    def __init__(self, *, tags: "dict | None" = None) -> None:
         self._lock = threading.Lock()
         self._tls = threading.local()
         self._active = False
@@ -307,6 +360,11 @@ class TracePlane:
         self._ring_on = False  # guarded-by: _lock
         self._jax_bridge = False
         self.out_path: str | None = None
+        # Constant after construction (read-only on the hot path, so no
+        # lock): args merged into every record, and the out-of-lock
+        # record callback.
+        self._tags: dict = dict(tags or {})
+        self._sink: "Callable[[dict], None] | None" = None
         self._epoch_ns = time.perf_counter_ns()  # guarded-by: _lock
         self._hist: dict[str, LatencyHistogram] = {}  # guarded-by: _lock
         self._counters: dict[str, int] = {}  # guarded-by: _lock
@@ -378,6 +436,34 @@ class TracePlane:
     def active(self) -> bool:
         return self._active
 
+    def set_sink(self, sink: "Callable[[dict], None] | None") -> None:
+        """Install (or clear) the record callback.  Set before the plane
+        starts receiving records — the hot path reads it unlocked."""
+        self._sink = sink
+
+    # -- scoped override -------------------------------------------------
+
+    def scoped(self, plane: "TracePlane | None") -> _PlaneScope:
+        """Install ``plane`` as the current thread's recording target
+        for ``span``/``event``/``ensure_timing``/``phase_totals`` calls
+        on THIS plane (``None`` = a no-op scope).  Used by the job plane
+        to give each tenant job a private trace plane without changing
+        any call site; the previous scope restores on exit."""
+        return _PlaneScope(self, plane)
+
+    def scope(self) -> "TracePlane | None":
+        """The current thread's override plane, if any — captured by the
+        replay executor before handing work to its dispatch worker so
+        the scope survives the thread hop."""
+        return getattr(self._tls, "scope", None)
+
+    def scope_tags(self) -> dict:
+        """The effective record tags for the calling thread (the
+        override plane's, else this plane's) — e.g. the owning job id
+        for the compile cache's per-tenant sharing evidence."""
+        ov = getattr(self._tls, "scope", None)
+        return (ov if ov is not None else self)._tags
+
     def ensure_timing(self) -> None:
         """Idempotent timing-only activation.  ScenarioRunner calls this
         so per-phase wall-clock totals always exist (the histogram cost
@@ -386,6 +472,10 @@ class TracePlane:
         operator armed it, and an explicit ``disable()`` /
         ``KSIM_TRACE=off`` wins — convenience activation never
         overrides a stated opt-out."""
+        ov = getattr(self._tls, "scope", None)
+        if ov is not None:
+            ov.ensure_timing()
+            return
         if not self._active and not self._user_disabled:
             self.enable(ring=False)
 
@@ -393,7 +483,12 @@ class TracePlane:
 
     def span(self, name: str, **args):
         """Open a named span; a no-op singleton when the plane is off
-        (the single-check disabled path)."""
+        (the disabled path is one TLS read + one attribute check).  A
+        thread-scoped override plane (``scoped``) takes the record
+        instead."""
+        ov = getattr(self._tls, "scope", None)
+        if ov is not None:
+            return ov.span(name, **args)
         if not self._active:
             return _NOOP
         return _Span(self, name, args)
@@ -401,42 +496,64 @@ class TracePlane:
     def event(self, name: str, **args) -> None:
         """Record one instant event (counted always; stored when the
         ring is on)."""
+        ov = getattr(self._tls, "scope", None)
+        if ov is not None:
+            ov.event(name, **args)
+            return
         if not self._active:
             return
         now = time.perf_counter_ns()
         tid = threading.get_ident()
+        if self._tags:
+            args = {**self._tags, **args}
+        sink = self._sink
+        rec = None
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + 1
-            if self._ring_on:
-                self._note_thread(tid)
-                self._appended += 1
-                self._ring.append(
-                    {"ph": "i", "name": name, "t": now, "tid": tid, "args": args}
-                )
+            if self._ring_on or sink is not None:
+                rec = {"ph": "i", "name": name, "t": now, "tid": tid, "args": args}
+                if self._ring_on:
+                    self._note_thread(tid)
+                    self._appended += 1
+                    self._ring.append(rec)
+        if rec is not None and sink is not None:
+            try:
+                sink(rec)
+            except Exception:  # a broken sink must not break the plane
+                pass
 
     def _record_span(
         self, name: str, t0: int, t1: int, depth: int, args: dict
     ) -> None:
         tid = threading.get_ident()
+        if self._tags:
+            args = {**self._tags, **args}
+        sink = self._sink
+        rec = None
         with self._lock:
             hist = self._hist.get(name)
             if hist is None:
                 hist = self._hist[name] = LatencyHistogram()
             hist.observe((t1 - t0) / 1e9)
-            if self._ring_on:
-                self._note_thread(tid)
-                self._appended += 1
-                self._ring.append(
-                    {
-                        "ph": "X",
-                        "name": name,
-                        "t": t0,
-                        "d": t1 - t0,
-                        "tid": tid,
-                        "depth": depth,
-                        "args": args,
-                    }
-                )
+            if self._ring_on or sink is not None:
+                rec = {
+                    "ph": "X",
+                    "name": name,
+                    "t": t0,
+                    "d": t1 - t0,
+                    "tid": tid,
+                    "depth": depth,
+                    "args": args,
+                }
+                if self._ring_on:
+                    self._note_thread(tid)
+                    self._appended += 1
+                    self._ring.append(rec)
+        if rec is not None and sink is not None:
+            try:
+                sink(rec)
+            except Exception:  # a broken sink must not break the plane
+                pass
 
     def _note_thread(self, tid: int) -> None:  # ksimlint: lock-held(_lock)
         if tid not in self._thread_names:
@@ -447,7 +564,12 @@ class TracePlane:
 
     def phase_totals(self) -> dict[str, tuple[float, int]]:
         """Per-span-name ``(total_seconds, count)`` — the runner diffs
-        two of these around a run for its per-phase breakdown."""
+        two of these around a run for its per-phase breakdown.  Follows
+        the thread's scoped override, so a job-scoped run's phase split
+        reads the JOB's histograms."""
+        ov = getattr(self._tls, "scope", None)
+        if ov is not None:
+            return ov.phase_totals()
         with self._lock:
             return {n: (h.total, h.count) for n, h in self._hist.items()}
 

@@ -19,6 +19,7 @@ from ksim_tpu.scenario.spec import (
     ScenarioSpecError,
     load_scenario,
     operations_from_spec,
+    spec_from_operations,
 )
 from ksim_tpu.scenario.simulation import run_scheduler_simulation
 
@@ -31,5 +32,6 @@ __all__ = [
     "churn_scenario",
     "load_scenario",
     "operations_from_spec",
+    "spec_from_operations",
     "run_scheduler_simulation",
 ]

@@ -12,7 +12,7 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Iterable, Sequence
+from typing import Any, Iterable, Sequence
 
 from ksim_tpu.obs import TRACE
 from ksim_tpu.scheduler.service import SchedulerService
@@ -99,6 +99,8 @@ class ScenarioRunner:
         device_segment_steps: int | None = None,
         fleet: int | None = None,
         fleet_faults: str | None = None,
+        cancel: "Any | None" = None,
+        private_faults: "Any | None" = None,
     ) -> None:
         """``device_replay=True`` routes supported step segments through
         the device-resident path (engine/replay.py): K steps of event
@@ -110,6 +112,24 @@ class ScenarioRunner:
         (``preemption=True``) and ``record="full"`` segments stay
         on-device since round 7 (on-device victim search + streamed
         result tensors).
+
+        ``cancel`` (a ``threading.Event``-like object) makes the run
+        cooperatively cancellable — the job plane's DELETE surface: the
+        flag is checked before every per-pass step AND inside the
+        segment reconcile loop, where a set flag raises
+        ``errors.RunCancelled`` INSIDE the store transaction, rolling
+        the whole in-flight segment back before propagating (the store
+        is byte-identical to the segment's start — a cancelled job
+        never leaves a half-applied window behind).
+
+        ``private_faults`` (a ``FaultPlane``) is this run's PRIVATE
+        fault plane (the job plane's ``KSIM_JOBS_FAULTS``): checked
+        next to the process-global ``FAULTS`` at the replay sites
+        (``replay.lower`` / ``replay.dispatch`` / ``replay.reconcile``)
+        exactly like a fleet lane's plane, so a chaos schedule degrades
+        THIS run alone while concurrent runs in the same process stay
+        healthy.  Mutually exclusive with ``fleet`` (use
+        ``fleet_faults`` there).
 
         ``fleet=S`` (requires ``device_replay=True``) replays S
         INDEPENDENT trajectories — each with its own store, service and
@@ -135,6 +155,11 @@ class ScenarioRunner:
             # A lane fault spec with no fleet would be silently dropped —
             # the vacuously-green chaos sweep parse_fleet_faults refuses.
             raise ValueError("fleet_faults requires fleet=S")
+        if fleet is not None and private_faults is not None:
+            raise ValueError(
+                "private_faults is the solo-run chaos surface; fleet lanes "
+                "arm per-lane planes via fleet_faults/KSIM_FLEET_FAULTS"
+            )
         self.store = store if store is not None else ClusterStore()
         self.service = (
             service
@@ -165,7 +190,12 @@ class ScenarioRunner:
         # and per-pass spans (and the lane's private fault plane) stay
         # attributable per trajectory.
         self._lane: int | None = None
-        self._lane_faults = None
+        # One private-plane slot serves both chaos surfaces: fleet lanes
+        # (set per lane in _run_fleet) and solo job runs (private_faults
+        # here) — the reconcile/driver checks are identical.
+        self._lane_faults = private_faults
+        # Cooperative cancellation flag (Event-like; see __init__ doc).
+        self._cancel = cancel
         # The last run's ReplayDriver (evidence counters: device_steps,
         # fallback_steps, device_round_trips, unsupported reasons).
         self.replay_driver = None
@@ -173,6 +203,16 @@ class ScenarioRunner:
         # and the FleetLane list (per-lane runners/drivers/results).
         self.fleet_driver = None
         self.fleet_lanes = None
+
+    def _check_cancelled(self) -> None:
+        """Raise ``RunCancelled`` if the run's cancel flag is set.
+        Called between per-pass steps and inside the segment reconcile
+        loop — the latter aborts (and rolls back) the in-flight store
+        transaction, so cancellation is never store-corrupting."""
+        if self._cancel is not None and self._cancel.is_set():
+            from ksim_tpu.errors import RunCancelled
+
+            raise RunCancelled("scenario run cancelled")
 
     # -- one operation ------------------------------------------------------
 
@@ -427,6 +467,12 @@ class ScenarioRunner:
                 # (and thereby invalidate the cache).  A rollback takes
                 # the explicit invalidation path (note_reconcile_fault).
                 for batch, outcome in zip(batches, seg.steps):
+                    # A cancel landing mid-segment aborts HERE: the
+                    # RunCancelled is not an InjectedFault, so it rolls
+                    # the transaction back and propagates to the caller
+                    # (the job plane marks the job cancelled; the store
+                    # is back at the segment's start state).
+                    self._check_cancelled()
                     FAULTS.check("replay.reconcile")
                     if self._lane_faults is not None:
                         # The lane's PRIVATE plane (fleet chaos): an
@@ -498,10 +544,12 @@ class ScenarioRunner:
                 self.service,
                 k=self._device_segment_steps or SEGMENT_STEPS,
                 requeue_on_node_delete=self._requeue,
+                lane_faults=self._lane_faults,
             )
             self.replay_driver = driver
         i = 0
         while i < len(keys):
+            self._check_cancelled()
             if driver is not None:
                 # Tails shorter than K no longer fall back: the driver
                 # consumes the supported PREFIX of the window (possibly
@@ -563,6 +611,10 @@ class ScenarioRunner:
         are byte-identical to its solo ``device_replay=True`` run."""
         import os
 
+        # Fleet runs cancel at the submission boundary only (the cohort
+        # dispatch has no per-lane abort point yet — ROADMAP "fleet
+        # round 2"); a flag set mid-run is honored by the NEXT run.
+        self._check_cancelled()
         from ksim_tpu.engine.fleet import FleetDriver, FleetLane, parse_fleet_faults
         from ksim_tpu.engine.replay import SEGMENT_STEPS, ReplayDriver
 

@@ -140,6 +140,44 @@ def operations_from_spec(doc: JSONObj) -> list[Operation]:
     return out
 
 
+#: store kind -> TypeMeta.kind (the inverse of KIND_MAP, for raising
+#: Operation streams back into Scenario documents).
+TYPE_META_KIND = {v: k for k, v in KIND_MAP.items()}
+
+
+def spec_from_operations(ops: "Sequence[Operation]") -> JSONObj:
+    """Raise a runner ``Operation`` stream back into the KEP-140
+    Scenario document shape — the inverse of ``operations_from_spec``
+    (round-trip: ``operations_from_spec(spec_from_operations(ops)) ==
+    list(ops)`` for in-vocabulary streams).  This is how library
+    streams (``churn_scenario``) are SUBMITTED to the tenant job plane,
+    whose wire format is documents, not Operation objects."""
+    out: list[JSONObj] = []
+    for op in ops:
+        entry: JSONObj = {"step": op.step}
+        if op.op == "create":
+            obj = dict(op.obj or {})
+            obj.setdefault("kind", TYPE_META_KIND.get(op.kind, ""))
+            entry["createOperation"] = {"object": obj}
+        elif op.op == "delete":
+            entry["deleteOperation"] = {
+                "typeMeta": {"kind": TYPE_META_KIND.get(op.kind, "")},
+                "objectMeta": {"name": op.name, "namespace": op.namespace},
+            }
+        elif op.op == "patch":
+            entry["patchOperation"] = {
+                "typeMeta": {"kind": TYPE_META_KIND.get(op.kind, "")},
+                "objectMeta": {"name": op.name, "namespace": op.namespace},
+                "patch": op.obj,
+            }
+        elif op.op == "done":
+            entry["doneOperation"] = {}
+        else:
+            raise ScenarioSpecError(f"operation {op.op!r} has no document form")
+        out.append(entry)
+    return {"operations": out}
+
+
 def load_scenario(text_or_doc: "str | bytes | JSONObj") -> list[Operation]:
     """Parse a Scenario document from YAML/JSON text (or an already-parsed
     dict) into runner operations."""

@@ -3,6 +3,7 @@ mirroring the reference (reference simulator/server/di/di.go:24-71)."""
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 from ksim_tpu.scheduler.service import SchedulerService
@@ -34,8 +35,33 @@ class DIContainer:
             self.store, scheduler_service=self.scheduler_service
         )
         self.reset_service = ResetService(self.store, self.scheduler_service)
+        # The tenant job plane (ksim_tpu/jobs) is built LAZILY on first
+        # use: constructing it spawns the worker pool, which a container
+        # serving only the classic single-cluster surface never needs.
+        self._job_manager = None
+        self._job_manager_lock = threading.Lock()
         if start_scheduler:
             self.scheduler_service.start()
+
+    @property
+    def job_manager(self):
+        """The job plane (ksim_tpu/jobs.JobManager), built on first
+        access from the job-plane environment knobs (docs/env.md
+        "Job plane")."""
+        with self._job_manager_lock:
+            if self._job_manager is None:
+                from ksim_tpu.jobs import JobManager
+
+                self._job_manager = JobManager()
+            return self._job_manager
+
+    @property
+    def job_manager_if_built(self):
+        """The job plane if anything has used it yet, else None (the
+        metrics endpoint reports without forcing worker threads into
+        existence)."""
+        with self._job_manager_lock:
+            return self._job_manager
 
     @property
     def extender_service(self) -> Any:
@@ -49,4 +75,7 @@ class DIContainer:
         """Stop services.  Callers about to EXIT the process should pass a
         generous (or None) timeout: an abandoned loop thread alive during
         runtime teardown can corrupt the heap (SchedulerService.stop)."""
+        jm = self.job_manager_if_built
+        if jm is not None:
+            jm.shutdown(timeout=timeout)
         self.scheduler_service.stop(timeout=timeout)

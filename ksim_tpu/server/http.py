@@ -22,12 +22,32 @@ simulator/server/server.go:44-54, handlers under server/handler/):
 Beyond the reference surface: /api/v1/resources/* CRUD (the role the
 KWOK apiserver plays for the reference UI), GET /api/v1/metrics (the
 merged evidence document: scheduler counters + latency histograms +
-fault-plane counters + replay driver stats), GET /api/v1/trace (the
+fault-plane counters + replay driver stats + the job plane's queue/
+worker/per-job section), GET /api/v1/trace (the
 trace plane's event ring as Chrome trace-event JSON — see
-docs/observability.md), and the
+docs/observability.md), the
 Permit waiting-pod view/ops (GET /api/v1/waitingpods, POST
 /api/v1/waitingpods/<ns>/<name>/{allow,reject} — the framework handle's
-WaitingPod surface for external permit controllers).
+WaitingPod surface for external permit controllers), and the tenant
+job plane (docs/jobs.md):
+
+    POST   /api/v1/jobs                 -> submit a scenario job
+                                           (202 {job}, 400 bad spec,
+                                           429 queue full)
+    GET    /api/v1/jobs                 -> list job statuses
+    GET    /api/v1/jobs/<id>            -> one job's status
+    GET    /api/v1/jobs/<id>/result     -> final result document
+                                           (409 until terminal)
+    GET    /api/v1/jobs/<id>/events     -> SSE stream of progress +
+                                           trace events (the
+                                           listwatchresources chunked
+                                           push pattern, SSE-framed)
+    GET    /api/v1/jobs/<id>/trace      -> the JOB's private ring as
+                                           Chrome trace JSON
+    DELETE /api/v1/jobs/<id>            -> cancel (queued: immediate;
+                                           running: cooperative, the
+                                           in-flight segment rolls
+                                           back)
 
 CORS headers come from ``cors_allowed_origins`` (the reference reads them
 from config, server.go:28-32)."""
@@ -40,6 +60,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from ksim_tpu.engine.compilecache import COMPILE_CACHE
 from ksim_tpu.faults import FAULTS
 from ksim_tpu.obs import TRACE, provider_snapshots
 from ksim_tpu.server.di import DIContainer
@@ -121,6 +142,24 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._json(code, obj)
 
+    # -- chunked server push (listwatch + the job SSE stream) ---------------
+
+    def _write_chunk(self, payload: bytes) -> bool:
+        """One HTTP/1.1 chunk, flushed; False when the client is gone."""
+        try:
+            self.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
+            self.wfile.flush()
+            return True
+        except (BrokenPipeError, ConnectionResetError):
+            return False
+
+    def _end_chunks(self) -> None:
+        """Graceful end-of-stream (the zero-length terminal chunk)."""
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
     # -- routing ------------------------------------------------------------
 
     def do_OPTIONS(self) -> None:  # CORS preflight
@@ -164,6 +203,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {"items": self.server.di.scheduler_service.get_waiting_pods()})
         elif url.path == "/api/v1/listwatchresources":
             self._list_watch(parse_qs(url.query))
+        elif url.path == "/api/v1/jobs" or url.path.startswith("/api/v1/jobs/"):
+            self._job_get(url.path)
         elif url.path.startswith("/api/v1/resources/"):
             self._resource("GET", url.path, parse_qs(url.query))
         else:
@@ -173,6 +214,8 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         if url.path == "/api/v1/schedulerconfiguration":
             self._apply_scheduler_config()
+        elif url.path == "/api/v1/jobs":
+            self._job_submit()
         elif url.path == "/api/v1/import":
             try:
                 self.server.di.snapshot_service.load(self._body())
@@ -236,7 +279,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self) -> None:
         url = urlparse(self.path)
-        if url.path.startswith("/api/v1/resources/"):
+        if url.path.startswith("/api/v1/jobs/"):
+            self._job_cancel(url.path)
+        elif url.path.startswith("/api/v1/resources/"):
             self._resource("DELETE", url.path)
         else:
             self._json(404, {"message": "Not Found"})
@@ -247,15 +292,157 @@ class _Handler(BaseHTTPRequestHandler):
         """One GET = the whole degradation-evidence surface: the
         scheduler's counters + latency histograms, the trace plane's
         span histograms/event counters, every fault-plane site's
-        calls/fired counters, and the registered evidence providers
-        (the live run's ``ReplayDriver.stats()`` under ``"replay"``).
-        Previously only ``Metrics.snapshot()`` was served and the rest
-        was visible only in bench JSON."""
+        calls/fired counters, the registered evidence providers
+        (the live run's ``ReplayDriver.stats()`` under ``"replay"``,
+        the process-wide ``compile_cache``), and the job plane's
+        ``jobs`` section (queue depth, worker occupancy, per-job
+        status + private-plane snapshots).  Previously only
+        ``Metrics.snapshot()`` was served and the rest was visible
+        only in bench JSON."""
         doc = self.server.di.scheduler_service.metrics.snapshot()
         doc["trace"] = TRACE.snapshot()
         doc["faults"] = FAULTS.snapshot()
         doc.update(provider_snapshots())
+        # Present even before any replay ran (the import above also
+        # registered it as a provider, so this is a no-op after one).
+        doc.setdefault("compile_cache", COMPILE_CACHE.snapshot())
+        # The jobs section reports WITHOUT forcing the worker pool into
+        # existence: a server never asked to run a job shows the empty
+        # shape, not two idle threads.
+        jm = self.server.di.job_manager_if_built
+        doc["jobs"] = (
+            jm.snapshot()
+            if jm is not None
+            else {
+                "queue": {"depth": 0, "capacity": 0, "submitted": 0, "rejected": 0},
+                "workers": {"pool": 0, "active": 0},
+                "jobs": {},
+            }
+        )
         return doc
+
+    # -- the job plane ------------------------------------------------------
+
+    def _job_submit(self) -> None:
+        """POST /api/v1/jobs: validate + enqueue a tenant scenario job.
+        202 with the job status on success; 400 on a bad spec; 429 when
+        the bounded queue refuses (backpressure the tenant can act on)."""
+        from ksim_tpu.jobs import JobQueueFull
+        from ksim_tpu.scenario.spec import ScenarioSpecError
+
+        try:
+            doc = self._body()
+        except Exception:
+            self._json(400, {"message": "Bad Request"})
+            return
+        try:
+            jm = self.server.di.job_manager
+        except Exception:
+            # Lazy construction can fail on operator config (e.g. a
+            # malformed KSIM_JOBS_FAULTS) — that is a server-side 500,
+            # not the tenant's spec, and must never escape the handler.
+            logger.exception("job manager construction failed")
+            self._json(500, {"message": "Internal Server Error"})
+            return
+        try:
+            job = jm.submit(doc)
+        except ScenarioSpecError as e:
+            self._json(400, {"message": str(e)})
+            return
+        except JobQueueFull as e:
+            self._json(429, {"message": str(e)})
+            return
+        except Exception:
+            logger.exception("job submission failed")
+            self._json(500, {"message": "Internal Server Error"})
+            return
+        self._json(202, job.status())
+
+    def _job_parts(self, path: str) -> "tuple[str, str] | None":
+        parts = [p for p in path.split("/") if p]  # api v1 jobs [id [sub]]
+        if len(parts) == 3:
+            return "", ""
+        if len(parts) == 4:
+            return parts[3], ""
+        if len(parts) == 5 and parts[4] in ("result", "events", "trace"):
+            return parts[3], parts[4]
+        return None
+
+    def _job_get(self, path: str) -> None:
+        parsed = self._job_parts(path)
+        if parsed is None:
+            self._json(404, {"message": "Not Found"})
+            return
+        job_id, sub = parsed
+        jm = self.server.di.job_manager_if_built
+        if not job_id:
+            self._json(
+                200,
+                {"items": [j.status() for j in jm.jobs()] if jm else []},
+            )
+            return
+        job = jm.get(job_id) if jm else None
+        if job is None:
+            self._json(404, {"message": f"no job {job_id}"})
+            return
+        if sub == "":
+            self._json(200, job.status())
+        elif sub == "result":
+            state, result, error = job.result_view()
+            if state == "succeeded":
+                self._json(200, {"id": job.id, "state": state, **(result or {})})
+            elif state in ("failed", "cancelled"):
+                self._json(
+                    200,
+                    {"id": job.id, "state": state, "phase": "Failed", "message": error},
+                )
+            else:
+                self._json(
+                    409, {"message": f"job {job_id} is {state}; result not ready"}
+                )
+        elif sub == "trace":
+            # The JOB's private ring — the isolation story made visible:
+            # only this tenant's spans/events, every record job-tagged.
+            self._json(200, job.trace.export_chrome())
+        else:  # events: the SSE stream
+            self._job_events(job)
+
+    def _job_events(self, job) -> None:
+        """Server push of one job's progress + trace events as
+        Server-Sent Events on a flushed chunked response — the
+        listwatchresources streaming pattern (eventproxy.go:66-80)
+        wearing SSE framing, so a browser EventSource consumes it
+        directly.  The event log replays from the start (late joiners
+        see the whole history) and the stream ends after the terminal
+        state event."""
+        self.send_response(200)
+        self._cors()
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        idx = 0
+        while not self.server.stopping.is_set():
+            events, idx, done = job.events_since(idx, timeout=0.25)
+            for ev in events:
+                if not self._write_chunk(f"data: {json.dumps(ev)}\n\n".encode()):
+                    return
+            if done:
+                break
+        self._end_chunks()
+
+    def _job_cancel(self, path: str) -> None:
+        parsed = self._job_parts(path)
+        if parsed is None or not parsed[0] or parsed[1]:
+            self._json(404, {"message": "Not Found"})
+            return
+        jm = self.server.di.job_manager_if_built
+        state = jm.cancel(parsed[0]) if jm else None
+        if state is None:
+            self._json(404, {"message": f"no job {parsed[0]}"})
+            return
+        self._json(200, {"id": parsed[0], "state": state})
 
     def _resource(self, method: str, path: str, query: dict | None = None) -> None:
         """Per-resource CRUD.  The reference UI talks straight to the
@@ -401,27 +588,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
-        def write_event(ev: WatchEvent) -> bool:
-            data = json.dumps(ev.to_json()).encode() + b"\n"
-            try:
-                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-                self.wfile.flush()
-                return True
-            except (BrokenPipeError, ConnectionResetError):
-                return False
-
         try:
             while not self.server.stopping.is_set():
                 ev = stream.next(timeout=0.25)
                 if ev is None:
                     continue
-                if not write_event(ev):
+                if not self._write_chunk(json.dumps(ev.to_json()).encode() + b"\n"):
                     return
             # Graceful end-of-stream on server shutdown.
-            try:
-                self.wfile.write(b"0\r\n\r\n")
-            except (BrokenPipeError, ConnectionResetError):
-                pass
+            self._end_chunks()
         finally:
             stream.close()
 

@@ -163,6 +163,77 @@ def test_bench_churn_fleet_child_records_fleet_evidence(tmp_path):
     assert "lower_cache" in rec and "prelower" in rec and "phases" in rec
 
 
+@pytest.mark.slow
+def test_bench_churn_jobs_child_records_job_evidence(tmp_path):
+    """Round 13: the churn_jobs child's record carries the job-plane
+    evidence — sustained jobs/min, per-job counts + jobs_match_solo,
+    per-job latency quantiles from each job's PRIVATE plane, and the
+    process-wide compile_cache counters proving same-rung tenants
+    compiled once (shared_rungs >= 1)."""
+    out = tmp_path / "jobs.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_jobs", "--out", str(out),
+            "--seed", "0", "--churn-events", "300", "--churn-nodes", "64",
+            "--jobs-count", "3", "--jobs-workers", "2",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=sanitized_cpu_env(),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["jobs"] == 3 and rec["workers"] == 2
+    assert rec["all_finished"] is True
+    assert rec["jobs_match_solo"] is True
+    assert rec["jobs_per_min"] > 0
+    assert len(rec["per_job"]) == 3
+    for pj in rec["per_job"]:
+        assert pj["state"] == "succeeded"
+        assert pj["counts"] == rec["solo_counts"]
+        assert pj["dispatch_p50_s"] > 0  # the job's own histogram
+    cc = rec["compile_cache"]
+    assert cc["misses"] >= 1 and cc["hits"] >= 1
+    assert cc["shared_rungs"] >= 1, cc
+    assert cc["shared_single_compile_rungs"] >= 1, cc
+    assert rec["queue"]["submitted"] == 3 and rec["queue"]["rejected"] == 0
+
+
+def test_bench_churn_jobs_child_survives_dead_device(tmp_path):
+    """One-JSON-line-under-any-hardware, job-plane edition: with every
+    dispatch failing (the wedged-tunnel stand-in) all jobs degrade to
+    the host path, finish, and still match the solo counts."""
+    out = tmp_path / "jobs_dead.json"
+    env = sanitized_cpu_env(
+        {
+            "KSIM_FAULTS": "replay.dispatch=always@device",
+            "KSIM_REPLAY_BREAKER_N": "2",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_jobs", "--out", str(out),
+            "--seed", "0", "--churn-events", "300", "--churn-nodes", "64",
+            "--jobs-count", "2", "--jobs-workers", "2",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["all_finished"] is True
+    assert rec["jobs_match_solo"] is True
+    for pj in rec["per_job"]:
+        assert pj["state"] == "succeeded"
+
+
 def test_bench_churn_fleet_child_survives_dead_device(tmp_path):
     """The one-JSON-line-under-any-hardware contract, fleet edition: a
     churn_fleet child whose every dispatch fails (the wedged-tunnel

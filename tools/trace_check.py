@@ -11,7 +11,12 @@ any hardware condition, like ``make faults``), then validates:
   ``store.txn_commit`` event per committed segment;
 - with a ``KSIM_FAULTS`` schedule armed (second, smaller run), the
   timeline carries the ``fault.fired`` and ``replay.fallback`` events
-  the chaos evidence story depends on.
+  the chaos evidence story depends on;
+- two CONCURRENT tenant jobs (fourth run, the job plane —
+  ksim_tpu/jobs) record job-tagged ``runner.step``/``replay.dispatch``
+  spans into ISOLATED per-job trace rings (every record in a job's
+  ring carries that job's id and no other's), with both jobs landing
+  identical counts.
 
 The parent process is stdlib-only (the bench.py crash-containment
 pattern: jax backend init can wedge on a dead chip, so anything that
@@ -37,6 +42,70 @@ LOCK = (2524, 471)
 # ---------------------------------------------------------------------------
 # Child payload (imports jax; only ever runs in a subprocess)
 # ---------------------------------------------------------------------------
+
+
+def _child_jobs(events: int, nodes: int, out_path: str) -> None:
+    """Two concurrent tenant jobs of the same churn stream through the
+    job plane; dumps each job's state, counts, and PRIVATE trace ring
+    for the parent's attribution/isolation asserts."""
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    import jax
+
+    from ksim_tpu.jobs import JobManager
+    from ksim_tpu.scenario import churn_scenario, spec_from_operations
+    from ksim_tpu.util import enable_compilation_cache, raise_map_count_limit
+
+    enable_compilation_cache()
+    raise_map_count_limit()
+    jax.config.update("jax_enable_x64", False)
+    doc = {
+        "spec": {
+            "simulator": {
+                "maxPodsPerPass": 1024,
+                "podBucketMin": 128,
+                "deviceReplay": True,
+                "preemption": True,
+            },
+            "scenario": spec_from_operations(
+                list(
+                    churn_scenario(
+                        0, n_nodes=nodes, n_events=events, ops_per_step=100
+                    )
+                )
+            ),
+        }
+    }
+    jm = JobManager(workers=2, queue_limit=4)
+    jobs = [jm.submit(doc) for _ in range(2)]
+    finished = jm.join(timeout=CHILD_TIMEOUT_S - 60)
+    record = {"finished": finished, "jobs": []}
+    for j in jobs:
+        state, result, err = j.result_view()
+        counts = None
+        replay = {}
+        if result:
+            counts = [
+                result["result"]["podsScheduled"],
+                result["result"]["unschedulableAttempts"],
+            ]
+            replay = result.get("replay") or {}
+        record["jobs"].append(
+            {
+                "id": j.id,
+                "state": state,
+                "error": err,
+                "counts": counts,
+                "device_round_trips": replay.get("device_round_trips", 0),
+                "ring": [
+                    {"name": r["name"], "ph": r["ph"], "args": r["args"]}
+                    for r in j.trace.ring_records()
+                ],
+            }
+        )
+    jm.shutdown(timeout=5)
+    with open(out_path, "w") as f:
+        json.dump(record, f)
 
 
 def _child(events: int, nodes: int, out_path: str, fleet: int = 0) -> None:
@@ -134,11 +203,15 @@ def _fail(msg: str) -> None:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", action="store_true")
+    ap.add_argument("--child-jobs", action="store_true")
     ap.add_argument("--events", type=int, default=6000)
     ap.add_argument("--nodes", type=int, default=2000)
     ap.add_argument("--out", type=str, default="")
     ap.add_argument("--fleet", type=int, default=0)
     args = ap.parse_args()
+    if args.child_jobs:
+        _child_jobs(args.events, args.nodes, args.out)
+        return
     if args.child:
         _child(args.events, args.nodes, args.out, args.fleet)
         return
@@ -255,6 +328,55 @@ def main() -> None:
         print(
             f"trace-check: fleet run OK — {fleet_stats['group_dispatches']} group "
             f"dispatches, reconcile lanes {sorted(lanes_seen)}"
+        )
+
+        # -- run 4: two CONCURRENT tenant jobs (the job plane) ---------
+        # Per-job isolation made checkable: every record in a job's
+        # private ring must carry that job's id (the scoped trace
+        # plane's tag), the two rings must never cross-contaminate,
+        # and the locked stream must land the same counts in both.
+        result4_path = os.path.join(tmp, "result_jobs.json")
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--child-jobs", "--events", "1000", "--nodes", "500",
+            "--out", result4_path,
+        ]
+        proc = subprocess.run(cmd, cwd=_REPO, env=env, timeout=CHILD_TIMEOUT_S)
+        if proc.returncode != 0:
+            raise SystemExit(f"trace-check child (jobs) exited rc={proc.returncode}")
+        with open(result4_path) as f:
+            result4 = json.load(f)
+        if not result4.get("finished"):
+            _fail(f"job-plane run did not finish: {result4}")
+        ids = [j["id"] for j in result4["jobs"]]
+        if len(set(ids)) != 2:
+            _fail(f"expected 2 distinct jobs, got {ids}")
+        counts_seen = []
+        for jrec in result4["jobs"]:
+            if jrec["state"] != "succeeded":
+                _fail(f"job {jrec['id']} ended {jrec['state']}: {jrec['error']}")
+            counts_seen.append(jrec["counts"])
+            if jrec["device_round_trips"] < 1:
+                _fail(f"job {jrec['id']} ran no device segments")
+            names4 = {}
+            for rec in jrec["ring"]:
+                names4[rec["name"]] = names4.get(rec["name"], 0) + 1
+                tag = rec["args"].get("job")
+                if tag != jrec["id"]:
+                    _fail(
+                        f"record in {jrec['id']}'s ring tagged job={tag!r} "
+                        f"({rec['name']}) — per-job rings must be isolated"
+                    )
+            for span in ("jobs.run", "replay.dispatch"):
+                if not names4.get(span):
+                    _fail(f"job {jrec['id']}'s ring has no {span} span")
+            if not names4.get("runner.step") and not names4.get("replay.reconcile"):
+                _fail(f"job {jrec['id']}'s ring has no step/reconcile spans")
+        if counts_seen[0] != counts_seen[1]:
+            _fail(f"concurrent jobs diverged: {counts_seen}")
+        print(
+            f"trace-check: jobs run OK — 2 isolated job rings, counts "
+            f"{counts_seen[0]}"
         )
     print("trace-check: PASS")
 
