@@ -554,6 +554,75 @@ def child_churn_jobs(
     return out
 
 
+def child_churn_trace(
+    trace_file: str, fmt: str, nodes: int, ops_per_step: int, max_events: int
+) -> dict:
+    """Trace-ingestion rung (round 14, ksim_tpu/traces): a REAL cluster
+    trace (Borg/Alibaba format; the bundled hand-checked fixture by
+    default) compiled to a churn stream and replayed through BOTH the
+    per-pass and the device-resident path.  Evidence the record must
+    carry: both paths' scheduled/unschedulable counts with a
+    ``counts_match`` flag (the second locked-count workload family next
+    to synthetic churn — tests/test_behavior_locks.py pins the fixture),
+    ``device_step_fraction`` with the fallback histogram (the
+    in-vocabulary claim: 0 fallbacks on the device path), the
+    ``phases`` wall-clock split, and the ingestion shape (records ->
+    ops -> steps)."""
+    import jax
+
+    from ksim_tpu.scenario import ScenarioRunner
+    from ksim_tpu.traces import trace_operations
+
+    _child_setup()
+    jax.config.update("jax_enable_x64", False)
+    t0 = time.perf_counter()
+    ops = trace_operations(
+        trace_file, fmt, nodes=nodes, max_events=max_events,
+        seed=0, ops_per_step=ops_per_step,
+    )
+    ingest_s = time.perf_counter() - t0
+    base = ScenarioRunner(pod_bucket_min=64)
+    rb = base.run(list(ops))
+    dev = ScenarioRunner(pod_bucket_min=64, device_replay=True)
+    rd = dev.run(list(ops))
+    drv = dev.replay_driver
+    base_counts = [rb.pods_scheduled, rb.unschedulable_attempts]
+    dev_counts = [rd.pods_scheduled, rd.unschedulable_attempts]
+    out = {
+        "trace": os.path.basename(trace_file),
+        "format": fmt,
+        "nodes": nodes,
+        "ops": len(ops),
+        "ingest_s": round(ingest_s, 3),
+        "events": rd.events_applied,
+        "steps": len(rd.steps),
+        "wall_s": round(rd.wall_seconds, 1),
+        "per_pass_wall_s": round(rb.wall_seconds, 1),
+        "counts": dev_counts,
+        "per_pass_counts": base_counts,
+        "counts_match": dev_counts == base_counts,
+        "device_step_fraction": (
+            round(drv.device_steps / len(rd.steps), 4) if rd.steps else None
+        ),
+        "fallback_steps": drv.fallback_steps,
+        "unsupported": dict(drv.unsupported),
+        "platform": jax.devices()[0].platform,
+    }
+    if rd.phase_seconds:
+        out["phases"] = {
+            name: {"seconds": rd.phase_seconds[name], "count": rd.phase_counts[name]}
+            for name in sorted(rd.phase_seconds)
+        }
+    print(
+        f"[churn_trace {fmt}:{out['trace']} {nodes}n] device {rd.wall_seconds:.1f}s "
+        f"counts {dev_counts} match={out['counts_match']} "
+        f"device_frac={out['device_step_fraction']}",
+        file=sys.stderr,
+        flush=True,
+    )
+    return out
+
+
 def _proc_watermarks() -> dict:
     """This process's /proc watermarks (stdlib + procfs only, guarded
     for non-Linux): the memory-map count — XLA:CPU executables each mmap
@@ -616,6 +685,14 @@ def _child_main(args: argparse.Namespace) -> None:
                 args.churn_events,
                 args.jobs_count,
                 args.jobs_workers,
+            )
+        elif args.child == "churn_trace":
+            out = child_churn_trace(
+                args.trace_file,
+                args.trace_format,
+                args.trace_nodes,
+                args.trace_ops_per_step,
+                args.trace_max_events,
             )
         else:  # pragma: no cover
             raise ValueError(f"unknown child mode {args.child!r}")
@@ -830,6 +907,17 @@ def main() -> None:
     # the child reads no environment for them).
     ap.add_argument("--jobs-count", type=int, default=8)
     ap.add_argument("--jobs-workers", type=int, default=4)
+    # Trace-rung shape (stdlib parent forwards; the bundled hand-checked
+    # fixture is the default — the locked trace workload family).
+    ap.add_argument(
+        "--trace-file",
+        type=str,
+        default=os.path.join(_REPO, "tests", "fixtures", "traces", "borg_mini.jsonl"),
+    )
+    ap.add_argument("--trace-format", type=str, default="borg")
+    ap.add_argument("--trace-nodes", type=int, default=24)
+    ap.add_argument("--trace-ops-per-step", type=int, default=2)
+    ap.add_argument("--trace-max-events", type=int, default=0)
     try:
         default_budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     except ValueError:
@@ -843,7 +931,7 @@ def main() -> None:
     # Internal: subprocess payload modes.
     ap.add_argument(
         "--child",
-        choices=["probe", "rung", "churn", "churn_fleet", "churn_jobs"],
+        choices=["probe", "rung", "churn", "churn_fleet", "churn_jobs", "churn_trace"],
         default=None,
     )
     ap.add_argument("--pods", type=int, default=0)
@@ -1163,6 +1251,29 @@ def main() -> None:
             mode="churn_jobs",
         )
 
+    def run_churn_trace_stage() -> None:
+        """Trace-ingestion rung (round 14, ksim_tpu/traces): the bundled
+        hand-checked Borg fixture compiled to a churn stream, replayed
+        per-pass AND device-resident — the record carries both counts
+        (counts_match), device_step_fraction with the fallback
+        histogram, the phases split, and the ingestion shape.  Small by
+        construction (the fixture is the locked workload family, not a
+        load test), so it shares the secondary-rung scaffolding with a
+        modest budget floor."""
+        run_secondary_churn_rung(
+            "churn_trace",
+            lambda resized: [
+                "--trace-file", args.trace_file,
+                "--trace-format", args.trace_format,
+                "--trace-nodes", str(args.trace_nodes),
+                "--trace-ops-per-step", str(args.trace_ops_per_step),
+                "--trace-max-events", str(args.trace_max_events),
+            ],
+            CHURN_EXACT_TIMEOUT,
+            min_budget=90,
+            mode="churn_trace",
+        )
+
     def run_churn_exact_stage() -> None:
         """Bounded exact-mode (x64) churn: demonstrates in the driver
         record that the replay counts are mode- and platform-identical
@@ -1204,6 +1315,7 @@ def main() -> None:
     run_churn_device_full_stage()
     run_churn_fleet_stage()
     run_churn_jobs_stage()
+    run_churn_trace_stage()
     run_churn_exact_stage()
     if fallback:
         # The north-star shape still gets a measured record on CPU: the

@@ -2632,10 +2632,28 @@ class ReplayDriver:
         for k in range(plan.n_steps):
             if bool(eligible[k] > 0) != plan.pred_featurizes[k]:
                 # The sync-schedule prediction missed (a create-free step
-                # still had eligible pods, or every eligible pod vanished)
-                # — the shipped rank tensors assumed the wrong slot
-                # history.  The store is untouched: discard and fall back.
-                return "featurize_prediction"
+                # still had eligible pods, or every eligible pod vanished).
+                # That only matters when the divergent sync schedules can
+                # see DIFFERENT node sets: the slot sim is a pure function
+                # of the live-node sequence, and a sync over an unchanged
+                # set is a no-op.  Both schedules agree (and synced the
+                # same steps) before this first mismatch; if no node event
+                # happened after the last predicted sync, the node set is
+                # frozen from there on, every later sync in EITHER
+                # schedule is a no-op, and the shipped rank tensors are
+                # provably identical — the window stays on-device.  (This
+                # is what keeps static-universe trace streams, whose
+                # create-free steps routinely carry eligible pods, at
+                # zero fallbacks — docs/churn_floor.md.)  With a node
+                # event past that sync the divergence is real: the
+                # shipped rank tensors may assume the wrong slot history.
+                # The store is untouched: discard and fall back.
+                last_sync = max(
+                    (j for j in range(k) if plan.pred_featurizes[j]), default=-1
+                )
+                if any(plan.step_node_event[last_sync + 1 : plan.n_steps]):
+                    return "featurize_prediction"
+                break
         if st.preempt and bool(
             np.any(np.asarray(pulled["overflow"])[: plan.n_steps])
         ):

@@ -7,6 +7,7 @@ from ksim_tpu.jobs.manager import (
     JOB_FAULT_SITES,
     TERMINAL_STATES,
     Job,
+    JobLimitExceeded,
     JobManager,
     parse_job_faults,
 )
@@ -16,6 +17,7 @@ __all__ = [
     "JOB_FAULT_SITES",
     "TERMINAL_STATES",
     "Job",
+    "JobLimitExceeded",
     "JobManager",
     "JobQueue",
     "JobQueueFull",

@@ -26,9 +26,30 @@ The HTTP surface lives in server/http.py (``/api/v1/jobs``): submit /
 status / result / cancel plus an SSE stream of the job's progress and
 trace events, fed by the job plane's record sink.
 
+Admission (ROADMAP "service round 2", round 14) is cost- and
+bounds-aware:
+
+- the queue orders shortest-job-first within a priority band, costed by
+  the spec's event count, with a starvation bound
+  (``KSIM_JOBS_SJF_BYPASS`` — jobs/queue.py), so a 50k-event job cannot
+  convoy 6k jobs behind it;
+- per-submission resource bounds (``KSIM_JOBS_MAX_EVENTS`` /
+  ``KSIM_JOBS_MAX_NODES``) refuse oversized specs at POST time with
+  ``JobLimitExceeded`` (HTTP 413) — measured AFTER trace ingestion, so
+  a trace-sourced job is bounded by what it would actually replay;
+- scenarios may reference REGISTERED traces by name
+  (``spec.scenario.source.trace.name`` resolved in the operator's
+  ``KSIM_TRACES_DIR`` — ksim_tpu/traces/registry.py); raw ``path``
+  references are refused exactly like the snapshot-path fields;
+- a spec may arm its own chaos (``spec.faults`` —
+  scenario/spec.py ``faults_spec_from_doc``) on the job's PRIVATE
+  fault plane, sites restricted to ``JOB_FAULT_SITES`` like the
+  operator's ``KSIM_JOBS_FAULTS`` ordinals.
+
 Environment (docs/env.md "Job plane"): ``KSIM_JOBS_WORKERS``,
 ``KSIM_JOBS_QUEUE``, ``KSIM_JOBS_RING``, ``KSIM_JOBS_KEEP``,
-``KSIM_JOBS_EVENTS``, ``KSIM_JOBS_FAULTS``.
+``KSIM_JOBS_EVENTS``, ``KSIM_JOBS_FAULTS``, ``KSIM_JOBS_MAX_EVENTS``,
+``KSIM_JOBS_MAX_NODES``, ``KSIM_JOBS_SJF_BYPASS``.
 """
 
 from __future__ import annotations
@@ -47,7 +68,19 @@ from ksim_tpu.obs import TRACE, TracePlane
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["Job", "JobManager", "JobQueueFull", "parse_job_faults"]
+__all__ = [
+    "Job",
+    "JobLimitExceeded",
+    "JobManager",
+    "JobQueueFull",
+    "parse_job_faults",
+]
+
+
+class JobLimitExceeded(Exception):
+    """A submission exceeded the operator's per-job resource bounds
+    (``KSIM_JOBS_MAX_EVENTS`` / ``KSIM_JOBS_MAX_NODES``) — HTTP 413
+    upstream, with this message as the reason body."""
 
 #: Final job states (no transitions out).
 TERMINAL_STATES = frozenset({"succeeded", "failed", "cancelled"})
@@ -62,8 +95,11 @@ JOB_FAULT_SITES = frozenset(
 )
 
 
-def parse_job_faults(spec: str) -> dict[int, FaultPlane]:
-    """Parse ``KSIM_JOBS_FAULTS`` into per-job-ordinal fault planes.
+def _job_fault_specs(spec: str) -> dict[int, list[str]]:
+    """Parse ``KSIM_JOBS_FAULTS`` into per-ordinal schedule SPEC
+    strings (the manager builds a FRESH plane per submission from
+    these, so a refused submission can never leave schedules behind on
+    a shared plane).
 
     Syntax mirrors ``KSIM_FLEET_FAULTS``: comma/semicolon-separated
     ``<ordinal>:<site>=<schedule>[@error]`` entries where ``ordinal``
@@ -71,7 +107,7 @@ def parse_job_faults(spec: str) -> dict[int, FaultPlane]:
     ``"0:replay.dispatch=always@device"`` arms only the first job
     submitted.  Sites outside ``JOB_FAULT_SITES`` and malformed entries
     raise."""
-    planes: dict[int, FaultPlane] = {}
+    specs: dict[int, list[str]] = {}
     for part in spec.replace(";", ",").split(","):
         part = part.strip()
         if not part:
@@ -88,26 +124,66 @@ def parse_job_faults(spec: str) -> dict[int, FaultPlane]:
                 f"KSIM_JOBS_FAULTS entry {part!r}: site {site!r} is not a "
                 f"job-plane site (have {sorted(JOB_FAULT_SITES)})"
             )
-        planes.setdefault(int(ord_s), FaultPlane()).configure(rest)
+        # Fail-fast on the SCHEDULE too (a throwaway plane): an operator
+        # typo must raise at JobManager construction, not surface later
+        # as an HTTP 400 blaming some tenant's spec.faults while the
+        # chaos schedule silently never runs.
+        FaultPlane().configure(rest)
+        specs.setdefault(int(ord_s), []).append(rest)
+    return specs
+
+
+def parse_job_faults(spec: str) -> dict[int, FaultPlane]:
+    """``KSIM_JOBS_FAULTS`` -> per-job-ordinal fault planes (see
+    ``_job_fault_specs`` for the grammar and refusals)."""
+    planes: dict[int, FaultPlane] = {}
+    for ordinal, entries in _job_fault_specs(spec).items():
+        plane = planes[ordinal] = FaultPlane()
+        for entry in entries:
+            plane.configure(entry)
     return planes
 
 
-def _parse_job_spec(doc: Any) -> tuple[list, dict, int]:
+def _tenant_trace_resolver(trace_doc: dict) -> str:
+    """The job plane's trace resolver: registered names only.  A raw
+    ``path`` is refused for the same reason ``initialSnapshotPath`` is —
+    tenants must never make the server read arbitrary files; the
+    operator registers traces by placing them in ``KSIM_TRACES_DIR``."""
+    from ksim_tpu.scenario.spec import ScenarioSpecError, default_trace_resolver
+
+    if trace_doc.get("path"):
+        raise ScenarioSpecError(
+            "source.trace.path is not allowed in a tenant job spec — "
+            "reference a trace registered in KSIM_TRACES_DIR by name"
+        )
+    return default_trace_resolver(trace_doc)
+
+
+def _parse_job_spec(doc: Any) -> tuple[list, dict, int, str]:
     """Validate a tenant job document -> (operations, simulator spec,
-    priority).  Accepts the SchedulerSimulation-ish shape::
+    priority, canonical fault spec).  Accepts the
+    SchedulerSimulation-ish shape::
 
         {"spec": {"priority": 0,
                   "simulator": {...},          # recordMode/preemption/
                                                # deviceReplay/fleet/
                                                # schedulerConfig/
                                                # initialSnapshot (INLINE)
-                  "scenario": {"operations": [...]}}}
+                  "faults": {...},             # site -> schedule (the
+                                               # job's PRIVATE plane)
+                  "scenario": {"operations": [...]   # or source.trace
+                  }}}
 
     or a bare ``{"operations": [...]}``.  File-path fields are REFUSED:
     tenants must not make the server read its own filesystem (the
     KEP-184 mounted-file workflow is the operator's
-    ``cmd/simulation.py``, not this surface)."""
-    from ksim_tpu.scenario.spec import ScenarioSpecError, operations_from_spec
+    ``cmd/simulation.py``, not this surface); trace references resolve
+    by REGISTERED NAME only (``_tenant_trace_resolver``)."""
+    from ksim_tpu.scenario.spec import (
+        ScenarioSpecError,
+        faults_spec_from_doc,
+        operations_from_spec,
+    )
 
     if not isinstance(doc, dict):
         raise ScenarioSpecError("job document must be a mapping")
@@ -138,16 +214,28 @@ def _parse_job_spec(doc: Any) -> tuple[list, dict, int]:
     scenario = spec.get("scenario")
     if scenario is None and "operations" in spec:
         scenario = {"operations": spec["operations"]}
+    if scenario is None and "source" in spec:
+        scenario = {"source": spec["source"]}
     if scenario is None:
         raise ScenarioSpecError(
-            "job spec needs an inline scenario (spec.scenario.operations)"
+            "job spec needs an inline scenario (spec.scenario.operations "
+            "or spec.scenario.source.trace)"
         )
-    ops = operations_from_spec(scenario)
+    ops = operations_from_spec(scenario, trace_resolver=_tenant_trace_resolver)
+    fault_spec = faults_spec_from_doc(doc)
+    if fault_spec:
+        for part in fault_spec.split(","):
+            site = part.partition("=")[0]
+            if site not in JOB_FAULT_SITES:
+                raise ScenarioSpecError(
+                    f"spec.faults site {site!r} is not a job-plane site "
+                    f"(have {sorted(JOB_FAULT_SITES)})"
+                )
     try:
         priority = int(spec.get("priority", 0))
     except (TypeError, ValueError):
         raise ScenarioSpecError("spec.priority must be an integer") from None
-    return ops, dict(sim), priority
+    return ops, dict(sim), priority, fault_spec
 
 
 class Job:
@@ -371,6 +459,9 @@ class JobManager:
         keep: "int | None" = None,
         max_events: "int | None" = None,
         fault_spec: "str | None" = None,
+        max_job_events: "int | None" = None,
+        max_job_nodes: "int | None" = None,
+        sjf_bypass: "int | None" = None,
     ) -> None:
         env = os.environ
         if workers is None:
@@ -385,11 +476,21 @@ class JobManager:
             max_events = int(env.get("KSIM_JOBS_EVENTS", "8192"))
         if fault_spec is None:
             fault_spec = env.get("KSIM_JOBS_FAULTS", "")
+        if max_job_events is None:
+            max_job_events = int(env.get("KSIM_JOBS_MAX_EVENTS", "0"))
+        if max_job_nodes is None:
+            max_job_nodes = int(env.get("KSIM_JOBS_MAX_NODES", "0"))
+        if sjf_bypass is None:
+            raw = env.get("KSIM_JOBS_SJF_BYPASS", "")
+            sjf_bypass = int(raw) if raw else None
         self._ring_cap = max(ring_cap, 16)
         self._keep = max(keep, 1)
         self._max_events = max(max_events, 64)
-        self._fault_planes = parse_job_faults(fault_spec) if fault_spec else {}
-        self.queue = JobQueue(queue_limit)
+        # Per-submission resource bounds (0 = unbounded): HTTP 413.
+        self._max_job_events = max(max_job_events, 0)
+        self._max_job_nodes = max(max_job_nodes, 0)
+        self._fault_specs = _job_fault_specs(fault_spec) if fault_spec else {}
+        self.queue = JobQueue(queue_limit, max_bypass=sjf_bypass)
         self._lock = threading.Lock()
         self._jobs: "OrderedDict[str, Job]" = OrderedDict()  # guarded-by: _lock
         self._seq = 0  # guarded-by: _lock
@@ -406,8 +507,10 @@ class JobManager:
 
     def submit(self, doc: Any, *, priority: "int | None" = None) -> Job:
         """Validate + enqueue one tenant job document.  Raises
-        ``ScenarioSpecError`` on a bad spec (HTTP 400) and
-        ``JobQueueFull`` on a saturated queue (HTTP 429).
+        ``ScenarioSpecError`` on a bad spec (HTTP 400),
+        ``JobLimitExceeded`` when the spec exceeds the operator's
+        per-job bounds (HTTP 413), and ``JobQueueFull`` on a saturated
+        queue (HTTP 429).
 
         The submission ordinal (the ``KSIM_JOBS_FAULTS`` key) commits
         only on a SUCCESSFUL enqueue: a refused submission must not
@@ -417,12 +520,47 @@ class JobManager:
         lock, so concurrent submits cannot interleave ordinals with
         rejections; lock order is ``_lock`` → ``queue._cond`` →
         ``job._cond``, matching every other path."""
-        ops, sim, spec_priority = _parse_job_spec(doc)
+        ops, sim, spec_priority, fault_spec = _parse_job_spec(doc)
         if priority is None:
             priority = spec_priority
+        # Resource bounds, AFTER parsing/ingestion: what is measured is
+        # the stream the job would actually replay (a trace-sourced job
+        # is bounded by its compiled size, not its reference's).
+        if self._max_job_events and len(ops) > self._max_job_events:
+            raise JobLimitExceeded(
+                f"job spec compiles to {len(ops)} events, over the "
+                f"per-job bound of {self._max_job_events} "
+                "(KSIM_JOBS_MAX_EVENTS)"
+            )
+        if self._max_job_nodes:
+            n_nodes = sum(
+                1 for op in ops if op.kind == "nodes" and op.op == "create"
+            )
+            if n_nodes > self._max_job_nodes:
+                raise JobLimitExceeded(
+                    f"job spec creates {n_nodes} nodes, over the per-job "
+                    f"bound of {self._max_job_nodes} (KSIM_JOBS_MAX_NODES)"
+                )
         with self._lock:
             ordinal = self._seq
-            faults = self._fault_planes.get(ordinal)
+            # The job's private plane is built FRESH per submission from
+            # the operator's per-ordinal schedules plus the spec's own
+            # faults section (a refused submission leaves nothing armed;
+            # FaultPlane.configure rejects malformed schedules loudly
+            # -> HTTP 400).
+            entries = list(self._fault_specs.get(ordinal, ()))
+            if fault_spec:
+                entries.append(fault_spec)
+            faults: "FaultPlane | None" = None
+            if entries:
+                from ksim_tpu.scenario.spec import ScenarioSpecError
+
+                faults = FaultPlane()
+                try:
+                    for entry in entries:
+                        faults.configure(entry)
+                except ValueError as e:
+                    raise ScenarioSpecError(f"spec.faults: {e}") from None
             if faults is not None and sim.get("fleet"):
                 from ksim_tpu.scenario.spec import ScenarioSpecError
 
@@ -430,8 +568,9 @@ class JobManager:
                 # only; silently dropping it for a fleet job would run
                 # the chaos schedule against nothing.
                 raise ScenarioSpecError(
-                    f"KSIM_JOBS_FAULTS arms job ordinal {ordinal}, but the "
-                    "submitted job is a fleet job — per-lane chaos uses "
+                    f"chaos is armed for job ordinal {ordinal} "
+                    "(KSIM_JOBS_FAULTS or spec.faults), but the submitted "
+                    "job is a fleet job — per-lane chaos uses "
                     "KSIM_FLEET_FAULTS (docs/faults.md)"
                 )
             job = Job(
@@ -449,7 +588,11 @@ class JobManager:
             # immediately, and the SSE log's state order must match
             # reality.
             job.emit({"event": "state", "state": "queued"}, vital=True)
-            self.queue.put(job, priority=priority)  # JobQueueFull -> no ordinal
+            # Cost-aware admission: the spec's event count is the cost
+            # estimate (shortest-job-first within the priority band).
+            self.queue.put(
+                job, priority=priority, cost=len(ops)
+            )  # JobQueueFull -> no ordinal
             self._seq += 1
             self._jobs[job.id] = job
             self._prune_locked()

@@ -96,6 +96,10 @@ SPAN_NAMES: tuple[str, ...] = (
     "jobs.run",  # one tenant job end-to-end on a job-plane worker
     #              (ksim_tpu/jobs/manager.py; recorded on the JOB's
     #              private plane via the worker's scoped override)
+    "scenario.ingest",  # one trace ingestion: parse + resample +
+    #                     compile of a real cluster trace into the
+    #                     operation stream (ksim_tpu/traces/compile.py;
+    #                     args carry format/records/ops)
 )
 
 #: Instant event names.

@@ -17,6 +17,7 @@ from ksim_tpu.scenario.runner import (
 from ksim_tpu.scenario.generate import churn_scenario
 from ksim_tpu.scenario.spec import (
     ScenarioSpecError,
+    faults_spec_from_doc,
     load_scenario,
     operations_from_spec,
     spec_from_operations,
@@ -30,6 +31,7 @@ __all__ = [
     "ScenarioSpecError",
     "StepResult",
     "churn_scenario",
+    "faults_spec_from_doc",
     "load_scenario",
     "operations_from_spec",
     "spec_from_operations",

@@ -21,6 +21,32 @@ and lowers it to the library ``Operation`` stream:
 
 Exactly one of the four must be set per operation, like the KEP's
 "one of the following four fields must be specified".
+
+Since round 14 a scenario may also be SOURCED instead of enumerated:
+``spec.source.trace`` names a real cluster trace (ksim_tpu/traces/) to
+be parsed, resampled and compiled into the operation stream —
+
+    spec:
+      source:
+        trace:
+          name: borg_mini.jsonl     # registered in KSIM_TRACES_DIR
+          # path: /data/trace.gz    # library/CLI only; the job plane
+          #                           refuses raw paths
+          format: borg              # borg | alibaba
+          nodes: 64                 # synthesized node universe
+          maxEvents: 5000           # resample budget (0 = no cap)
+          seed: 0
+          opsPerStep: 100
+          sourceNodes: 4000         # optional: rescale load to nodes/
+
+and a ``spec.faults`` section arms ``KSIM_FAULTS``-style schedules from
+the document itself (the chaos-native half of the same ROADMAP item):
+a mapping of injection site to schedule string, canonicalized by
+``faults_spec_from_doc`` into the exact grammar ``KSIM_FAULTS`` speaks
+and armed by the consumer (the job plane arms it on the job's PRIVATE
+plane, sites restricted to the job-plane set — docs/jobs.md).
+
+Exactly one of ``operations`` / ``source`` must be present.
 """
 
 from __future__ import annotations
@@ -71,12 +97,123 @@ def merge_patch(target: JSONObj, patch: Any) -> Any:
     return out
 
 
-def operations_from_spec(doc: JSONObj) -> list[Operation]:
+def default_trace_resolver(trace_doc: JSONObj) -> str:
+    """Resolve a ``source.trace`` reference to a readable path: an
+    explicit ``path`` (library/CLI use), else a ``name`` looked up in
+    the ``KSIM_TRACES_DIR`` registry.  The job plane substitutes a
+    resolver that refuses ``path`` outright (tenants must never make
+    the server read arbitrary files)."""
+    from ksim_tpu.traces.registry import resolve
+
+    path = trace_doc.get("path")
+    if path:
+        return str(path)
+    name = trace_doc.get("name")
+    if not name:
+        raise ScenarioSpecError("source.trace needs a name (or path)")
+    return resolve(str(name))
+
+
+def _operations_from_source(src: JSONObj, trace_resolver) -> list[Operation]:
+    from ksim_tpu.traces.compile import TRACE_FORMATS, trace_operations
+    from ksim_tpu.traces.schema import TraceError
+
+    if not isinstance(src, dict) or set(src) != {"trace"}:
+        raise ScenarioSpecError(
+            "spec.source supports exactly one key: 'trace'"
+        )
+    t = src["trace"] or {}
+    fmt = t.get("format")
+    if fmt not in TRACE_FORMATS:
+        raise ScenarioSpecError(
+            f"source.trace.format must be one of {list(TRACE_FORMATS)} "
+            f"(got {fmt!r})"
+        )
+    try:
+        nodes = int(t.get("nodes", 100))
+        max_events = int(t.get("maxEvents", 0))
+        seed = int(t.get("seed", 0))
+        ops_per_step = int(t.get("opsPerStep", 100))
+        source_nodes = t.get("sourceNodes")
+        source_nodes = int(source_nodes) if source_nodes is not None else None
+    except (TypeError, ValueError):
+        raise ScenarioSpecError(
+            "source.trace nodes/maxEvents/seed/opsPerStep/sourceNodes "
+            "must be integers"
+        ) from None
+    try:
+        path = (trace_resolver or default_trace_resolver)(t)
+        return trace_operations(
+            path,
+            fmt,
+            nodes=nodes,
+            max_events=max_events,
+            seed=seed,
+            ops_per_step=ops_per_step,
+            source_nodes=source_nodes,
+        )
+    except TraceError as e:
+        # One failure vocabulary at this surface: a bad trace reference
+        # or corrupt file is a bad SCENARIO document (HTTP 400), not a
+        # server error.
+        raise ScenarioSpecError(str(e)) from e
+
+
+def faults_spec_from_doc(doc: JSONObj) -> str:
+    """Canonicalize ``spec.faults`` — a mapping of injection site to
+    ``KSIM_FAULTS`` schedule string (``call:N``/``first:K``/``always``/
+    ``p:P[:SEED]``/``hang:T[:K]``, optional ``@error``) — into the
+    comma-joined ``site=schedule`` grammar the fault plane's
+    ``configure`` speaks.  Returns ``""`` when the document arms
+    nothing.  Validation of schedules (and of WHICH sites a consumer
+    may arm) stays with the consumer: the job plane restricts sites to
+    its own set and lets ``FaultPlane.configure`` reject malformed
+    schedules loudly."""
+    spec = doc.get("spec") or doc
+    faults = spec.get("faults")
+    if faults is None:
+        return ""
+    if not isinstance(faults, dict) or not all(
+        isinstance(k, str) and isinstance(v, str) and k and v
+        for k, v in faults.items()
+    ):
+        raise ScenarioSpecError(
+            "spec.faults must map injection sites to schedule strings "
+            '(e.g. {"replay.dispatch": "call:2@device"})'
+        )
+    for site, sched in faults.items():
+        if "=" in site or "," in site or ";" in site:
+            raise ScenarioSpecError(f"spec.faults site {site!r} is malformed")
+        # The schedule value must be ONE schedule: an embedded separator
+        # would smuggle extra `site=schedule` entries past the caller's
+        # site allowlist once FaultPlane.configure re-splits the string.
+        if "," in sched or ";" in sched:
+            raise ScenarioSpecError(
+                f"spec.faults schedule {sched!r} for {site!r} is malformed "
+                "(one schedule per site; no ','/';')"
+            )
+    return ",".join(f"{site}={sched}" for site, sched in sorted(faults.items()))
+
+
+def operations_from_spec(
+    doc: JSONObj, *, trace_resolver=None
+) -> list[Operation]:
     """Lower a Scenario document (or bare ``{"operations": [...]}``) to
     the runner's Operation list, sorted by step (stable within a step,
-    like the KEP's per-MajorStep batches)."""
+    like the KEP's per-MajorStep batches).  A document may instead
+    carry ``spec.source.trace`` (exactly one of the two): the named
+    trace is ingested through ``trace_resolver`` (default: explicit
+    path, else the ``KSIM_TRACES_DIR`` registry)."""
     spec = doc.get("spec") or doc
     raw_ops = spec.get("operations")
+    source = spec.get("source")
+    if source is not None:
+        if raw_ops is not None:
+            raise ScenarioSpecError(
+                "document has both spec.operations and spec.source — "
+                "exactly one must be present"
+            )
+        return _operations_from_source(source, trace_resolver)
     if raw_ops is None:
         raise ScenarioSpecError("document has no spec.operations")
     out: list[Operation] = []

@@ -33,6 +33,7 @@ job plane (docs/jobs.md):
 
     POST   /api/v1/jobs                 -> submit a scenario job
                                            (202 {job}, 400 bad spec,
+                                           413 over per-job bounds,
                                            429 queue full)
     GET    /api/v1/jobs                 -> list job statuses
     GET    /api/v1/jobs/<id>            -> one job's status
@@ -48,6 +49,12 @@ job plane (docs/jobs.md):
                                            running: cooperative, the
                                            in-flight segment rolls
                                            back)
+    GET    /api/v1/traces               -> trace names registered in
+                                           the operator's
+                                           KSIM_TRACES_DIR (what a
+                                           tenant may reference as
+                                           scenario source.trace.name
+                                           — docs/scenario.md)
 
 CORS headers come from ``cors_allowed_origins`` (the reference reads them
 from config, server.go:28-32)."""
@@ -198,6 +205,12 @@ class _Handler(BaseHTTPRequestHandler):
             # chrome://tracing.  Empty unless the trace plane's ring is
             # on (KSIM_TRACE_OUT / KSIM_TRACE=1 / TRACE.enable()).
             self._json(200, TRACE.export_chrome())
+        elif url.path == "/api/v1/traces":
+            # The named-trace registry (ksim_tpu/traces/registry.py):
+            # names only — resolution and parsing stay server-side.
+            from ksim_tpu.traces.registry import list_traces
+
+            self._json(200, {"items": list_traces()})
         elif url.path == "/api/v1/waitingpods":
             # Permit-parked pods (the framework handle's waiting-pod view).
             self._json(200, {"items": self.server.di.scheduler_service.get_waiting_pods()})
@@ -314,7 +327,13 @@ class _Handler(BaseHTTPRequestHandler):
             jm.snapshot()
             if jm is not None
             else {
-                "queue": {"depth": 0, "capacity": 0, "submitted": 0, "rejected": 0},
+                "queue": {
+                    "depth": 0,
+                    "capacity": 0,
+                    "submitted": 0,
+                    "rejected": 0,
+                    "bypass_pops": 0,
+                },
                 "workers": {"pool": 0, "active": 0},
                 "jobs": {},
             }
@@ -327,7 +346,7 @@ class _Handler(BaseHTTPRequestHandler):
         """POST /api/v1/jobs: validate + enqueue a tenant scenario job.
         202 with the job status on success; 400 on a bad spec; 429 when
         the bounded queue refuses (backpressure the tenant can act on)."""
-        from ksim_tpu.jobs import JobQueueFull
+        from ksim_tpu.jobs import JobLimitExceeded, JobQueueFull
         from ksim_tpu.scenario.spec import ScenarioSpecError
 
         try:
@@ -348,6 +367,11 @@ class _Handler(BaseHTTPRequestHandler):
             job = jm.submit(doc)
         except ScenarioSpecError as e:
             self._json(400, {"message": str(e)})
+            return
+        except JobLimitExceeded as e:
+            # Payload-too-large, with the bound in the reason body so
+            # the tenant can resize instead of guessing.
+            self._json(413, {"message": str(e)})
             return
         except JobQueueFull as e:
             self._json(429, {"message": str(e)})

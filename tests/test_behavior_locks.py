@@ -101,6 +101,58 @@ def test_churn_lock_6k_holds_with_tracing_enabled(tmp_path):
         TRACE._active, TRACE._ring_on, TRACE._user_disabled = prev_state
 
 
+# The trace workload family (round 14, ksim_tpu/traces): the bundled
+# hand-checked Borg fixture compiled at 24 nodes / ops_per_step=2 —
+# the SECOND locked-count family next to synthetic churn, and the
+# first priority-DIVERSE one (trace tiers land on PRIORITY_LADDER, so
+# windows are not priority-flat).  bench.py's churn_trace rung replays
+# the same compilation.
+TRACE_LOCK_SCHEDULED = 56
+TRACE_LOCK_UNSCHEDULABLE = 19
+TRACE_LOCK_EVENTS = 126
+
+
+def test_trace_lock_borg_mini_device_vs_per_pass():
+    """The trace-ingestion acceptance lock: the bundled fixture compiles
+    deterministically and replays byte-identically through the per-pass
+    AND the device-resident path, with the device path carrying EVERY
+    step (0 fallbacks — in-vocabulary by construction, and create-free
+    steps with eligible pods stay on-device since the round-14
+    featurize-prediction refinement for static node universes)."""
+    from ksim_tpu.traces import trace_operations
+
+    jax.config.update("jax_enable_x64", False)
+    ops = trace_operations(
+        "tests/fixtures/traces/borg_mini.jsonl",
+        "borg",
+        nodes=24,
+        ops_per_step=2,
+    )
+    base_r = ScenarioRunner(pod_bucket_min=64)
+    base = base_r.run(list(ops))
+    assert base.events_applied == TRACE_LOCK_EVENTS
+    assert (base.pods_scheduled, base.unschedulable_attempts) == (
+        TRACE_LOCK_SCHEDULED,
+        TRACE_LOCK_UNSCHEDULABLE,
+    )
+    dev_r = ScenarioRunner(pod_bucket_min=64, device_replay=True)
+    dev = dev_r.run(list(ops))
+    assert (dev.pods_scheduled, dev.unschedulable_attempts) == (
+        TRACE_LOCK_SCHEDULED,
+        TRACE_LOCK_UNSCHEDULABLE,
+    )
+    base_sig = [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in base.steps
+    ]
+    dev_sig = [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in dev.steps
+    ]
+    assert dev_sig == base_sig
+    driver = dev_r.replay_driver
+    assert driver.fallback_steps == 0, driver.unsupported
+    assert driver.device_steps == len(dev.steps)
+
+
 # The full 50k flagship locks (repo CLAUDE.md).
 LOCK_50K_SCHEDULED = 52_781
 LOCK_50K_UNSCHEDULABLE = 42_829

@@ -267,6 +267,64 @@ def test_bench_churn_fleet_child_survives_dead_device(tmp_path):
     assert all(s == 0 for s in rec["fleet"]["lane_device_steps"])
 
 
+def test_bench_churn_trace_child_records_trace_evidence(tmp_path):
+    """Round 14: the churn_trace child's record carries the trace-plane
+    acceptance evidence — both paths' counts with counts_match (the
+    bundled fixture's locked family), device_step_fraction 1.0 with 0
+    fallbacks, and the phases split."""
+    out = tmp_path / "trace.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_trace", "--out", str(out),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=sanitized_cpu_env(),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["format"] == "borg" and rec["trace"] == "borg_mini.jsonl"
+    # The locked trace family (tests/test_behavior_locks.py).
+    assert rec["counts"] == [56, 19]
+    assert rec["counts_match"] is True
+    assert rec["device_step_fraction"] == 1.0
+    assert rec["fallback_steps"] == 0 and rec["unsupported"] == {}
+    assert "phases" in rec and "replay.dispatch" in rec["phases"]
+
+
+def test_bench_churn_trace_child_survives_dead_device(tmp_path):
+    """One-JSON-line-under-any-hardware, trace edition: with every
+    dispatch failing, the whole trace stream degrades to the per-pass
+    host path, the counts still match, and the record still exists."""
+    out = tmp_path / "trace_dead.json"
+    env = sanitized_cpu_env(
+        {
+            "KSIM_FAULTS": "replay.dispatch=always@device",
+            "KSIM_REPLAY_BREAKER_N": "2",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_trace", "--out", str(out),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["counts_match"] is True  # the host path carried the stream
+    assert rec["counts"] == [56, 19]
+    assert rec["device_step_fraction"] == 0.0
+    assert rec["unsupported"].get("device_error", 0) >= 2
+
+
 @pytest.mark.slow
 def test_bench_emits_json_when_probe_backend_is_dead():
     """A wedged/absent accelerator at PROBE time (the chip-tunnel

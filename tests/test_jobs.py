@@ -183,6 +183,7 @@ def test_job_queue_full_returns_429(monkeypatch):
         status, m = _req(srv, "GET", "/api/v1/metrics")
         assert m["jobs"]["queue"] == {
             "depth": 1, "capacity": 1, "submitted": 1, "rejected": 1,
+            "bypass_pops": 0,
         }
         assert m["jobs"]["workers"] == {"pool": 0, "active": 0}
         # Cancel the queued job: immediate terminal state.
@@ -527,3 +528,186 @@ def test_job_fault_containment_6k_locked():
     finally:
         jm.shutdown(timeout=5)
         jax.config.update("jax_enable_x64", prev_x64)
+
+
+# ---------------------------------------------------------------------------
+# Round 14: cost-aware admission (SJF + starvation bound)
+# ---------------------------------------------------------------------------
+
+
+def test_queue_sjf_within_priority_band():
+    from ksim_tpu.jobs import JobQueue
+
+    q = JobQueue(limit=10)
+    q.put("big", cost=1000)
+    q.put("small", cost=5)
+    q.put("mid", cost=50)
+    q.put("prio", priority=5, cost=9999)  # a higher band beats any cost
+    assert [q.get(0.1) for _ in range(4)] == ["prio", "small", "mid", "big"]
+    # cost=0 ties keep FIFO (the pre-round-14 special case).
+    q.put("a"); q.put("b")
+    assert [q.get(0.1), q.get(0.1)] == ["a", "b"]
+
+
+def test_queue_starvation_bound():
+    """A long job is overtaken at most max_bypass times, then pops
+    regardless of cost — the SJF starvation bound, deterministically."""
+    from ksim_tpu.jobs import JobQueue
+
+    q = JobQueue(limit=0, max_bypass=2)
+    q.put("long", cost=1000)
+    q.put("s1", cost=1)
+    assert q.get(0.1) == "s1"      # bypass 1
+    q.put("s2", cost=1)
+    assert q.get(0.1) == "s2"      # bypass 2
+    q.put("s3", cost=1)
+    assert q.get(0.1) == "long"    # the bound fires: cost ignored
+    assert q.get(0.1) == "s3"
+    assert q.stats()["bypass_pops"] == 1
+
+
+def test_manager_submit_costs_queue_by_event_count(monkeypatch):
+    """With no workers, submissions queue up; the pop order proves the
+    manager passed the spec's event count as the cost."""
+    jm = JobManager(workers=0, queue_limit=8)
+    try:
+        big = jm.submit(tiny_spec(10))
+        small = jm.submit(tiny_spec(1))
+        assert jm.queue.get(0.1) is small
+        assert jm.queue.get(0.1) is big
+    finally:
+        jm.shutdown(timeout=1)
+
+
+# ---------------------------------------------------------------------------
+# Round 14: per-job resource bounds -> HTTP 413
+# ---------------------------------------------------------------------------
+
+
+def test_job_limits_refuse_oversized_specs():
+    from ksim_tpu.jobs import JobLimitExceeded
+
+    jm = JobManager(workers=0, queue_limit=8, max_job_events=5, max_job_nodes=0)
+    try:
+        with pytest.raises(JobLimitExceeded, match="KSIM_JOBS_MAX_EVENTS"):
+            jm.submit(tiny_spec(10))
+        # A refused submission consumes no ordinal and queues nothing.
+        assert jm.queue.depth() == 0
+        ok = jm.submit(tiny_spec(1))
+        assert ok.ordinal == 0
+    finally:
+        jm.shutdown(timeout=1)
+    jm2 = JobManager(workers=0, queue_limit=8, max_job_nodes=1)
+    try:
+        with pytest.raises(JobLimitExceeded, match="KSIM_JOBS_MAX_NODES"):
+            jm2.submit(tiny_spec(1))  # tiny_spec creates 2 nodes
+    finally:
+        jm2.shutdown(timeout=1)
+
+
+def test_job_limit_returns_413_over_http(monkeypatch):
+    monkeypatch.setenv("KSIM_JOBS_MAX_EVENTS", "5")
+    monkeypatch.setenv("KSIM_JOBS_WORKERS", "0")
+    di = DIContainer()
+    srv = SimulatorServer(di, port=0).start()
+    try:
+        status, body = _req(srv, "POST", "/api/v1/jobs", tiny_spec(10))
+        assert status == 413
+        assert "KSIM_JOBS_MAX_EVENTS" in body["message"]
+        status, _ = _req(srv, "POST", "/api/v1/jobs", tiny_spec(1))
+        assert status == 202
+    finally:
+        srv.shutdown_server()
+        di.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Round 14: trace-by-name submission + spec-armed chaos
+# ---------------------------------------------------------------------------
+
+
+def _trace_job(**trace):
+    return {"spec": {"scenario": {"source": {"trace": trace}}}}
+
+
+def test_job_submits_registered_trace_by_name(server, monkeypatch):
+    monkeypatch.setenv("KSIM_TRACES_DIR", "tests/fixtures/traces")
+    status, names = _req(server, "GET", "/api/v1/traces")
+    assert status == 200
+    assert "alibaba_batch_mini.csv" in names["items"]
+    status, job = _req(
+        server,
+        "POST",
+        "/api/v1/jobs",
+        _trace_job(name="alibaba_batch_mini.csv", format="alibaba", nodes=4,
+                   opsPerStep=8),
+    )
+    assert status == 202, job
+    body = _wait_state(server, job["id"], {"succeeded", "failed"})
+    assert body["state"] == "succeeded"
+    status, result = _req(server, "GET", f"/api/v1/jobs/{job['id']}/result")
+    assert status == 200
+    assert result["result"]["eventsApplied"] > 24  # nodes + creates + deletes
+
+
+def test_job_refuses_trace_paths_and_unregistered_names(server, monkeypatch):
+    monkeypatch.setenv("KSIM_TRACES_DIR", "tests/fixtures/traces")
+    status, body = _req(
+        server, "POST", "/api/v1/jobs",
+        _trace_job(path="/etc/passwd", format="borg"),
+    )
+    assert status == 400
+    assert "registered" in body["message"]
+    status, body = _req(
+        server, "POST", "/api/v1/jobs",
+        _trace_job(name="../../../etc/passwd", format="borg"),
+    )
+    assert status == 400
+    status, body = _req(
+        server, "POST", "/api/v1/jobs",
+        _trace_job(name="nope.jsonl", format="borg"),
+    )
+    assert status == 400
+    assert "no registered trace" in body["message"]
+
+
+def test_spec_armed_faults_degrade_the_submitting_job_alone(server):
+    """The chaos-native spec: a job arming its own jobs.run fault fails
+    by itself while a concurrently submitted clean job succeeds."""
+    chaotic = dict(tiny_spec(2))
+    chaotic["spec"] = dict(chaotic["spec"], faults={"jobs.run": "always"})
+    status, bad = _req(server, "POST", "/api/v1/jobs", chaotic)
+    assert status == 202
+    status, good = _req(server, "POST", "/api/v1/jobs", tiny_spec(2))
+    assert status == 202
+    bad_body = _wait_state(server, bad["id"], {"failed", "succeeded", "cancelled"})
+    good_body = _wait_state(server, good["id"], {"failed", "succeeded", "cancelled"})
+    assert bad_body["state"] == "failed"
+    assert "InjectedFault" in bad_body["error"]
+    assert good_body["state"] == "succeeded"
+
+
+def test_spec_faults_refuse_non_job_sites(server):
+    doc = dict(tiny_spec(1))
+    doc["spec"] = dict(doc["spec"], faults={"service.schedule": "always"})
+    status, body = _req(server, "POST", "/api/v1/jobs", doc)
+    assert status == 400
+    assert "job-plane site" in body["message"]
+
+
+def test_malformed_jobs_faults_schedule_fails_at_construction():
+    """An operator typo in a KSIM_JOBS_FAULTS SCHEDULE raises at
+    JobManager construction (fail-fast), never later as a tenant-blamed
+    400 with the chaos silently unarmed."""
+    with pytest.raises(ValueError):
+        JobManager(workers=0, queue_limit=2, fault_spec="0:jobs.run=bogus")
+
+
+def test_spec_faults_schedule_smuggling_refused_over_http(server):
+    doc = dict(tiny_spec(1))
+    doc["spec"] = dict(
+        doc["spec"], faults={"replay.dispatch": "always;service.schedule=always"}
+    )
+    status, body = _req(server, "POST", "/api/v1/jobs", doc)
+    assert status == 400
+    assert "one schedule per site" in body["message"]

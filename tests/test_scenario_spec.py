@@ -151,3 +151,109 @@ def test_scheduler_simulation_failure_phase():
     })
     assert out["status"]["phase"] == "Failed"
     assert "NotFound" in out["status"]["message"]
+
+
+# ---------------------------------------------------------------------------
+# Round 14: sourced scenarios (source.trace) + the spec faults section
+# ---------------------------------------------------------------------------
+
+
+def _trace_source_doc(**trace):
+    return {"spec": {"source": {"trace": trace}}}
+
+
+def test_source_trace_compiles_operations(monkeypatch):
+    monkeypatch.setenv("KSIM_TRACES_DIR", "tests/fixtures/traces")
+    ops = operations_from_spec(
+        _trace_source_doc(
+            name="borg_mini.jsonl", format="borg", nodes=8, opsPerStep=4
+        )
+    )
+    assert sum(1 for o in ops if o.kind == "nodes" and o.op == "create") == 8
+    assert all(o.op in ("create", "delete") for o in ops)
+    # Same doc -> same stream (the determinism guarantee the behavior
+    # locks ride on).
+    again = operations_from_spec(
+        _trace_source_doc(
+            name="borg_mini.jsonl", format="borg", nodes=8, opsPerStep=4
+        )
+    )
+    assert ops == again
+
+
+def test_source_trace_path_resolver_for_library_use():
+    ops = operations_from_spec(
+        _trace_source_doc(
+            path="tests/fixtures/traces/alibaba_batch_mini.csv",
+            format="alibaba",
+            nodes=4,
+        )
+    )
+    assert sum(1 for o in ops if o.kind == "pods" and o.op == "create") == 24
+
+
+def test_source_trace_refusals(monkeypatch):
+    monkeypatch.delenv("KSIM_TRACES_DIR", raising=False)
+    with pytest.raises(ScenarioSpecError, match="no trace registry"):
+        operations_from_spec(_trace_source_doc(name="x.jsonl", format="borg"))
+    with pytest.raises(ScenarioSpecError, match="format"):
+        operations_from_spec(_trace_source_doc(name="x.jsonl", format="nope"))
+    with pytest.raises(ScenarioSpecError, match="needs a name"):
+        operations_from_spec(_trace_source_doc(format="borg"))
+    with pytest.raises(ScenarioSpecError, match="exactly one"):
+        operations_from_spec(
+            {"spec": {"operations": [], "source": {"trace": {"format": "borg"}}}}
+        )
+    with pytest.raises(ScenarioSpecError, match="exactly one key"):
+        operations_from_spec({"spec": {"source": {"bogus": {}}}})
+    with pytest.raises(ScenarioSpecError, match="must be integers"):
+        operations_from_spec(
+            _trace_source_doc(name="x.jsonl", format="borg", nodes="many")
+        )
+
+
+def test_faults_spec_from_doc_canonicalizes():
+    from ksim_tpu.scenario import faults_spec_from_doc
+
+    assert faults_spec_from_doc({"spec": {}}) == ""
+    spec = faults_spec_from_doc(
+        {
+            "spec": {
+                "faults": {
+                    "replay.dispatch": "call:2@device",
+                    "jobs.run": "first:1",
+                }
+            }
+        }
+    )
+    # Sorted, comma-joined -> exactly the KSIM_FAULTS grammar.
+    assert spec == "jobs.run=first:1,replay.dispatch=call:2@device"
+    from ksim_tpu.faults import FaultPlane
+
+    plane = FaultPlane()
+    plane.configure(spec)  # the canonical string parses as-is
+
+
+def test_faults_spec_from_doc_refusals():
+    from ksim_tpu.scenario import faults_spec_from_doc
+
+    for bad in (
+        {"spec": {"faults": ["replay.dispatch=always"]}},  # list, not mapping
+        {"spec": {"faults": {"replay.dispatch": 3}}},
+        {"spec": {"faults": {"": "always"}}},
+    ):
+        with pytest.raises(ScenarioSpecError, match="spec.faults"):
+            faults_spec_from_doc(bad)
+    with pytest.raises(ScenarioSpecError, match="malformed"):
+        faults_spec_from_doc({"spec": {"faults": {"a=b": "always"}}})
+
+
+def test_faults_spec_schedule_cannot_smuggle_sites():
+    """A schedule value embedding ';'/',' would re-split inside
+    FaultPlane.configure into EXTRA site=schedule entries, bypassing a
+    caller's site allowlist — refused at the spec surface."""
+    from ksim_tpu.scenario import faults_spec_from_doc
+
+    for sched in ("always;service.schedule=always", "always,jobs.run=first:1"):
+        with pytest.raises(ScenarioSpecError, match="one schedule per site"):
+            faults_spec_from_doc({"spec": {"faults": {"replay.dispatch": sched}}})

@@ -1,0 +1,440 @@
+"""Trace ingestion plane (ksim_tpu/traces): parsers, resampling,
+compilation, the name registry, and the scenario-spec wiring.
+
+Golden expectations here are HAND-DERIVED from the documented format
+subsets (fixture rule, repo CLAUDE.md): e.g. a Borg ``cpus`` of 0.05
+against the 16-core reference machine is 800 millicores BY ARITHMETIC,
+never by running the parser and copying its output.  The replay-side
+behavior lock for the bundled fixture lives in
+tests/test_behavior_locks.py.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+
+import pytest
+
+from ksim_tpu.traces import (
+    PRIORITY_LADDER,
+    TraceError,
+    TraceParseError,
+    TraceRecord,
+    compile_trace,
+    parse_alibaba,
+    parse_borg,
+    resample,
+)
+from ksim_tpu.traces.registry import list_traces, open_trace_lines, resolve
+
+FIXTURES = "tests/fixtures/traces"
+
+
+# ---------------------------------------------------------------------------
+# Borg parser goldens (hand-derived from the documented subset)
+# ---------------------------------------------------------------------------
+
+
+def _borg_line(time_us, etype, cid, idx, prio=None, cpus=None, mem=None):
+    o = {"time": time_us, "type": etype, "collection_id": cid, "instance_index": idx}
+    if prio is not None:
+        o["priority"] = prio
+    if cpus is not None:
+        o["resource_request"] = {"cpus": cpus, "memory": mem}
+    return json.dumps(o)
+
+
+def test_borg_golden_submit_finish_pair():
+    lines = [
+        _borg_line(2_000_000, 0, 7, 3, prio=200, cpus=0.05, mem=0.02),
+        _borg_line(9_500_000, 6, 7, 3),  # FINISH
+    ]
+    (rec,) = list(parse_borg(lines))
+    # Hand-derived: 0.05 * 16000 = 800 m; 0.02 * 65536 = 1310.72 -> 1311;
+    # arrival 2.0 s; lifetime 9.5 - 2.0 = 7.5 s; priority 200 is the
+    # production band -> tier 3 -> kind "service".
+    assert rec == TraceRecord(
+        name="c7-i3",
+        arrival_s=2.0,
+        cpu_milli=800,
+        mem_mib=1311,
+        lifetime_s=7.5,
+        tier=3,
+        priority=200,
+        kind="service",
+    )
+
+
+def test_borg_tier_bands_and_string_types():
+    """The published 0..450 bands map to tiers 0..4; type names and
+    numbers are interchangeable."""
+    lines = [
+        _borg_line(0, "SUBMIT", 1, 0, prio=0),
+        _borg_line(0, "SUBMIT", 1, 1, prio=103),
+        _borg_line(0, "SUBMIT", 1, 2, prio=117),
+        _borg_line(0, "SUBMIT", 1, 3, prio=200),
+        _borg_line(0, "SUBMIT", 1, 4, prio=450),
+    ]
+    recs = {r.name: r for r in parse_borg(lines)}
+    assert [recs[f"c1-i{i}"].tier for i in range(5)] == [0, 1, 2, 3, 4]
+    assert recs["c1-i0"].kind == "batch" and recs["c1-i4"].kind == "service"
+    # Missing resource_request parses as a zero request, not an error.
+    assert recs["c1-i0"].cpu_milli == 0 and recs["c1-i0"].mem_mib == 0
+
+
+def test_borg_lifecycle_noise_and_unmatched_terminals_ignored():
+    lines = [
+        _borg_line(1_000_000, "SUBMIT", 1, 0, prio=0),
+        _borg_line(1_100_000, "QUEUE", 1, 0),
+        _borg_line(1_200_000, "SCHEDULE", 1, 0),
+        _borg_line(1_300_000, "FINISH", 9, 9),  # never submitted: ignored
+        _borg_line(1_400_000, "SUBMIT", 1, 0, prio=0),  # duplicate live submit
+        _borg_line(2_000_000, "KILL", 1, 0),
+    ]
+    (rec,) = list(parse_borg(lines))
+    assert rec.name == "c1-i0" and rec.lifetime_s == 1.0
+
+
+def test_borg_resubmit_opens_distinct_record():
+    """A SUBMIT after a terminal is a NEW workload item with a distinct
+    name (simulator pod names must never be reused — replay contract)."""
+    lines = [
+        _borg_line(1_000_000, "SUBMIT", 3, 1, prio=100),
+        _borg_line(2_000_000, "EVICT", 3, 1),
+        _borg_line(3_000_000, "SUBMIT", 3, 1, prio=100),
+        _borg_line(5_000_000, "FINISH", 3, 1),
+    ]
+    recs = list(parse_borg(lines))
+    assert [(r.name, r.arrival_s, r.lifetime_s) for r in recs] == [
+        ("c3-i1", 1.0, 1.0),
+        ("c3-i1-r1", 3.0, 2.0),
+    ]
+
+
+def test_borg_live_at_eof_has_no_lifetime():
+    (rec,) = list(parse_borg([_borg_line(4_000_000, 0, 2, 0, prio=0)]))
+    assert rec.lifetime_s == 0.0
+
+
+def test_borg_malformed_rows_raise_with_line_numbers():
+    good = _borg_line(0, 0, 1, 0, prio=0)
+    with pytest.raises(TraceParseError, match="line 2: not valid JSON"):
+        list(parse_borg([good, "{broken"]))
+    with pytest.raises(TraceParseError, match="line 1: .*collection_id"):
+        list(parse_borg(['{"time": 1, "type": 0, "instance_index": 0}']))
+    with pytest.raises(TraceParseError, match="line 1: .*time"):
+        list(parse_borg(['{"type": 0, "collection_id": 1, "instance_index": 0}']))
+    with pytest.raises(TraceParseError, match="line 1"):
+        list(parse_borg(['["an", "array"]']))
+
+
+# ---------------------------------------------------------------------------
+# Alibaba parser goldens
+# ---------------------------------------------------------------------------
+
+
+def test_alibaba_batch_task_golden():
+    row = "M1,1,j_42,2,Terminated,100,160,300,2.5"
+    (rec,) = list(parse_alibaba([row]))
+    # Hand-derived: plan_cpu 300 centi-cores = 3000 m; plan_mem 2.5% of
+    # the 64-GiB reference = 0.025 * 65536 = 1638.4 -> 1638; lifetime
+    # 160 - 100 = 60 s; batch tier 1; task_type 2 kept as priority.
+    assert rec == TraceRecord(
+        name="j_42-M1",
+        arrival_s=100.0,
+        cpu_milli=3000,
+        mem_mib=1638,
+        lifetime_s=60.0,
+        tier=1,
+        priority=2,
+        kind="batch",
+    )
+
+
+def test_alibaba_batch_empty_end_time_means_no_delete():
+    (rec,) = list(parse_alibaba(["M1,1,j_1,1,Running,100,,100,0.8"]))
+    assert rec.lifetime_s == 0.0
+
+
+def test_alibaba_container_meta_golden_and_dedup():
+    rows = [
+        "c_1001,m_1,50,app_7,started,400,800,1.5625",
+        "c_1001,m_1,60,app_7,started,400,800,1.5625",  # update row: ignored
+        "c_1002,m_2,55,app_8,started,800,800,3.125",
+    ]
+    recs = list(parse_alibaba(rows))
+    # Hand-derived: cpu_request 400 centi-cores = 4000 m; mem_size
+    # 1.5625% of 65536 = 1024 MiB exactly; containers are service tier 3.
+    assert [(r.name, r.arrival_s, r.cpu_milli, r.mem_mib) for r in recs] == [
+        ("c_1001", 50.0, 4000, 1024),
+        ("c_1002", 55.0, 8000, 2048),
+    ]
+    assert all(r.kind == "service" and r.tier == 3 and r.lifetime_s == 0 for r in recs)
+
+
+def test_alibaba_malformed_rows_raise():
+    with pytest.raises(TraceParseError, match="line 1: unrecognized table shape"):
+        list(parse_alibaba(["a,b,c"]))
+    with pytest.raises(TraceParseError, match="line 2: expected 9 columns"):
+        list(parse_alibaba(["M1,1,j_1,1,T,100,160,300,2.5", "M2,1,j_1,1,T,100,160"]))
+    with pytest.raises(TraceParseError, match="non-numeric"):
+        list(parse_alibaba(["M1,1,j_1,1,T,abc,160,300,2.5"]))
+    with pytest.raises(TraceParseError, match="empty required"):
+        list(parse_alibaba(["M1,1,j_1,1,T,,160,300,2.5"]))
+
+
+# ---------------------------------------------------------------------------
+# IO: gz transparency, truncation, byte bound
+# ---------------------------------------------------------------------------
+
+
+def test_gz_input_parses_identically(tmp_path):
+    plain = tmp_path / "t.jsonl"
+    plain.write_text(
+        _borg_line(1_000_000, 0, 1, 0, prio=100, cpus=0.05, mem=0.02)
+        + "\n"
+        + _borg_line(2_000_000, 6, 1, 0)
+        + "\n"
+    )
+    # Deliberately NOT named .gz: detection is by magic bytes.
+    gz = tmp_path / "t.jsonl.data"
+    gz.write_bytes(gzip.compress(plain.read_bytes()))
+    assert list(parse_borg(str(plain))) == list(parse_borg(str(gz)))
+
+
+def test_truncated_gz_raises_trace_error(tmp_path):
+    payload = gzip.compress(
+        ("\n".join(_borg_line(i * 1_000_000, 0, 1, i, prio=0) for i in range(200))).encode()
+    )
+    trunc = tmp_path / "trunc.gz"
+    trunc.write_bytes(payload[: len(payload) // 2])
+    with pytest.raises(TraceError, match="corrupt trace"):
+        list(parse_borg(str(trunc)))
+
+
+def test_byte_bound_refuses_oversized_input(tmp_path):
+    big = tmp_path / "big.jsonl"
+    big.write_text("x" * 1024)
+    with pytest.raises(TraceError, match="exceeds the 100-byte bound"):
+        list(open_trace_lines(str(big), max_bytes=100))
+
+
+def test_byte_bound_from_environment(tmp_path, monkeypatch):
+    big = tmp_path / "big.jsonl"
+    big.write_text("y" * 2048)
+    monkeypatch.setenv("KSIM_TRACES_MAX_BYTES", "64")
+    with pytest.raises(TraceError, match="64-byte bound"):
+        list(open_trace_lines(str(big)))
+
+
+def test_missing_file_raises_trace_error():
+    with pytest.raises(TraceError, match="cannot read trace"):
+        list(parse_borg("/nonexistent/trace.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# Resample: determinism + distribution preservation
+# ---------------------------------------------------------------------------
+
+
+def _mk_records(n: int) -> list[TraceRecord]:
+    return [
+        TraceRecord(
+            name=f"t{i}",
+            arrival_s=float(i),
+            cpu_milli=100 * (1 + i % 4),
+            mem_mib=128,
+            lifetime_s=10.0 if i % 2 else 0.0,
+            tier=i % 5,
+            priority=i,
+        )
+        for i in range(n)
+    ]
+
+
+def test_resample_sorts_out_of_order_arrivals():
+    recs = _mk_records(10)[::-1]  # reversed arrival order (Borg yields at close)
+    out = resample(recs)
+    assert [r.arrival_s for r in out] == sorted(r.arrival_s for r in out)
+
+
+def test_resample_is_seed_deterministic():
+    recs = _mk_records(200)
+    a = resample(recs, seed=7, max_events=50)
+    b = resample(recs, seed=7, max_events=50)
+    c = resample(recs, seed=8, max_events=50)
+    assert a == b
+    assert a != c  # a different seed picks a different subset
+    assert sum(2 if r.lifetime_s > 0 else 1 for r in a) <= 50
+
+
+def test_resample_no_budget_keeps_everything():
+    recs = _mk_records(20)
+    assert len(resample(recs)) == 20
+    assert len(resample(recs, max_events=10_000)) == 20
+
+
+def test_resample_node_rescale_thins_proportionally():
+    recs = _mk_records(2000)
+    out = resample(recs, seed=0, target_nodes=100, source_nodes=1000)
+    # ~10% survive (uniform, independent draws): wide deterministic band.
+    assert 120 <= len(out) <= 280
+    # Uniform thinning preserves the tier mix (each tier is 20% +- noise).
+    from collections import Counter
+
+    tiers = Counter(r.tier for r in out)
+    for t in range(5):
+        assert tiers[t] / len(out) == pytest.approx(0.2, abs=0.08)
+
+
+def test_resample_rejects_bad_node_counts():
+    with pytest.raises(TraceError):
+        resample(_mk_records(4), target_nodes=0, source_nodes=10)
+
+
+# ---------------------------------------------------------------------------
+# Compile: vocabulary, grid, priority ladder
+# ---------------------------------------------------------------------------
+
+
+def test_compile_emits_only_replay_vocabulary():
+    ops = compile_trace(_mk_records(30), n_nodes=4, ops_per_step=5)
+    assert all(op.kind in ("nodes", "pods") for op in ops)
+    assert all(op.op in ("create", "delete") for op in ops)
+    nodes = [op for op in ops if op.kind == "nodes"]
+    assert len(nodes) == 4 and all(op.step == 0 for op in nodes)
+    # Steps are sorted and pod names unique.
+    assert [op.step for op in ops] == sorted(op.step for op in ops)
+    names = [op.obj["metadata"]["name"] for op in ops if op.kind == "pods" and op.op == "create"]
+    assert len(set(names)) == len(names)
+
+
+def test_compile_deletes_follow_creates_with_exact_quantities():
+    recs = [
+        TraceRecord(name="A_1", arrival_s=0.0, cpu_milli=750, mem_mib=300,
+                    lifetime_s=5.0, tier=2, priority=117),
+        TraceRecord(name="b", arrival_s=9.0, cpu_milli=100, mem_mib=64,
+                    lifetime_s=0.0, tier=0, priority=0),
+    ]
+    ops = compile_trace(recs, n_nodes=2, ops_per_step=1)
+    pods = [op for op in ops if op.kind == "pods"]
+    creates = [op for op in pods if op.op == "create"]
+    deletes = [op for op in pods if op.op == "delete"]
+    assert len(creates) == 2 and len(deletes) == 1  # b has no known lifetime
+    by_name = {op.obj["metadata"]["name"]: op for op in creates}
+    (a_name,) = [n for n in by_name if "a-1" in n]  # sanitized to k8s charset
+    a = by_name[a_name]
+    req = a.obj["spec"]["containers"][0]["resources"]["requests"]
+    assert req == {"cpu": "750m", "memory": "300Mi"}
+    assert a.obj["spec"]["priority"] == PRIORITY_LADDER[2]
+    (d,) = deletes
+    assert d.name == a_name and d.step >= a.step
+
+
+def test_compile_priority_ladder_per_tier():
+    recs = [
+        TraceRecord(name=f"t{t}", arrival_s=float(t), cpu_milli=100, mem_mib=64, tier=t)
+        for t in range(5)
+    ]
+    ops = compile_trace(recs, n_nodes=1, ops_per_step=1)
+    prios = [
+        op.obj["spec"]["priority"]
+        for op in ops
+        if op.kind == "pods" and op.op == "create"
+    ]
+    assert prios == list(PRIORITY_LADDER)
+
+
+def test_compile_grid_preserves_burstiness():
+    """A fixed tick, not a fixed batch: an arrival burst lands in few
+    steps, a quiet stretch spreads thin."""
+    recs = [
+        TraceRecord(name=f"q{i}", arrival_s=float(i * 10), cpu_milli=10, mem_mib=16)
+        for i in range(10)
+    ] + [
+        TraceRecord(name=f"b{i}", arrival_s=95.0, cpu_milli=10, mem_mib=16)
+        for i in range(10)
+    ]
+    ops = compile_trace(recs, n_nodes=1, ops_per_step=2)
+    from collections import Counter
+
+    per_step = Counter(op.step for op in ops if op.kind == "pods")
+    assert max(per_step.values()) >= 10  # the burst stayed a burst
+
+
+def test_compile_refusals():
+    with pytest.raises(TraceError, match="zero records"):
+        compile_trace([], n_nodes=4)
+    with pytest.raises(TraceError, match="n_nodes"):
+        compile_trace(_mk_records(3), n_nodes=0)
+    with pytest.raises(TraceError, match="ops_per_step"):
+        compile_trace(_mk_records(3), n_nodes=2, ops_per_step=0)
+
+
+# ---------------------------------------------------------------------------
+# Registry: allowlisted names only
+# ---------------------------------------------------------------------------
+
+
+def test_registry_resolves_names_in_traces_dir(tmp_path, monkeypatch):
+    (tmp_path / "mini.jsonl").write_text("")
+    (tmp_path / ".hidden").write_text("")
+    monkeypatch.setenv("KSIM_TRACES_DIR", str(tmp_path))
+    assert list_traces() == ["mini.jsonl"]
+    assert resolve("mini.jsonl") == str(tmp_path / "mini.jsonl")
+
+
+def test_registry_refuses_traversal_and_unknown(tmp_path, monkeypatch):
+    monkeypatch.setenv("KSIM_TRACES_DIR", str(tmp_path))
+    for bad in ("../etc/passwd", "a/b.jsonl", ".hidden", ""):
+        with pytest.raises(TraceError):
+            resolve(bad)
+    with pytest.raises(TraceError, match="no registered trace"):
+        resolve("missing.jsonl")
+
+
+def test_registry_unconfigured_refuses(monkeypatch):
+    monkeypatch.delenv("KSIM_TRACES_DIR", raising=False)
+    assert list_traces() == []
+    with pytest.raises(TraceError, match="no trace registry configured"):
+        resolve("anything.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# Bundled fixtures stay parseable (the replay lock lives in
+# tests/test_behavior_locks.py)
+# ---------------------------------------------------------------------------
+
+
+def test_bundled_borg_fixture_parses():
+    recs = list(parse_borg(f"{FIXTURES}/borg_mini.jsonl"))
+    assert len(recs) == 61  # 60 instances + 1 resubmit lifetime
+    assert {r.tier for r in recs} == {0, 1, 2, 3, 4}
+    names = [r.name for r in recs]
+    assert len(set(names)) == len(names)
+
+
+def test_bundled_alibaba_fixture_parses():
+    recs = list(parse_alibaba(f"{FIXTURES}/alibaba_batch_mini.csv"))
+    assert len(recs) == 24
+    assert all(r.kind == "batch" and r.tier == 1 for r in recs)
+    assert sum(1 for r in recs if r.lifetime_s > 0) == 22  # 2 Running rows
+
+
+def test_borg_malformed_priority_and_request_raise_parse_errors():
+    """Malformed priority/resource_request fields stay inside the
+    strict-with-line-number contract (a bare ValueError would escape
+    the TraceError -> HTTP 400 mapping at the spec/job surface)."""
+    with pytest.raises(TraceParseError, match="line 1: non-numeric priority"):
+        list(parse_borg([
+            '{"time": 0, "type": 0, "collection_id": 1, "instance_index": 0, "priority": "high"}'
+        ]))
+    with pytest.raises(TraceParseError, match="line 1: resource_request must be an object"):
+        list(parse_borg([
+            '{"time": 0, "type": 0, "collection_id": 1, "instance_index": 0, "resource_request": "0.5"}'
+        ]))
+    with pytest.raises(TraceParseError, match="line 1: non-numeric"):
+        list(parse_borg([
+            '{"time": 0, "type": 0, "collection_id": 1, "instance_index": 0, "resource_request": {"cpus": "lots"}}'
+        ]))

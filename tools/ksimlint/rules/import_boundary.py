@@ -60,6 +60,11 @@ DEFAULT_BOUNDARIES: tuple[Boundary, ...] = (
     Boundary("ksim_tpu/obs.py", _ACCEL, "import-time"),
     Boundary("ksim_tpu/faults.py", _ACCEL, "import-time"),
     Boundary("ksim_tpu/errors.py", _ACCEL, "import-time"),
+    # The trace ingestion plane: parsers/registry/resample must stay
+    # stdlib-only at import time (they configure and fail cleanly in
+    # jax-free processes — the bench parent, the HTTP surface); jax may
+    # enter only through the compile path's function-scope imports.
+    Boundary("ksim_tpu/traces", _ACCEL, "import-time"),
 )
 
 
