@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs
+.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs restart-check
 
 test:
 	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
@@ -15,12 +15,15 @@ test:
 # window events, not universe size) — and the FLEET parity lock (round
 # 12): 8 lanes x 6k events through the vmapped fleet path, every lane
 # byte-identical to 2524/471 with the shared universe lowered once per
-# window (counter-based guard).  ~15-25 min on CPU.
+# window (counter-based guard).  ~15-25 min on CPU.  Round 15 adds the
+# CHAOS leg: the locked 6k prefix with injected device-dispatch faults
+# mid-stream — the breaker trips, the half-open probe recovers the
+# device path, and the 2524/471 counts still hold byte-identically.
 # The analyzer gates the lock run: a lock/kernel/registry contract
 # violation is exactly the class of bug the 50k stepwise run exists to
 # catch, and lint finds it in seconds instead of minutes.
 lock-check: lint
-	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_device_vs_per_pass tests/test_behavior_locks.py::test_churn_fleet_lock_6k_lanes8 -q -rs -m slow
+	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_device_vs_per_pass tests/test_behavior_locks.py::test_churn_fleet_lock_6k_lanes8 tests/test_behavior_locks.py::test_churn_lock_6k_holds_under_dispatch_faults_with_recovery -q -rs -m slow
 
 # The fault suite (docs/faults.md) on CPU in the sanitized environment
 # (tests/helpers.sanitized_cpu_env drops the axon sitecustomize that
@@ -35,6 +38,7 @@ faults:
 	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
 	'tests/test_replay_faults.py', 'tests/test_fault_injection.py', \
 	'tests/test_replay_cache.py', 'tests/test_jobs.py', \
+	'tests/test_jobs_durability.py', \
 	'-q', '-m', ''], env=sanitized_cpu_env({'KSIM_STORE_STRICT': '1'})))"
 
 # The job-plane suite (docs/jobs.md) on CPU in the sanitized env, slow
@@ -46,6 +50,17 @@ jobs:
 	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
 	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
 	'tests/test_jobs.py', '-q', '-m', ''], env=sanitized_cpu_env()))"
+
+# Crash-recovery verification (docs/jobs.md "Durability & recovery"):
+# the journal/AOT-cache unit matrix (torn tails, corrupt CRCs, corrupt
+# serialized executables — all hand-written bad bytes), manager replay
+# on restart, the SSE aborted-reader leak regression, and the slow
+# SIGKILL-mid-job-then-restart end-to-end (-m '' includes it).  Runs in
+# the sanitized CPU env so it works under ANY hardware condition.
+restart-check:
+	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
+	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
+	'tests/test_jobs_durability.py', '-q', '-m', ''], env=sanitized_cpu_env()))"
 
 # Trace-plane validation (docs/observability.md): the locked 6k prefix
 # through the device path with KSIM_TRACE_OUT set, in the sanitized CPU

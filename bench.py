@@ -47,6 +47,7 @@ import argparse
 import atexit
 import json
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -554,6 +555,86 @@ def child_churn_jobs(
     return out
 
 
+def child_churn_restart(seed: int, n_nodes: int, n_events: int) -> dict:
+    """Warm-restart rung (round 15, engine/compilecache.py disk layer):
+    one device churn replay in THIS fresh process, with
+    time-to-first-scheduled-pod measured by a store watcher thread.
+    The parent runs this child TWICE against one shared state dir
+    (``KSIM_AOT_CACHE`` + ``KSIM_COMPILE_CACHE`` pointed into it, so
+    the machine-wide cache never contaminates the comparison): the
+    first run is the cold start (every executable compiles, then
+    persists), the second IS the warm restart — its record must carry
+    ``compile_cache.disk_hits > 0`` and a smaller first-scheduled
+    wall."""
+    import threading
+
+    import jax
+
+    from ksim_tpu.engine.compilecache import COMPILE_CACHE
+    from ksim_tpu.scenario import ScenarioRunner, churn_scenario
+
+    _child_setup()
+    jax.config.update("jax_enable_x64", False)
+    runner = ScenarioRunner(
+        max_pods_per_pass=1024,
+        pod_bucket_min=128,
+        device_replay=True,
+        preemption=True,
+    )
+    # Time-to-first-scheduled-pod: churn pods are created unbound and
+    # only a scheduler bind gives one a nodeName, so the first non-empty
+    # pods_with_node() IS the first placement.  The store is internally
+    # locked; polling from a side thread never perturbs the replay.
+    first_sched: "list[float | None]" = [None]
+    stop = threading.Event()
+    t0 = time.perf_counter()
+
+    def _watch_first_bind() -> None:
+        while not stop.is_set():
+            if runner.store.pods_with_node():
+                first_sched[0] = round(time.perf_counter() - t0, 3)
+                return
+            time.sleep(0.005)
+
+    watcher = threading.Thread(
+        target=_watch_first_bind, name="restart-first-sched", daemon=True
+    )
+    watcher.start()
+    res = runner.run(
+        churn_scenario(seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100)
+    )
+    stop.set()
+    watcher.join(timeout=1)
+    cc = COMPILE_CACHE.snapshot()
+    drv = runner.replay_driver
+    out = {
+        "events": res.events_applied,
+        "nodes": n_nodes,
+        "wall_s": round(res.wall_seconds, 2),
+        "first_scheduled_s": first_sched[0],
+        "pods_scheduled": res.pods_scheduled,
+        "unschedulable_attempts": res.unschedulable_attempts,
+        "device_steps": drv.device_steps if drv else None,
+        "fallback_steps": drv.fallback_steps if drv else None,
+        "compile_cache": {
+            k: cc[k]
+            for k in (
+                "hits", "misses",
+                "disk_hits", "disk_misses", "disk_stores", "disk_evictions",
+            )
+        },
+        "platform": jax.devices()[0].platform,
+    }
+    print(
+        f"[churn_restart {n_events}ev/{n_nodes}n] {res.wall_seconds:.1f}s "
+        f"first_sched {first_sched[0]}s "
+        f"disk_hits={cc['disk_hits']} disk_stores={cc['disk_stores']}",
+        file=sys.stderr,
+        flush=True,
+    )
+    return out
+
+
 def child_churn_trace(
     trace_file: str, fmt: str, nodes: int, ops_per_step: int, max_events: int
 ) -> dict:
@@ -685,6 +766,12 @@ def _child_main(args: argparse.Namespace) -> None:
                 args.churn_events,
                 args.jobs_count,
                 args.jobs_workers,
+            )
+        elif args.child == "churn_restart":
+            out = child_churn_restart(
+                args.seed,
+                args.churn_nodes,
+                args.churn_events,
             )
         elif args.child == "churn_trace":
             out = child_churn_trace(
@@ -915,6 +1002,11 @@ def main() -> None:
         default=os.path.join(_REPO, "tests", "fixtures", "traces", "borg_mini.jsonl"),
     )
     ap.add_argument("--trace-format", type=str, default="borg")
+    # Warm-restart rung shape: small on purpose — the rung's claim is
+    # about compile-persistence recovery, not stream length, and the
+    # child runs twice.
+    ap.add_argument("--restart-events", type=int, default=1_000)
+    ap.add_argument("--restart-nodes", type=int, default=500)
     ap.add_argument("--trace-nodes", type=int, default=24)
     ap.add_argument("--trace-ops-per-step", type=int, default=2)
     ap.add_argument("--trace-max-events", type=int, default=0)
@@ -931,7 +1023,10 @@ def main() -> None:
     # Internal: subprocess payload modes.
     ap.add_argument(
         "--child",
-        choices=["probe", "rung", "churn", "churn_fleet", "churn_jobs", "churn_trace"],
+        choices=[
+            "probe", "rung", "churn", "churn_fleet", "churn_jobs",
+            "churn_trace", "churn_restart",
+        ],
         default=None,
     )
     ap.add_argument("--pods", type=int, default=0)
@@ -1274,6 +1369,58 @@ def main() -> None:
             mode="churn_trace",
         )
 
+    def run_churn_restart_stage() -> None:
+        """Warm-restart rung (round 15): the SAME restart child twice
+        over one shared persistent-executable dir — cold (empty dir:
+        every program compiles and persists) then warm (a FRESH process
+        that load-or-compiles from disk).  The record carries both
+        walls, both time-to-first-scheduled-pod marks, the warm child's
+        compile_cache disk hits/misses, and the derived speedups — the
+        restart-recovery claim (docs/jobs.md "Durability & recovery")
+        as bench evidence.  The state dir is a throwaway temp dir:
+        hermetic from the machine-wide jax cache in both directions."""
+        if args.skip_churn or args.only:
+            return
+        if orch.remaining() < 120:
+            payload["rungs"]["churn_restart"] = {"error": "skipped: budget exhausted"}
+            return
+        state_dir = tempfile.mkdtemp(prefix="bench_restart_")
+        renv = dict(env)
+        renv["KSIM_AOT_CACHE"] = os.path.join(state_dir, "aot")
+        renv["KSIM_COMPILE_CACHE"] = os.path.join(state_dir, "xla")
+        extra = [
+            "--seed", str(args.seed),
+            "--churn-events", str(args.restart_events),
+            "--churn-nodes", str(args.restart_nodes),
+        ]
+        try:
+            cold = orch.run_child("churn_restart", extra, renv, CHURN_EXACT_TIMEOUT)
+            record: dict = {"cold": cold}
+            if "error" not in cold and orch.remaining() > 30:
+                warm = orch.run_child(
+                    "churn_restart", extra, renv, CHURN_EXACT_TIMEOUT
+                )
+                record["warm"] = warm
+                if "error" not in warm:
+                    cw, ww = cold.get("wall_s"), warm.get("wall_s")
+                    if cw and ww:
+                        record["warm_speedup"] = round(cw / ww, 2)
+                    cf = cold.get("first_scheduled_s")
+                    wf = warm.get("first_scheduled_s")
+                    if cf and wf:
+                        record["first_scheduled_speedup"] = round(cf / wf, 2)
+                    record["counts_match"] = (
+                        cold.get("pods_scheduled"),
+                        cold.get("unschedulable_attempts"),
+                    ) == (
+                        warm.get("pods_scheduled"),
+                        warm.get("unschedulable_attempts"),
+                    )
+            payload["rungs"]["churn_restart"] = record
+        finally:
+            shutil.rmtree(state_dir, ignore_errors=True)
+        orch.flush_partial()
+
     def run_churn_exact_stage() -> None:
         """Bounded exact-mode (x64) churn: demonstrates in the driver
         record that the replay counts are mode- and platform-identical
@@ -1316,6 +1463,7 @@ def main() -> None:
     run_churn_fleet_stage()
     run_churn_jobs_stage()
     run_churn_trace_stage()
+    run_churn_restart_stage()
     run_churn_exact_stage()
     if fallback:
         # The north-star shape still gets a measured record on CPU: the

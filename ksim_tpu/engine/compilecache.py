@@ -21,15 +21,37 @@ the bucketed shape ladder + profile token that
   removes its entry (``aborts``) so the next caller retries as leader
   rather than deadlocking behind a tombstone.
 
-The module is stdlib-only: callers (engine/replay.py ``_device_exec``)
-build the key from hashable statics + the input trees' dtype/shape
-signature, so nothing here ever imports jax.
+Round 15 adds the ON-DISK layer (ISSUE 11 "persistent executables"):
+``run`` takes an optional ``disk`` spec — a duck-typed handle the
+CALLER builds (engine/replay.py ``_aot_disk_spec``) carrying the entry
+``path``, a stable identity ``token`` (shape-ladder rung + profile
+token + jaxlib version + backend), and ``load``/``invoke``/
+``serialize`` callables.  A leader first tries load-from-disk (a
+deserialized ``jax.export`` executable skips XLA compilation
+entirely); corrupt, version-mismatched or un-invokable entries are
+unlinked and counted with a ``compilecache.evict`` trace event, then
+the leader falls back to compiling and best-effort persists the fresh
+executable (atomic tmp+rename).  Followers reuse the leader's
+deserialized executable — after a disk hit jax's jit cache was never
+warmed, so dispatching ``fn`` again would re-pay the compile the disk
+hit just skipped.
+
+The module stays stdlib-only (json/os/zlib): all jax calls live inside
+the caller's ``disk`` callables, so nothing here ever imports jax.
 """
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 import threading
+import zlib
 from typing import Any, Callable
+
+from ksim_tpu.obs import TRACE, register_provider
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["CompileCache", "COMPILE_CACHE"]
 
@@ -46,12 +68,16 @@ class _Entry:
     evidence.  Mutated only under the owning cache's lock (the ready
     Event is the one cross-thread signal and is safe bare)."""
 
-    __slots__ = ("ready", "hits", "owners")
+    __slots__ = ("ready", "hits", "owners", "exec_obj")
 
     def __init__(self) -> None:
         self.ready = threading.Event()
         self.hits = 0
         self.owners: set = set()
+        # The leader's disk-loaded executable (None when the leader
+        # compiled via fn — jax's jit cache is warm then and followers
+        # dispatch fn directly).
+        self.exec_obj: Any = None
 
 
 class CompileCache:
@@ -64,6 +90,10 @@ class CompileCache:
         self.misses = 0  # guarded-by: _lock
         self.waits = 0  # guarded-by: _lock (followers that blocked on a leader)
         self.aborts = 0  # guarded-by: _lock (leader dispatches that raised)
+        self.disk_hits = 0  # guarded-by: _lock (leaders warm-started from disk)
+        self.disk_misses = 0  # guarded-by: _lock (leaders that found no entry)
+        self.disk_stores = 0  # guarded-by: _lock (fresh executables persisted)
+        self.disk_evictions = 0  # guarded-by: _lock (corrupt/mismatched unlinks)
 
     def run(
         self,
@@ -72,6 +102,7 @@ class CompileCache:
         *,
         owner: "str | None" = None,
         wait_s: float = _WAIT_DEFAULT_S,
+        disk: Any = None,
     ) -> Any:
         """Run ``fn`` (the jitted dispatch) under the compile-once gate.
 
@@ -81,7 +112,19 @@ class CompileCache:
         in flight it waits (up to ``wait_s``) before dispatching, so a
         rung is compiled once no matter how many tenants race onto it.
         A leader that raises removes the entry and re-raises — the next
-        caller becomes the new leader (counted in ``aborts``)."""
+        caller becomes the new leader (counted in ``aborts``).
+
+        ``disk`` (optional) is the persistent layer's handle for this
+        key: ``.path`` (entry file), ``.token`` (the stable identity
+        string the header must match), ``.load(blob) -> exec_obj``,
+        ``.invoke(exec_obj) -> result`` and ``.serialize() -> bytes |
+        None``.  A leader tries disk first (warm restart: no compile);
+        any corruption, token mismatch, failed deserialize or failed
+        invoke evicts the entry (``compilecache.evict``) and degrades
+        to the compile path, after which the fresh executable is
+        persisted best-effort.  Followers behind a disk-hit leader
+        reuse its deserialized executable — ``fn`` would re-compile,
+        jax's jit cache was never warmed on that path."""
         with self._lock:
             ent = self._entries.get(key)
             if ent is None:
@@ -98,6 +141,21 @@ class CompileCache:
                 leader = False
             ready = ent.ready
         if leader:
+            if disk is not None:
+                exec_obj = self._disk_load(disk)
+                if exec_obj is not None:
+                    try:
+                        out = disk.invoke(exec_obj)
+                    except Exception:
+                        # Deserialized fine but will not run (e.g. a
+                        # platform the blob was not exported for):
+                        # evict and fall through to the compile path.
+                        self._evict(disk, "exec_failed")
+                    else:
+                        with self._lock:
+                            ent.exec_obj = exec_obj
+                        ready.set()
+                        return out
             try:
                 out = fn()
             except BaseException:
@@ -111,12 +169,94 @@ class CompileCache:
                 ready.set()
                 raise
             ready.set()
+            if disk is not None:
+                self._disk_store(disk)
             return out
         if not ready.is_set():
             with self._lock:
                 self.waits += 1
             ready.wait(wait_s)
+        if disk is not None:
+            with self._lock:
+                live = self._entries.get(key)
+                exec_obj = live.exec_obj if live is not None else None
+            if exec_obj is not None:
+                return disk.invoke(exec_obj)
         return fn()
+
+    # -- the persistent layer (leader-only helpers) ----------------------
+
+    def _disk_load(self, disk: Any) -> Any:
+        """entry file -> deserialized executable, or None (miss /
+        evicted).  Validates the one-line JSON header (version, the
+        caller's identity token, blob CRC) before handing bytes to
+        ``disk.load`` — a stale jaxlib or a hash-colliding path must
+        never reach the deserializer."""
+        try:
+            with open(disk.path, "rb") as f:
+                header, sep, blob = f.read().partition(b"\n")
+        except OSError:
+            with self._lock:
+                self.disk_misses += 1
+            return None
+        try:
+            meta = json.loads(header)
+            crc = int(meta.get("crc", -1))
+            ok_shape = bool(sep) and meta.get("v") == 1
+        except (ValueError, TypeError):
+            self._evict(disk, "corrupt")
+            return None
+        if not ok_shape or (zlib.crc32(blob) & 0xFFFFFFFF) != crc:
+            self._evict(disk, "corrupt")
+            return None
+        if meta.get("key") != disk.token:
+            self._evict(disk, "key_mismatch")
+            return None
+        try:
+            exec_obj = disk.load(blob)
+        except Exception:
+            self._evict(disk, "deserialize_failed")
+            return None
+        with self._lock:
+            self.disk_hits += 1
+        return exec_obj
+
+    def _disk_store(self, disk: Any) -> None:
+        """Best-effort persist of the leader's fresh executable —
+        serialization or I/O failure costs only the NEXT process's
+        warm start, never this dispatch."""
+        try:
+            blob = disk.serialize()
+            if blob is None:
+                return  # the caller deemed this plan non-exportable
+            header = json.dumps({
+                "v": 1, "key": disk.token,
+                "crc": zlib.crc32(blob) & 0xFFFFFFFF,
+            }).encode()
+            os.makedirs(os.path.dirname(disk.path) or ".", exist_ok=True)
+            tmp = f"{disk.path}.tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(header + b"\n" + blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, disk.path)
+        except Exception:
+            logger.debug("compile cache: could not persist %s", disk.path,
+                         exc_info=True)
+            return
+        with self._lock:
+            self.disk_stores += 1
+
+    def _evict(self, disk: Any, reason: str) -> None:
+        """Unlink an unusable entry and count it — the evidence trail
+        behind the "discarded gracefully" contract."""
+        try:
+            os.unlink(disk.path)
+        except OSError:
+            pass
+        with self._lock:
+            self.disk_evictions += 1
+        TRACE.event("compilecache.evict", reason=reason, path=disk.path)
 
     def snapshot(self) -> dict:
         """JSON-ready evidence (the ``compile_cache`` section of
@@ -142,6 +282,10 @@ class CompileCache:
                 "misses": self.misses,
                 "waits": self.waits,
                 "aborts": self.aborts,
+                "disk_hits": self.disk_hits,
+                "disk_misses": self.disk_misses,
+                "disk_stores": self.disk_stores,
+                "disk_evictions": self.disk_evictions,
                 "rungs": rungs,
                 "shared_rungs": shared,
                 "shared_single_compile_rungs": shared_hot,
@@ -158,6 +302,10 @@ class CompileCache:
             self.misses = 0
             self.waits = 0
             self.aborts = 0
+            self.disk_hits = 0
+            self.disk_misses = 0
+            self.disk_stores = 0
+            self.disk_evictions = 0
 
 
 #: The process-wide cache every segment dispatch consults — one compile
@@ -169,6 +317,4 @@ COMPILE_CACHE = CompileCache()
 # that imports this module (the replay executor, the HTTP server)
 # serves the rung counters live.  obs is stdlib-only like this module,
 # and never imports back — no cycle.
-from ksim_tpu.obs import register_provider  # noqa: E402  (after the global)
-
 register_provider("compile_cache", COMPILE_CACHE.snapshot)

@@ -71,11 +71,12 @@ locks.
 from __future__ import annotations
 
 import bisect
+import hashlib
 import logging
 import os
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields, is_dataclass
 from functools import partial
 from typing import Any, Sequence
 
@@ -171,6 +172,21 @@ def _watchdog_seconds() -> float:
 
 def _breaker_threshold() -> int:
     return int(os.environ.get("KSIM_REPLAY_BREAKER_N", str(BREAKER_DEFAULT_N)))
+
+
+#: Half-open cooldown doubling is bounded here: a backend that stays
+#: dead costs one probe per hour at worst, never less often.
+_BREAKER_COOLDOWN_CAP_S = 3600.0
+
+
+def _breaker_cooldown_s() -> float:
+    """``KSIM_REPLAY_BREAKER_COOLDOWN_S``: 0 (the default) keeps the
+    round-8 STICKY breaker — openings only, the behavior every breaker
+    test pins; > 0 arms half-open recovery (ISSUE 11): after the
+    cooldown an open breaker admits ONE probe segment, a healthy probe
+    closes it (re-promoting the driver to the device path), a failed
+    probe re-opens with the cooldown doubled (bounded above)."""
+    return float(os.environ.get("KSIM_REPLAY_BREAKER_COOLDOWN_S", "0"))
 
 _I32_MIN = np.iinfo(np.int32).min
 _I32_MAX = np.iinfo(np.int32).max
@@ -1174,9 +1190,20 @@ class ReplayDriver:
         self.breaker_threshold = max(_breaker_threshold(), 1)
         self.device_errors = 0  # guarded-by: main-thread (degraded dispatches)
         self.watchdog_timeouts = 0  # guarded-by: main-thread (subset of above)
-        self.breaker_tripped = False  # guarded-by: main-thread (sticky)
+        # Sticky with the default cooldown of 0; with
+        # KSIM_REPLAY_BREAKER_COOLDOWN_S > 0 the half-open machinery
+        # below may close it again after a healthy probe segment.
+        self.breaker_tripped = False  # guarded-by: main-thread
         self._consecutive_device_errors = 0  # guarded-by: main-thread
         self._consecutive_reconcile_faults = 0  # guarded-by: main-thread
+        # Half-open recovery state (round 15, _breaker_cooldown_s).
+        self.breaker_cooldown_s = max(_breaker_cooldown_s(), 0.0)
+        self._breaker_cooldown_cur = self.breaker_cooldown_s  # guarded-by: main-thread
+        self._breaker_retry_at: "float | None" = None  # guarded-by: main-thread
+        self._breaker_probe = False  # guarded-by: main-thread
+        self.breaker_probes = 0  # guarded-by: main-thread
+        self.breaker_closes = 0  # guarded-by: main-thread
+        self.breaker_reopens = 0  # guarded-by: main-thread
         # Segment sequence number (trace-span correlation id: every
         # lower/dispatch/reconcile span of one window shares it).
         self._segment_seq = 0
@@ -1243,6 +1270,15 @@ class ReplayDriver:
             "device_errors": self.device_errors,
             "watchdog_timeouts": self.watchdog_timeouts,
             "breaker_tripped": self.breaker_tripped,
+            # Half-open recovery evidence: zeros (and cooldown_s 0)
+            # under the default sticky configuration.
+            "breaker": {
+                "cooldown_s": self.breaker_cooldown_s,
+                "cooldown_current_s": self._breaker_cooldown_cur,
+                "probes": self.breaker_probes,
+                "closes": self.breaker_closes,
+                "reopens": self.breaker_reopens,
+            },
             "unsupported": dict(self.unsupported),
             # Incremental-lowering evidence (round 10): the cache's
             # hit/miss/invalidation counters and the driver featurizer's
@@ -1610,6 +1646,14 @@ class ReplayDriver:
         """
         out = self._try_segment_impl(batches)
         if out is None:
+            # A probe admitted in prepare_segment that never reached a
+            # dispatch verdict (lowering fault / vocabulary miss) must
+            # not leave the half-open gate ajar: re-open, cooldown
+            # doubled — unbounded free re-probing would defeat the
+            # backoff.  (A probe that failed IN dispatch was already
+            # resolved by _note_device_error, which clears the flag.)
+            if self._breaker_probe:
+                self._breaker_reopen("probe lost before dispatch")
             self._flush_incremental("fallback")
         return out
 
@@ -1634,9 +1678,11 @@ class ReplayDriver:
         one lane — a check here too would both double-count the
         leader's schedule and land the injected fault inside the SHARED
         lowering, degrading the whole cohort."""
-        if self.breaker_tripped:
-            # Sticky: after the breaker opens, every window falls back
-            # immediately — no lowering work, no watchdog tax.
+        if self.breaker_tripped and not self._breaker_admit_probe():
+            # Open: every window falls back immediately — no lowering
+            # work, no watchdog tax.  Sticky under the default cooldown
+            # of 0; otherwise ONE probe segment per elapsed cooldown
+            # gets through the gate above.
             self._reject("breaker_open")
             return None
         if not self.service_supported():
@@ -1731,6 +1777,11 @@ class ReplayDriver:
         (where every lane's driver gets the reset but only the plan
         OWNER — the cohort leader — adopts the buffers, ``adopt``)."""
         self._consecutive_device_errors = 0
+        if self._breaker_probe:
+            # The half-open probe segment came back healthy: the
+            # backend recovered — close the breaker and re-promote the
+            # driver to the device path.
+            self._breaker_close()
         self.device_round_trips += 1
         if self._dev_cache_on is None:
             # Safe to probe now: the dispatch initialized the backend on
@@ -1809,6 +1860,12 @@ class ReplayDriver:
         self.device_errors += 1
         self._consecutive_device_errors += 1
         self._reject("device_error")
+        if self._breaker_probe:
+            # This WAS the half-open probe: the backend is still dead.
+            # Re-open with a doubled (bounded) cooldown; none of the
+            # trip logic below applies — the breaker never closed.
+            self._breaker_reopen(f"{type(e).__name__}: {e}")
+            return None
         if (
             not self.breaker_tripped
             and (
@@ -1817,6 +1874,7 @@ class ReplayDriver:
             )
         ):
             self.breaker_tripped = True
+            self._breaker_schedule_retry()
             TRACE.event(
                 "replay.breaker_open",
                 cause="device_error",
@@ -1841,6 +1899,80 @@ class ReplayDriver:
                 self._consecutive_device_errors, self.breaker_threshold,
             )
         return None
+
+    # -- breaker half-open recovery (round 15) ---------------------------
+    # All main-thread, like every other breaker field: probes are
+    # admitted in prepare_segment and resolved on the main thread after
+    # the dispatch joins — the worker never touches the gate.
+
+    def _breaker_schedule_retry(self) -> None:
+        """Arm the next probe window (no-op under the sticky default)."""
+        if self.breaker_cooldown_s > 0:
+            self._breaker_retry_at = time.monotonic() + self._breaker_cooldown_cur
+
+    def _breaker_admit_probe(self) -> bool:
+        """One probe segment per elapsed cooldown: True admits THIS
+        window through the open breaker as the probe.  False while the
+        cooldown runs, while a probe is already in flight, or under the
+        sticky default (cooldown 0)."""
+        if self.breaker_cooldown_s <= 0 or self._breaker_probe:
+            return False
+        if self._breaker_retry_at is None or time.monotonic() < self._breaker_retry_at:
+            return False
+        self._breaker_probe = True
+        self.breaker_probes += 1
+        TRACE.event(
+            "replay.breaker_probe",
+            cooldown_s=self._breaker_cooldown_cur,
+            probes=self.breaker_probes,
+            **self._span_tags,
+        )
+        logger.info(
+            "circuit breaker half-open: admitting one probe segment "
+            "(cooldown %.1fs elapsed)", self._breaker_cooldown_cur,
+        )
+        return True
+
+    def _breaker_close(self) -> None:
+        """A healthy probe: close the breaker, reset both consecutive
+        windows and the cooldown ladder — the driver is back on the
+        device path as if it never tripped."""
+        self._breaker_probe = False
+        self.breaker_tripped = False
+        self.breaker_closes += 1
+        self._consecutive_device_errors = 0
+        self._consecutive_reconcile_faults = 0
+        self._breaker_cooldown_cur = self.breaker_cooldown_s
+        self._breaker_retry_at = None
+        TRACE.event(
+            "replay.breaker_close",
+            closes=self.breaker_closes,
+            **self._span_tags,
+        )
+        logger.info(
+            "device replay circuit breaker CLOSED after a healthy probe "
+            "segment; device path re-promoted"
+        )
+
+    def _breaker_reopen(self, why: str) -> None:
+        """A failed (or lost) probe: stay open, double the cooldown
+        (bounded by _BREAKER_COOLDOWN_CAP_S) before the next probe."""
+        self._breaker_probe = False
+        self.breaker_reopens += 1
+        self._breaker_cooldown_cur = min(
+            self._breaker_cooldown_cur * 2.0, _BREAKER_COOLDOWN_CAP_S
+        )
+        self._breaker_retry_at = time.monotonic() + self._breaker_cooldown_cur
+        TRACE.event(
+            "replay.breaker_open",
+            cause="probe_failed",
+            cooldown_s=self._breaker_cooldown_cur,
+            **self._span_tags,
+        )
+        logger.warning(
+            "circuit breaker probe failed (%s); re-opened, next probe in "
+            "%.1fs", why, self._breaker_cooldown_cur,
+        )
 
     def _service_featurizer(self):
         """The canonical per-pass featurizer (created exactly as the
@@ -2605,6 +2737,11 @@ class ReplayDriver:
             ),
             owner=TRACE.scope_tags().get("job"),
             wait_s=self.watchdog_s if self.watchdog_s > 0 else 300.0,
+            # The persistent layer (round 15): a warm restart loads the
+            # serialized executable instead of re-compiling; None when
+            # KSIM_AOT_CACHE is off/unset (and no KSIM_JOBS_DIR) or the
+            # plan's identity is process-local.
+            disk=_aot_disk_spec("solo", plan, (const_dev, ev_dev, state_dev)),
         )
         pulled_state, pulled = _pull_tree_to_host(
             (
@@ -2870,6 +3007,7 @@ class ReplayDriver:
             and self._consecutive_reconcile_faults >= self.breaker_threshold
         ):
             self.breaker_tripped = True
+            self._breaker_schedule_retry()
             TRACE.event(
                 "replay.breaker_open",
                 cause="reconcile_fault",
@@ -2895,6 +3033,135 @@ def _compile_cache_key(kind: str, plan: "_SegmentPlan", dev_tree) -> tuple:
     leaves = jax.tree_util.tree_leaves(dev_tree)
     sig = tuple((str(a.dtype), tuple(a.shape)) for a in leaves)
     return (kind, plan.statics, plan.prog, bool(jax.config.jax_enable_x64), sig)
+
+
+# ---------------------------------------------------------------------------
+# Persistent executables (round 15): the compile cache's on-disk layer
+# ---------------------------------------------------------------------------
+
+
+def _aot_cache_dir() -> "str | None":
+    """Where serialized executables live: ``KSIM_AOT_CACHE`` (a path, or
+    ``off`` to disable), defaulting to ``$KSIM_JOBS_DIR/aot`` when the
+    durable job plane is on — a restarted server then warms from the
+    same directory its journal lives in.  None disables persistence
+    (the jax compilation cache wired in ksim_tpu/util.py:15 still
+    soft-warms XLA compiles underneath either way)."""
+    raw = os.environ.get("KSIM_AOT_CACHE", "")
+    if raw == "off":
+        return None
+    if raw:
+        return raw
+    jobs_dir = os.environ.get("KSIM_JOBS_DIR", "")
+    return os.path.join(jobs_dir, "aot") if jobs_dir else None
+
+
+def _aot_stable_token(obj) -> "str | None":
+    """A CROSS-PROCESS-deterministic rendering of jit-cache key
+    material, or None when the object's identity is process-local and
+    must not be persisted.  The in-memory key (``_compile_cache_key``)
+    leans on ``hash``/``repr`` semantics that do not survive a restart:
+    frozenset iteration order moves with hash randomization, and
+    ``_plugin_sig``'s ``("@id", id(plugin))`` fallback (engine/core.py)
+    is a memory address.  This canonicalizer sorts unordered
+    collections, recurses dataclasses field-by-field, admits only
+    scalar leaves — and refuses (None) anything else, so a plan whose
+    identity cannot be pinned simply skips the disk layer instead of
+    colliding in it."""
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return repr(obj)
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "@id":
+        return None  # process-local plugin identity
+    if isinstance(obj, (tuple, list)):
+        parts = []
+        for item in obj:
+            t = _aot_stable_token(item)
+            if t is None:
+                return None
+            parts.append(t)
+        return "(" + ",".join(parts) + ")"
+    if isinstance(obj, (frozenset, set)):
+        parts = []
+        for item in obj:
+            t = _aot_stable_token(item)
+            if t is None:
+                return None
+            parts.append(t)
+        return "{" + ",".join(sorted(parts)) + "}"
+    if is_dataclass(obj) and not isinstance(obj, type):
+        parts = [type(obj).__name__]
+        for f in fields(obj):
+            t = _aot_stable_token(getattr(obj, f.name))
+            if t is None:
+                return None
+            parts.append(f"{f.name}={t}")
+        return "<" + ";".join(parts) + ">"
+    return None
+
+
+class _AotDiskSpec:
+    """The compile cache's duck-typed disk handle for one solo segment
+    dispatch (engine/compilecache.py ``run(disk=...)``): entry path +
+    identity token + the three jax-touching callables.  Lives entirely
+    on the watchdogged worker thread and holds no driver reference —
+    kernel purity and the worker-thread write ban stay intact."""
+
+    __slots__ = ("path", "token", "_plan", "_args")
+
+    def __init__(self, path: str, token: str, plan, args) -> None:
+        self.path = path
+        self.token = token
+        self._plan = plan
+        self._args = args
+
+    def load(self, blob: bytes):
+        """Serialized entry -> a dispatchable callable.  ``jax.jit``
+        over the exported call keeps repeat dispatches on the fast
+        C++ path."""
+        from jax import export as jax_export
+
+        return jax.jit(jax_export.deserialize(blob).call)
+
+    def invoke(self, exec_obj):
+        return exec_obj(*self._args)
+
+    def serialize(self) -> "bytes | None":
+        """Export the freshly compiled program for the next process.
+        ``jax.export`` bakes the static argnums in at export time, so
+        the deserialized call takes only the dynamic operands."""
+        from jax import export as jax_export
+
+        ex = jax_export.export(_segment_fn)(
+            self._plan.statics, self._plan.prog, *self._args
+        )
+        return ex.serialize()
+
+
+def _aot_disk_spec(kind: str, plan: "_SegmentPlan", args) -> "_AotDiskSpec | None":
+    """Build the disk handle for one dispatch, or None when persistence
+    is off or the plan's identity is not stable across processes
+    (custom plugin objects without a static signature).  The token pins
+    everything a stale entry could differ in: jax/jaxlib version,
+    backend, program kind, statics, the profile signature, x64 mode and
+    the full dtype/shape ladder rung."""
+    base = _aot_cache_dir()
+    if base is None:
+        return None
+    body = _aot_stable_token((
+        kind,
+        plan.statics,
+        plan.prog._sig,
+        bool(jax.config.jax_enable_x64),
+        tuple(
+            (str(a.dtype), tuple(a.shape))
+            for a in jax.tree_util.tree_leaves(args)
+        ),
+    ))
+    if body is None:
+        return None
+    token = f"{jax.__version__}|{jax.default_backend()}|{body}"
+    name = hashlib.sha256(token.encode()).hexdigest()[:32] + ".aot"
+    return _AotDiskSpec(os.path.join(base, name), token, plan, args)
 
 
 def _plan_const_parts(plan: "_SegmentPlan"):

@@ -84,6 +84,8 @@ SITES: tuple[str, ...] = (
     "writeback.push",
     "kubeapi.request",
     "jobs.run",
+    "jobs.journal_append",
+    "jobs.journal_replay",
 )
 
 

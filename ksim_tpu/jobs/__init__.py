@@ -3,6 +3,7 @@
 See ksim_tpu/jobs/manager.py for the subsystem docstring and
 docs/jobs.md for the API, queue semantics and tenancy model."""
 
+from ksim_tpu.jobs.journal import JobJournal
 from ksim_tpu.jobs.manager import (
     JOB_FAULT_SITES,
     TERMINAL_STATES,
@@ -17,6 +18,7 @@ __all__ = [
     "JOB_FAULT_SITES",
     "TERMINAL_STATES",
     "Job",
+    "JobJournal",
     "JobLimitExceeded",
     "JobManager",
     "JobQueue",

@@ -1,0 +1,214 @@
+"""Crash-safe job journal: an append-only JSONL write-ahead log.
+
+ROADMAP service round 3 (e): the job registry was in-memory only — a
+server crash or restart silently forgot submitted specs, in-flight
+progress and finished results.  This module makes the job plane durable
+the way the reference's long-lived service is implicitly durable (it
+holds no tenant jobs at all): every submission (the full KEP-140-ish
+spec document), every state transition, every cancellation and every
+result document is one checksummed JSONL record appended (and flushed)
+to ``$KSIM_JOBS_DIR/jobs.journal.jsonl`` BEFORE the in-memory state
+machine observes the transition.  On startup ``JobManager`` replays the
+journal to reconstruct the registry (ksim_tpu/jobs/manager.py
+``_recover``).
+
+Record format — one line per record::
+
+    {"crc": <crc32 of the canonical rec JSON>, "rec": {...}}
+
+``rec`` is canonicalized (sorted keys, no whitespace) before the CRC so
+the checksum is stable under re-serialization.  ``rec["t"]`` is the
+record type:
+
+- ``submit``: id, ordinal, priority, created, and ``doc`` — the raw
+  submitted job document, verbatim;
+- ``state``: id, state, optional error, ts;
+- ``result``: id, and the full result document (served byte-identically
+  after a restart);
+- ``cancel``: id, ts (the cancel REQUEST; the resulting terminal state
+  arrives as its own ``state`` record).
+
+Recovery is torn-tail tolerant: a process killed mid-append leaves a
+partial (or checksum-failing) final line, and ``replay`` truncates the
+file at the last valid record instead of crashing — corruption can lose
+the torn tail, never the journal.  Compaction (``maybe_compact``)
+bounds the file: past ``KSIM_JOBS_JOURNAL_MAX_BYTES`` the live registry
+is rewritten as a snapshot (atomic tmp-file + fsync + rename), dropping
+records of jobs the retention policy already pruned.
+
+The module is stdlib-only and jax-free: recovery must work in a fresh
+process whose backend may be wedged (the whole point of restarting).
+Fault sites ``jobs.journal_append`` / ``jobs.journal_replay``
+(docs/faults.md) inject I/O errors here so ``make faults`` proves an
+append failure fails the ONE job, never poisons the registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from typing import Any, Callable, Iterable
+
+from ksim_tpu.faults import FAULTS
+from ksim_tpu.obs import TRACE
+
+__all__ = ["JobJournal", "JOURNAL_NAME"]
+
+JOURNAL_NAME = "jobs.journal.jsonl"
+
+#: Default compaction bound (bytes) — ``KSIM_JOBS_JOURNAL_MAX_BYTES``.
+_MAX_BYTES_DEFAULT = 16 * 1024 * 1024
+
+
+def _canon(rec: dict) -> str:
+    """The canonical JSON the checksum covers (stable under
+    re-serialization: sorted keys, no whitespace)."""
+    return json.dumps(rec, sort_keys=True, separators=(",", ":"))
+
+
+def _line(rec: dict) -> str:
+    body = _canon(rec)
+    crc = zlib.crc32(body.encode()) & 0xFFFFFFFF
+    return (
+        json.dumps({"crc": crc, "rec": json.loads(body)},
+                   sort_keys=True, separators=(",", ":"))
+        + "\n"
+    )
+
+
+def _decode_line(line: str) -> "dict | None":
+    """One journal line -> the validated rec, or None (torn/corrupt)."""
+    if not line.endswith("\n"):
+        return None  # torn tail: the append died mid-write
+    try:
+        wrapper = json.loads(line)
+        rec = wrapper["rec"]
+        crc = int(wrapper["crc"])
+    except (ValueError, KeyError, TypeError):
+        return None
+    if not isinstance(rec, dict):
+        return None
+    if zlib.crc32(_canon(rec).encode()) & 0xFFFFFFFF != crc:
+        return None
+    return rec
+
+
+class JobJournal:
+    """Append-only JSONL WAL for one JobManager's registry.
+
+    Thread-safe: appends from the submit path and every worker thread
+    serialize on ``_lock``.  Lock order: ``_lock`` is a LEAF — nothing
+    is called under it that takes a manager or job lock (the manager
+    calls ``maybe_compact`` with no locks held and passes a snapshot
+    callable that takes its own lock while ``_lock`` is free)."""
+
+    def __init__(self, path: str, *, max_bytes: "int | None" = None) -> None:
+        if max_bytes is None:
+            raw = os.environ.get("KSIM_JOBS_JOURNAL_MAX_BYTES", "")
+            max_bytes = int(raw) if raw else _MAX_BYTES_DEFAULT
+        self.path = path
+        self.max_bytes = max(int(max_bytes), 0)  # 0 = never compact
+        self._lock = threading.Lock()
+        self._size = 0  # guarded-by: _lock
+        self.appends = 0  # guarded-by: _lock
+        self.append_errors = 0  # guarded-by: _lock
+        self.compactions = 0  # guarded-by: _lock
+        self.truncated_bytes = 0  # guarded-by: _lock (torn-tail recovery)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    # -- append ----------------------------------------------------------
+
+    def append(self, rec: dict) -> None:
+        """Durably append one record (write + flush + fsync).  Raises on
+        I/O failure (including the armed ``jobs.journal_append`` fault)
+        — the CALLER owns the containment policy: fail the one job the
+        record belongs to, never the registry."""
+        line = _line(rec)
+        with TRACE.span("jobs.journal_append", type=rec.get("t")):
+            with self._lock:
+                try:
+                    FAULTS.check("jobs.journal_append")
+                    with open(self.path, "a", encoding="utf-8") as f:
+                        f.write(line)
+                        f.flush()
+                        os.fsync(f.fileno())
+                except BaseException:
+                    self.append_errors += 1
+                    raise
+                self._size += len(line)
+                self.appends += 1
+
+    # -- recovery --------------------------------------------------------
+
+    def replay(self) -> list[dict]:
+        """Read every valid record, truncating the file at the FIRST
+        invalid line (a torn tail from a mid-append crash, or garbage —
+        everything after it is unordered debris the WAL contract cannot
+        vouch for).  Never raises on corruption; I/O errors (including
+        the armed ``jobs.journal_replay`` fault) propagate to the
+        manager, which recovers what it can and never crashes startup."""
+        with TRACE.span("jobs.journal_replay"):
+            with self._lock:
+                FAULTS.check("jobs.journal_replay")
+                recs: list[dict] = []
+                good_end = 0
+                try:
+                    f = open(self.path, "r", encoding="utf-8", newline="")
+                except FileNotFoundError:
+                    return recs
+                with f:
+                    for line in f:
+                        rec = _decode_line(line)
+                        if rec is None:
+                            break
+                        recs.append(rec)
+                        good_end += len(line.encode())
+                    total = os.path.getsize(self.path)
+                if good_end < total:
+                    self.truncated_bytes = total - good_end
+                    with open(self.path, "a", encoding="utf-8") as tf:
+                        tf.truncate(good_end)
+                self._size = good_end
+                return recs
+
+    # -- compaction ------------------------------------------------------
+
+    def maybe_compact(self, snapshot_fn: Callable[[], Iterable[dict]]) -> bool:
+        """Rewrite the journal as a snapshot of the LIVE registry when
+        it outgrew ``max_bytes``.  ``snapshot_fn`` is called under the
+        journal lock and must not take it again (the manager's registry
+        lock is fine — see the class docstring's lock order).  Failures
+        are swallowed: compaction is an optimization, the oversized
+        journal stays fully valid."""
+        with self._lock:
+            if not self.max_bytes or self._size <= self.max_bytes:
+                return False
+            try:
+                lines = [_line(rec) for rec in snapshot_fn()]
+                tmp = f"{self.path}.tmp{os.getpid()}"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.writelines(lines)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+            except OSError:
+                return False
+            self._size = sum(len(ln) for ln in lines)
+            self.compactions += 1
+            return True
+
+    # -- evidence --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "path": self.path,
+                "size_bytes": self._size,
+                "max_bytes": self.max_bytes,
+                "appends": self.appends,
+                "append_errors": self.append_errors,
+                "compactions": self.compactions,
+                "truncated_bytes": self.truncated_bytes,
+            }

@@ -46,10 +46,21 @@ bounds-aware:
   fault plane, sites restricted to ``JOB_FAULT_SITES`` like the
   operator's ``KSIM_JOBS_FAULTS`` ordinals.
 
+Durability (ROADMAP round 15): when ``KSIM_JOBS_DIR`` is set, every
+submission, state transition, cancellation and result document is
+journaled through the crash-safe WAL in ksim_tpu/jobs/journal.py
+BEFORE the in-memory state machine observes it, and a restarted
+manager replays that journal to reconstruct the registry — completed
+results serve byte-identically, jobs that died mid-run surface as
+``interrupted`` (or re-enqueue under ``KSIM_JOBS_RESUME=1``).  Unset,
+the plane is exactly the in-memory-only plane of rounds 13–14.
+
 Environment (docs/env.md "Job plane"): ``KSIM_JOBS_WORKERS``,
 ``KSIM_JOBS_QUEUE``, ``KSIM_JOBS_RING``, ``KSIM_JOBS_KEEP``,
 ``KSIM_JOBS_EVENTS``, ``KSIM_JOBS_FAULTS``, ``KSIM_JOBS_MAX_EVENTS``,
-``KSIM_JOBS_MAX_NODES``, ``KSIM_JOBS_SJF_BYPASS``.
+``KSIM_JOBS_MAX_NODES``, ``KSIM_JOBS_SJF_BYPASS``; durability:
+``KSIM_JOBS_DIR``, ``KSIM_JOBS_RESUME``,
+``KSIM_JOBS_JOURNAL_MAX_BYTES``.
 """
 
 from __future__ import annotations
@@ -63,6 +74,7 @@ from typing import Any
 
 from ksim_tpu.errors import RunCancelled
 from ksim_tpu.faults import FAULTS, FaultPlane
+from ksim_tpu.jobs.journal import JOURNAL_NAME, JobJournal
 from ksim_tpu.jobs.queue import JobQueue, JobQueueFull
 from ksim_tpu.obs import TRACE, TracePlane
 
@@ -82,8 +94,11 @@ class JobLimitExceeded(Exception):
     (``KSIM_JOBS_MAX_EVENTS`` / ``KSIM_JOBS_MAX_NODES``) — HTTP 413
     upstream, with this message as the reason body."""
 
-#: Final job states (no transitions out).
-TERMINAL_STATES = frozenset({"succeeded", "failed", "cancelled"})
+#: Final job states (no transitions out).  ``interrupted`` is
+#: recovery-only: the journal saw the job queued/running when the
+#: process died (docs/jobs.md "Durability & recovery") — terminal
+#: unless ``KSIM_JOBS_RESUME=1`` re-enqueues it as a fresh run.
+TERMINAL_STATES = frozenset({"succeeded", "failed", "cancelled", "interrupted"})
 
 #: Sites a tenant-job private plane may arm.  The private plane is only
 #: CHECKED at these (jobs/manager.py + the runner/driver's lane-plane
@@ -282,6 +297,11 @@ class Job:
         self.steps_done = 0  # guarded-by: _cond
         self._events: list[dict] = []  # guarded-by: _cond
         self._dropped = 0  # guarded-by: _cond
+        self.sse_listeners = 0  # guarded-by: _cond
+        # The raw submitted document, kept ONLY once its submit record
+        # is durably journaled (compaction re-serializes it; None in
+        # the in-memory-only plane).
+        self.doc: Any = None
         # Diagnostics handles, set by the worker (the job's own store/
         # runner — tests assert cancel-rollback consistency through
         # them; None for queued jobs).
@@ -367,6 +387,36 @@ class Job:
                 ev["error"] = error
             self._emit_locked(ev, True)
 
+    def restore(
+        self,
+        state: str,
+        *,
+        error: "str | None" = None,
+        result: "dict | None" = None,
+        created: "float | None" = None,
+        started: "float | None" = None,
+        finished: "float | None" = None,
+        cancelled: bool = False,
+    ) -> None:
+        """Journal-recovery only (JobManager._recover): install the
+        reconstructed final state directly — the job never ran in THIS
+        process, so the queued→running→terminal machinery must not
+        fire (no worker owns it, no planes are scoped)."""
+        if cancelled:
+            self.cancel.set()
+        with self._cond:
+            self.state = state
+            self.error = error
+            self.result = result
+            if created:
+                self.created = float(created)
+            self.started = float(started) if started else None
+            self.finished = float(finished) if finished else time.time()
+            ev = {"event": "state", "state": state, "recovered": True}
+            if error:
+                ev["error"] = error
+            self._emit_locked(ev, True)
+
     def request_cancel(self) -> str:
         """Set the cancel flag; a QUEUED job finalizes immediately, a
         RUNNING one stops at the runner's next checkpoint (rolling back
@@ -378,6 +428,17 @@ class Job:
                 self.finished = time.time()
                 self._emit_locked({"event": "state", "state": "cancelled"}, True)
             return self.state
+
+    def sse_attach(self) -> None:
+        """One SSE reader subscribed (server/http.py pairs every attach
+        with a detach in a finally — the leak regression test counts
+        these through an aborted stream)."""
+        with self._cond:
+            self.sse_listeners += 1
+
+    def sse_detach(self) -> None:
+        with self._cond:
+            self.sse_listeners = max(self.sse_listeners - 1, 0)
 
     # -- views -----------------------------------------------------------
 
@@ -396,6 +457,7 @@ class Job:
                 },
                 "events": len(self._events),
                 "events_dropped": self._dropped,
+                "sse_listeners": self.sse_listeners,
                 "cancel_requested": self.cancel.is_set(),
                 "error": self.error,
             }
@@ -462,6 +524,9 @@ class JobManager:
         max_job_events: "int | None" = None,
         max_job_nodes: "int | None" = None,
         sjf_bypass: "int | None" = None,
+        jobs_dir: "str | None" = None,
+        resume: "bool | None" = None,
+        journal_max_bytes: "int | None" = None,
     ) -> None:
         env = os.environ
         if workers is None:
@@ -483,6 +548,10 @@ class JobManager:
         if sjf_bypass is None:
             raw = env.get("KSIM_JOBS_SJF_BYPASS", "")
             sjf_bypass = int(raw) if raw else None
+        if jobs_dir is None:
+            jobs_dir = env.get("KSIM_JOBS_DIR", "")
+        if resume is None:
+            resume = env.get("KSIM_JOBS_RESUME", "") == "1"
         self._ring_cap = max(ring_cap, 16)
         self._keep = max(keep, 1)
         self._max_events = max(max_events, 64)
@@ -495,6 +564,16 @@ class JobManager:
         self._jobs: "OrderedDict[str, Job]" = OrderedDict()  # guarded-by: _lock
         self._seq = 0  # guarded-by: _lock
         self._active = 0  # guarded-by: _lock
+        # Durability: journal replay + registry reconstruction happen
+        # BEFORE the workers start — recovery is single-threaded by
+        # construction, so no claim can race the rebuild.
+        self._journal: "JobJournal | None" = None
+        if jobs_dir:
+            self._journal = JobJournal(
+                os.path.join(jobs_dir, JOURNAL_NAME),
+                max_bytes=journal_max_bytes,
+            )
+            self._recover(bool(resume))
         self._threads: list[threading.Thread] = []
         for i in range(max(int(workers), 0)):
             t = threading.Thread(
@@ -502,6 +581,217 @@ class JobManager:
             )
             t.start()
             self._threads.append(t)
+
+    # -- durability ------------------------------------------------------
+
+    def _journal_append(self, rec: dict) -> bool:
+        """One best-effort durable append.  False on failure (I/O error
+        or an armed ``jobs.journal_append`` fault) — CALLERS decide the
+        blast radius, which is always the ONE job the record belongs
+        to, never the registry or the worker pool."""
+        if self._journal is None:
+            return True
+        try:
+            self._journal.append(rec)
+            return True
+        except Exception:
+            logger.exception(
+                "job journal append failed (type=%s job=%s)",
+                rec.get("t"), rec.get("id"),
+            )
+            return False
+
+    def _journal_state(
+        self, job: Job, state: str, *, error: "str | None" = None
+    ) -> bool:
+        if self._journal is None:
+            return True
+        rec: dict = {
+            "t": "state", "id": job.id, "state": state,
+            "ts": round(time.time(), 3),
+        }
+        if error:
+            rec["error"] = error
+        return self._journal_append(rec)
+
+    def _journal_records(self) -> list[dict]:
+        """The LIVE registry re-serialized as journal records — the
+        compaction snapshot.  Called by ``JobJournal.maybe_compact``
+        with the journal lock held; lock order journal ``_lock`` →
+        manager ``_lock`` → job ``_cond`` (the only path that ever
+        holds all three)."""
+        recs: list[dict] = []
+        for j in self.jobs():
+            if j.doc is None:
+                continue  # its submit record never became durable
+            st = j.status()
+            recs.append({
+                "t": "submit", "id": j.id, "ordinal": j.ordinal,
+                "priority": j.priority, "doc": j.doc,
+                "created": round(j.created, 3),
+            })
+            if st["started"]:
+                recs.append({
+                    "t": "state", "id": j.id, "state": "running",
+                    "ts": st["started"],
+                })
+            if st["state"] in TERMINAL_STATES:
+                _, result, _ = j.result_view()
+                if result is not None:
+                    recs.append({"t": "result", "id": j.id, "result": result})
+                state_rec: dict = {
+                    "t": "state", "id": j.id, "state": st["state"],
+                    "ts": st["finished"],
+                }
+                if st["error"]:
+                    state_rec["error"] = st["error"]
+                recs.append(state_rec)
+        return recs
+
+    def _maybe_compact(self) -> None:
+        """Bound the journal (called with NO locks held — submit's tail
+        and the worker's run epilogue)."""
+        if self._journal is not None:
+            self._journal.maybe_compact(self._journal_records)
+
+    def _recover(self, resume: bool) -> None:  # ksimlint: lock-held(_lock)
+        """Rebuild the registry from the journal (startup, pre-workers).
+        Runs in ``__init__`` BEFORE any worker thread exists, so the
+        registry is single-threaded here by construction — the
+        lock-held annotation records that exclusivity, not an actual
+        acquisition.  Never raises: an unreadable journal (or an armed
+        ``jobs.journal_replay`` fault) starts an empty registry; a
+        per-job reconstruction failure loses that ONE job."""
+        try:
+            recs = self._journal.replay()
+        except Exception:
+            logger.exception(
+                "job journal replay failed; starting with an empty registry"
+            )
+            return
+        folded: "OrderedDict[str, dict]" = OrderedDict()
+        for rec in recs:
+            jid, t = rec.get("id"), rec.get("t")
+            if not isinstance(jid, str):
+                continue
+            ent = folded.setdefault(jid, {
+                "submit": None, "state": None, "error": None,
+                "result": None, "cancel": False,
+                "started": None, "finished": None,
+            })
+            if t == "submit":
+                ent["submit"] = rec
+            elif t == "state":
+                state = rec.get("state")
+                ent["state"], ent["error"] = state, rec.get("error")
+                if state == "running":
+                    ent["started"] = rec.get("ts")
+                elif state in TERMINAL_STATES:
+                    ent["finished"] = rec.get("ts")
+            elif t == "result":
+                ent["result"] = rec.get("result")
+            elif t == "cancel":
+                ent["cancel"] = True
+        interrupted = resumed = 0
+        max_ordinal = -1
+        for jid, ent in folded.items():
+            sub = ent["submit"]
+            if sub is None:
+                continue  # debris past compaction: states without a spec
+            try:
+                ordinal = int(sub.get("ordinal", 0))
+                priority = int(sub.get("priority", 0))
+                max_ordinal = max(max_ordinal, ordinal)
+                job: "Job | None" = None
+                # Resumable: died mid-flight (no terminal record) OR
+                # already flagged interrupted by an earlier restart —
+                # KSIM_JOBS_RESUME=1 is exactly the "re-run those"
+                # switch, so it must reach jobs a resume-less restart
+                # already journaled as interrupted.
+                resumable = (
+                    ent["state"] not in TERMINAL_STATES
+                    or ent["state"] == "interrupted"
+                )
+                if resumable and resume:
+                    job = self._resume_job(jid, ordinal, priority, sub)
+                    if job is not None:
+                        resumed += 1
+                if job is None:
+                    job = self._restore_job(jid, ordinal, priority, sub, ent)
+                    if job.status()["state"] == "interrupted":
+                        interrupted += 1
+                self._jobs[jid] = job
+            except Exception:
+                logger.exception("job journal recovery lost job %s", jid)
+        self._seq = max_ordinal + 1
+        TRACE.event(
+            "jobs.journal_recover",
+            jobs=len(self._jobs), interrupted=interrupted, resumed=resumed,
+            truncated_bytes=self._journal.truncated_bytes,
+        )
+
+    def _restore_job(
+        self, jid: str, ordinal: int, priority: int, sub: dict, ent: dict
+    ) -> Job:
+        """One journal-reconstructed job: terminal states restore
+        verbatim (the result document serves byte-identically); a job
+        last seen queued/running died with the old process and is
+        flagged ``interrupted``."""
+        job = Job(
+            jid, ordinal, [], {}, priority,
+            ring_cap=self._ring_cap, max_events=self._max_events, faults=None,
+        )
+        job.doc = sub.get("doc")
+        state = ent["state"]
+        if state in TERMINAL_STATES:
+            job.restore(
+                state,
+                error=ent["error"],
+                result=ent["result"] if state == "succeeded" else None,
+                created=sub.get("created"), started=ent["started"],
+                finished=ent["finished"], cancelled=ent["cancel"],
+            )
+        else:
+            job.restore(
+                "interrupted",
+                error="interrupted by server restart",
+                created=sub.get("created"), started=ent["started"],
+                cancelled=ent["cancel"],
+            )
+            self._journal_state(job, "interrupted",
+                                error="interrupted by server restart")
+        return job
+
+    def _resume_job(
+        self, jid: str, ordinal: int, priority: int, sub: dict
+    ) -> "Job | None":
+        """KSIM_JOBS_RESUME=1: re-parse the journaled spec and re-enqueue
+        the died-mid-run job under its original id/ordinal.  None when
+        the spec no longer parses or the queue is full — the caller
+        falls back to ``interrupted`` (recovery never crashes startup)."""
+        try:
+            ops, sim, _, fault_spec = _parse_job_spec(sub.get("doc"))
+            entries = list(self._fault_specs.get(ordinal, ()))
+            if fault_spec:
+                entries.append(fault_spec)
+            faults: "FaultPlane | None" = None
+            if entries and not sim.get("fleet"):
+                faults = FaultPlane()
+                for entry in entries:
+                    faults.configure(entry)
+            job = Job(
+                jid, ordinal, ops, sim, priority,
+                ring_cap=self._ring_cap, max_events=self._max_events,
+                faults=faults,
+            )
+            job.doc = sub.get("doc")
+            job.emit({"event": "state", "state": "queued", "resumed": True},
+                     vital=True)
+            self.queue.put(job, priority=priority, cost=len(ops))
+            return job
+        except Exception:
+            logger.exception("job %s could not be resumed", jid)
+            return None
 
     # -- submission ------------------------------------------------------
 
@@ -596,9 +886,25 @@ class JobManager:
             self._seq += 1
             self._jobs[job.id] = job
             self._prune_locked()
+        # WAL: the submit record lands OUTSIDE the manager lock (lock
+        # order — the journal lock is taken first on the compaction
+        # path, so it must never nest inside ``_lock``).  A failed
+        # append fails the ONE job: the worker's ``claim()`` then sees
+        # a terminal state and skips it; the registry stays clean.
+        if self._journal is not None:
+            ok = self._journal_append({
+                "t": "submit", "id": job.id, "ordinal": job.ordinal,
+                "priority": priority, "doc": doc,
+                "created": round(job.created, 3),
+            })
+            if ok:
+                job.doc = doc
+            else:
+                job.finish("failed", error="journal append failed (submit)")
         TRACE.event(
             "jobs.enqueue", job=job.id, priority=priority, depth=self.queue.depth()
         )
+        self._maybe_compact()
         return job
 
     def _prune_locked(self) -> None:  # ksimlint: lock-held(_lock)
@@ -637,6 +943,13 @@ class JobManager:
         runner, service, replay driver, even the dispatch worker thread
         (the executor re-installs the scope there) — onto the job's
         private plane, tagged ``job=<id>``."""
+        # WAL: the running record lands BEFORE any work — a restart
+        # that finds it (and no terminal record) knows the job died
+        # mid-run and flags it ``interrupted``.  An unappendable
+        # journal fails the job without running it.
+        if not self._journal_state(job, "running"):
+            job.finish("failed", error="journal append failed (running)")
+            return
         try:
             with TRACE.scoped(job.trace):
                 with TRACE.span("jobs.run", steps=job.steps_total):
@@ -644,13 +957,29 @@ class JobManager:
                     if job.faults is not None:
                         job.faults.check("jobs.run")
                     res, runner = self._execute(job)
-            job.finish("succeeded", result=self._result_doc(job, res, runner))
+            result = self._result_doc(job, res, runner)
+            # WAL: result + terminal record become durable BEFORE the
+            # in-memory success — a success the journal cannot vouch
+            # for must not be reported (it would vanish on restart).
+            if self._journal is not None:
+                ok = self._journal_append(
+                    {"t": "result", "id": job.id, "result": result}
+                ) and self._journal_state(job, "succeeded")
+                if not ok:
+                    job.finish("failed", error="journal append failed (result)")
+                    return
+            job.finish("succeeded", result=result)
         except RunCancelled:
             job.finish("cancelled")
+            self._journal_state(job, "cancelled")  # best-effort: terminal
             logger.info("job %s cancelled", job.id)
         except Exception as e:
             logger.exception("job %s failed", job.id)
-            job.finish("failed", error=f"{type(e).__name__}: {e}")
+            error = f"{type(e).__name__}: {e}"
+            job.finish("failed", error=error)
+            self._journal_state(job, "failed", error=error)  # best-effort
+        finally:
+            self._maybe_compact()
 
     def _execute(self, job: Job):
         """Build the job's isolated simulator stack from its spec and
@@ -744,6 +1073,14 @@ class JobManager:
         state = job.request_cancel()
         if not already_done:
             TRACE.event("job.cancelled", job=job.id, state=state)
+            # Best-effort WAL: the cancel REQUEST, plus the terminal
+            # record when the queued job finalized right here (a
+            # running job's terminal record comes from its worker).
+            self._journal_append(
+                {"t": "cancel", "id": job.id, "ts": round(time.time(), 3)}
+            )
+            if state == "cancelled":
+                self._journal_state(job, "cancelled")
         return state
 
     def join(self, timeout: "float | None" = None) -> bool:
@@ -764,13 +1101,16 @@ class JobManager:
         with self._lock:
             jobs = list(self._jobs.values())
             active = self._active
-        return {
+        doc = {
             "queue": self.queue.stats(),
             "workers": {"pool": len(self._threads), "active": active},
             "jobs": {
                 j.id: dict(j.status(), trace=j.trace_summary()) for j in jobs
             },
         }
+        if self._journal is not None:
+            doc["journal"] = self._journal.snapshot()
+        return doc
 
     def shutdown(self, timeout: "float | None" = 5.0) -> None:
         """Stop accepting work, cancel everything live, and join the

@@ -100,6 +100,11 @@ SPAN_NAMES: tuple[str, ...] = (
     #                     compile of a real cluster trace into the
     #                     operation stream (ksim_tpu/traces/compile.py;
     #                     args carry format/records/ops)
+    "jobs.journal_append",  # one durable append to the job journal
+    #                         (ksim_tpu/jobs/journal.py; the write-ahead
+    #                         record behind every submission/transition)
+    "jobs.journal_replay",  # one startup journal replay: scan + torn-
+    #                         tail truncation + registry reconstruction
 )
 
 #: Instant event names.
@@ -107,8 +112,11 @@ EVENT_NAMES: tuple[str, ...] = (
     "replay.fallback",  # segment rejected/degraded; args.reason is the
     #                     stable histogram reason (ReplayDriver._reject)
     "replay.watchdog_timeout",  # a dispatch exceeded the watchdog
-    "replay.breaker_open",  # the sticky circuit breaker tripped
-    #                         (it never closes — openings only)
+    "replay.breaker_open",  # the circuit breaker tripped (args.cause:
+    #                         device_error / reconcile_fault /
+    #                         probe_failed — the last is a half-open
+    #                         probe that failed and re-opened with a
+    #                         doubled cooldown)
     "service.pass",  # pass outcome: attempts/scheduled/unschedulable
     "fault.fired",  # the fault plane injected at args.site
     "store.txn_commit",  # segment transaction committed (args.writes)
@@ -126,6 +134,19 @@ EVENT_NAMES: tuple[str, ...] = (
     "job.cancelled",  # a tenant job was cancelled (queued or mid-run;
     #                   mid-segment cancellation rolls the in-flight
     #                   segment transaction back first)
+    "replay.breaker_probe",  # the open breaker's cooldown elapsed and
+    #                          ONE probe segment was admitted to the
+    #                          device path (half-open state)
+    "replay.breaker_close",  # a probe dispatch came back healthy: the
+    #                          breaker closed and the driver re-promoted
+    #                          to the device path
+    "compilecache.evict",  # an on-disk serialized executable was
+    #                        discarded (args.reason: corrupt /
+    #                        key_mismatch / deserialize_failed /
+    #                        exec_failed — engine/compilecache.py)
+    "jobs.journal_recover",  # startup journal replay reconstructed the
+    #                          job registry (args: jobs / interrupted /
+    #                          resumed / truncated_bytes)
 )
 
 _KNOWN_NAMES = frozenset(SPAN_NAMES) | frozenset(EVENT_NAMES)

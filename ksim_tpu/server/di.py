@@ -3,6 +3,7 @@ mirroring the reference (reference simulator/server/di/di.go:24-71)."""
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any
 
@@ -40,6 +41,16 @@ class DIContainer:
         # serving only the classic single-cluster surface never needs.
         self._job_manager = None
         self._job_manager_lock = threading.Lock()
+        if os.environ.get("KSIM_JOBS_DIR"):
+            # The durable job plane (docs/jobs.md "Durability &
+            # recovery") replays its journal at CONSTRUCTION: a
+            # restarted server must know its journaled jobs before the
+            # first tenant GET, so the lazy build — a classic-surface
+            # optimization — would leave recovered results 404 until
+            # some request happened to force the manager into being.
+            from ksim_tpu.jobs import JobManager
+
+            self._job_manager = JobManager()
         if start_scheduler:
             self.scheduler_service.start()
 

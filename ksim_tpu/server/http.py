@@ -63,7 +63,9 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -79,6 +81,19 @@ logger = logging.getLogger(__name__)
 from ksim_tpu.server.params import LRV_PARAMS
 
 EXTENDER_VERBS = ("filter", "prioritize", "preempt", "bind")
+
+
+def _sse_heartbeat_s() -> float:
+    """Idle bound before the job SSE stream emits a ``: keepalive``
+    comment — ``KSIM_JOBS_SSE_HEARTBEAT_S`` (seconds, default 15; 0
+    disables).  Proxies and LBs silently drop idle chunked responses;
+    the comment line is invisible to EventSource consumers but keeps
+    the connection (and the server's disconnect detection) live."""
+    raw = os.environ.get("KSIM_JOBS_SSE_HEARTBEAT_S", "")
+    try:
+        return float(raw) if raw else 15.0
+    except ValueError:
+        return 15.0
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -152,19 +167,22 @@ class _Handler(BaseHTTPRequestHandler):
     # -- chunked server push (listwatch + the job SSE stream) ---------------
 
     def _write_chunk(self, payload: bytes) -> bool:
-        """One HTTP/1.1 chunk, flushed; False when the client is gone."""
+        """One HTTP/1.1 chunk, flushed; False when the client is gone.
+        Any OSError means gone — an aborted reader can surface as
+        ETIMEDOUT/EPIPE wrapped in plain OSError, not just the two
+        connection subclasses."""
         try:
             self.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
             self.wfile.flush()
             return True
-        except (BrokenPipeError, ConnectionResetError):
+        except OSError:
             return False
 
     def _end_chunks(self) -> None:
         """Graceful end-of-stream (the zero-length terminal chunk)."""
         try:
             self.wfile.write(b"0\r\n\r\n")
-        except (BrokenPipeError, ConnectionResetError):
+        except OSError:
             pass
 
     # -- routing ------------------------------------------------------------
@@ -415,7 +433,7 @@ class _Handler(BaseHTTPRequestHandler):
             state, result, error = job.result_view()
             if state == "succeeded":
                 self._json(200, {"id": job.id, "state": state, **(result or {})})
-            elif state in ("failed", "cancelled"):
+            elif state in ("failed", "cancelled", "interrupted"):
                 self._json(
                     200,
                     {"id": job.id, "state": state, "phase": "Failed", "message": error},
@@ -438,7 +456,16 @@ class _Handler(BaseHTTPRequestHandler):
         wearing SSE framing, so a browser EventSource consumes it
         directly.  The event log replays from the start (late joiners
         see the whole history) and the stream ends after the terminal
-        state event."""
+        state event.
+
+        Hardened (round 15): the listener is COUNTED on the job
+        (``sse_listeners`` in the status document) and the count is
+        released in a ``finally`` no matter how the reader goes away —
+        an aborted EventSource must never leak a phantom listener.  An
+        idle stream emits a ``: keepalive`` SSE comment every
+        ``KSIM_JOBS_SSE_HEARTBEAT_S`` seconds, which both defeats
+        idle-connection reaping by proxies and turns a silently dead
+        socket into a detected disconnect (the chunk write fails)."""
         self.send_response(200)
         self._cors()
         self.send_header("Content-Type", "text/event-stream")
@@ -446,15 +473,31 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
+        heartbeat_s = _sse_heartbeat_s()
         idx = 0
-        while not self.server.stopping.is_set():
-            events, idx, done = job.events_since(idx, timeout=0.25)
-            for ev in events:
-                if not self._write_chunk(f"data: {json.dumps(ev)}\n\n".encode()):
-                    return
-            if done:
-                break
-        self._end_chunks()
+        last_write = time.monotonic()
+        job.sse_attach()
+        try:
+            while not self.server.stopping.is_set():
+                events, idx, done = job.events_since(idx, timeout=0.25)
+                for ev in events:
+                    if not self._write_chunk(
+                        f"data: {json.dumps(ev)}\n\n".encode()
+                    ):
+                        return
+                    last_write = time.monotonic()
+                if done:
+                    break
+                if (
+                    heartbeat_s > 0
+                    and time.monotonic() - last_write >= heartbeat_s
+                ):
+                    if not self._write_chunk(b": keepalive\n\n"):
+                        return
+                    last_write = time.monotonic()
+            self._end_chunks()
+        finally:
+            job.sse_detach()
 
     def _job_cancel(self, path: str) -> None:
         parsed = self._job_parts(path)

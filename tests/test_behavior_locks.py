@@ -101,6 +101,44 @@ def test_churn_lock_6k_holds_with_tracing_enabled(tmp_path):
         TRACE._active, TRACE._ring_on, TRACE._user_disabled = prev_state
 
 
+@pytest.mark.slow
+def test_churn_lock_6k_holds_under_dispatch_faults_with_recovery(monkeypatch):
+    """The chaos leg (`make lock-check`, round 15): the locked 6k counts
+    are BYTE-IDENTICAL while the fault plane kills the first two device
+    dispatches, the breaker trips, and half-open recovery (a cooldown'd
+    probe segment) re-promotes the device path mid-run.  Faults change
+    WHERE steps execute (host vs device), never WHAT they compute —
+    the durability round's end-to-end breaker-recovery proof."""
+    from ksim_tpu.faults import FAULTS
+
+    monkeypatch.setenv("KSIM_REPLAY_BREAKER_N", "2")
+    monkeypatch.setenv("KSIM_REPLAY_BREAKER_COOLDOWN_S", "0.05")
+    prev_x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    FAULTS.reset()
+    FAULTS.arm("replay.dispatch", "first:2@device")
+    try:
+        runner = ScenarioRunner(
+            max_pods_per_pass=1024, pod_bucket_min=128, device_replay=True
+        )
+        res = runner.run(
+            churn_scenario(0, n_nodes=2000, n_events=6000, ops_per_step=100)
+        )
+    finally:
+        FAULTS.reset()
+        jax.config.update("jax_enable_x64", prev_x64)
+    assert res.events_applied == LOCK_EVENTS
+    assert (res.pods_scheduled, res.unschedulable_attempts) == (
+        LOCK_SCHEDULED,
+        LOCK_UNSCHEDULABLE,
+    )
+    d = runner.replay_driver
+    assert d.breaker_closes >= 1, d.stats()["breaker"]  # recovered mid-run
+    assert d.breaker_tripped is False
+    assert d.device_steps > 0
+    assert d.device_steps + d.fallback_steps == len(res.steps)
+
+
 # The trace workload family (round 14, ksim_tpu/traces): the bundled
 # hand-checked Borg fixture compiled at 24 nodes / ops_per_step=2 —
 # the SECOND locked-count family next to synthetic churn, and the

@@ -326,6 +326,53 @@ def test_bench_churn_trace_child_survives_dead_device(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_churn_restart_child_records_warm_restart_evidence(tmp_path):
+    """Round 15: the churn_restart child's record carries the warm-restart
+    acceptance evidence — time-to-first-scheduled-pod plus the on-disk AOT
+    compile-cache counters. Two children over the SAME state dir: the cold
+    run stores the serialized executable, the warm run loads it from disk
+    without compiling, and both produce identical counts."""
+    state = tmp_path / "state"
+    env = sanitized_cpu_env(
+        {
+            "KSIM_AOT_CACHE": str(state / "aot"),
+            "KSIM_COMPILE_CACHE": str(state / "xla"),
+        }
+    )
+    recs = []
+    for leg in ("cold", "warm"):
+        out = tmp_path / f"restart_{leg}.json"
+        proc = subprocess.run(
+            [
+                sys.executable, str(REPO / "bench.py"),
+                "--child", "churn_restart", "--out", str(out),
+                "--seed", "0", "--churn-events", "600", "--churn-nodes", "200",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=420,
+            cwd=REPO,
+            env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        recs.append(json.loads(out.read_text()))
+    cold, warm = recs
+    for rec in recs:
+        assert rec["wall_s"] > 0
+        assert rec["first_scheduled_s"] is not None
+        assert 0 < rec["first_scheduled_s"] <= rec["wall_s"] + 0.1
+        assert rec["device_steps"] > 0 and rec["fallback_steps"] == 0
+    # Identical streams -> identical counts, cold or warm.
+    assert (warm["pods_scheduled"], warm["unschedulable_attempts"]) == (
+        cold["pods_scheduled"], cold["unschedulable_attempts"])
+    # The cold leg compiled and persisted; the warm leg restored from disk.
+    assert cold["compile_cache"]["disk_stores"] >= 1
+    assert cold["compile_cache"]["disk_hits"] == 0
+    assert warm["compile_cache"]["disk_hits"] >= 1
+    assert warm["compile_cache"]["disk_stores"] == 0
+
+
+@pytest.mark.slow
 def test_bench_emits_json_when_probe_backend_is_dead():
     """A wedged/absent accelerator at PROBE time (the chip-tunnel
     failure mode the stdlib-only parent exists for): the probe child

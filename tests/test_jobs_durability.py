@@ -1,0 +1,558 @@
+"""Durable job plane (round 15, ksim_tpu/jobs/journal.py +
+engine/compilecache.py disk layer): crash-safe journal units
+(torn-tail/corrupt-CRC bytes are HAND-WRITTEN, never derived from the
+writer), persistent-executable cache units (fake disk spec, jax-free),
+in-process restart recovery, the kill -9 end-to-end (slow; `make
+restart-check` runs it), and the SSE listener-leak regression."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import zlib
+
+import pytest
+
+from ksim_tpu.engine.compilecache import CompileCache
+from ksim_tpu.faults import FAULTS, InjectedFault
+from ksim_tpu.jobs import JobJournal, JobManager
+from ksim_tpu.jobs.journal import JOURNAL_NAME
+from ksim_tpu.server import DIContainer, SimulatorServer
+from tests.helpers import make_node, make_pod, sanitized_cpu_env
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def tiny_doc(n_pods: int = 3) -> dict:
+    ops = [
+        {"step": 0, "createOperation": {"object": make_node(f"n{i}", cpu="4")}}
+        for i in range(2)
+    ]
+    ops += [
+        {"step": i + 1, "createOperation": {"object": make_pod(f"p{i}", cpu="100m")}}
+        for i in range(n_pods)
+    ]
+    return {"spec": {"scenario": {"operations": ops}}}
+
+
+def _wait(job, states, deadline_s=60.0):
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        if job.status()["state"] in states:
+            return job.status()
+        time.sleep(0.02)
+    raise AssertionError(f"job {job.id} never reached {states}: {job.status()}")
+
+
+# ---------------------------------------------------------------------------
+# Journal units: append/replay, torn tail, corrupt CRC, compaction
+# ---------------------------------------------------------------------------
+
+
+def test_journal_append_replay_round_trip(tmp_path):
+    j = JobJournal(str(tmp_path / "j.jsonl"))
+    recs = [
+        {"t": "submit", "id": "a", "ordinal": 0, "priority": 0, "doc": {"x": 1}},
+        {"t": "state", "id": "a", "state": "running", "ts": 1.0},
+        {"t": "result", "id": "a", "result": {"podsScheduled": 3}},
+        {"t": "state", "id": "a", "state": "succeeded", "ts": 2.0},
+    ]
+    for r in recs:
+        j.append(r)
+    assert JobJournal(j.path).replay() == recs
+    snap = j.snapshot()
+    assert snap["appends"] == 4 and snap["append_errors"] == 0
+
+
+def test_journal_torn_tail_is_truncated_not_fatal(tmp_path):
+    """A process killed mid-append leaves a partial final line; replay
+    keeps every whole record and truncates the debris.  The torn bytes
+    are hand-written — the writer never produces them."""
+    j = JobJournal(str(tmp_path / "j.jsonl"))
+    j.append({"t": "submit", "id": "a", "ordinal": 0, "priority": 0, "doc": {}})
+    j.append({"t": "state", "id": "a", "state": "running", "ts": 1.0})
+    torn = b'{"crc": 123, "rec": {"t": "state", "id": "a", "sta'
+    with open(j.path, "ab") as f:
+        f.write(torn)
+    j2 = JobJournal(j.path)
+    recs = j2.replay()
+    assert [r["t"] for r in recs] == ["submit", "state"]
+    assert j2.snapshot()["truncated_bytes"] == len(torn)
+    # The file was repaired in place: a fresh append then full replay works.
+    j2.append({"t": "state", "id": "a", "state": "succeeded", "ts": 2.0})
+    assert [r["t"] for r in JobJournal(j.path).replay()] == [
+        "submit", "state", "state",
+    ]
+
+
+def test_journal_corrupt_crc_drops_record_and_tail(tmp_path):
+    """A bit-flipped record fails its checksum; the WAL contract can
+    vouch for nothing after it, so the tail (even well-formed lines) is
+    dropped too.  The bad line is hand-written with a deliberately
+    wrong CRC."""
+    j = JobJournal(str(tmp_path / "j.jsonl"))
+    j.append({"t": "submit", "id": "a", "ordinal": 0, "priority": 0, "doc": {}})
+    bad_rec = {"t": "state", "id": "a", "state": "running", "ts": 1.0}
+    with open(j.path, "a", encoding="utf-8") as f:
+        f.write(json.dumps({"crc": 1, "rec": bad_rec}) + "\n")
+    j.append({"t": "state", "id": "a", "state": "succeeded", "ts": 2.0})
+    j2 = JobJournal(j.path)
+    recs = j2.replay()
+    assert [r["t"] for r in recs] == ["submit"]
+    assert j2.snapshot()["truncated_bytes"] > 0
+
+
+def test_journal_garbage_and_missing_file(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    assert JobJournal(p).replay() == []  # missing file: empty registry
+    with open(p, "w", encoding="utf-8") as f:
+        f.write("not json at all\n")
+    assert JobJournal(p).replay() == []
+
+
+def test_journal_crc_covers_canonical_form(tmp_path):
+    """A record re-serialized with different key order / whitespace
+    still validates: the checksum is over the canonical JSON."""
+    j = JobJournal(str(tmp_path / "j.jsonl"))
+    rec = {"t": "submit", "id": "a", "ordinal": 0, "priority": 0, "doc": {"k": 1}}
+    body = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+    crc = zlib.crc32(body.encode()) & 0xFFFFFFFF
+    # Hand-write the wrapper with scrambled key order and spaces.
+    with open(j.path, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"rec": rec, "crc": crc}, indent=None) + "\n")
+    assert JobJournal(j.path).replay() == [rec]
+
+
+def test_journal_compaction_bounds_file(tmp_path):
+    j = JobJournal(str(tmp_path / "j.jsonl"), max_bytes=256)
+    for i in range(50):
+        j.append({"t": "state", "id": "a", "state": "running", "ts": float(i)})
+    live = [{"t": "submit", "id": "a", "ordinal": 0, "priority": 0, "doc": {}}]
+    assert j.maybe_compact(lambda: live) is True
+    assert j.snapshot()["compactions"] == 1
+    assert os.path.getsize(j.path) < 256
+    assert JobJournal(j.path).replay() == live
+    # Under the bound: no-op.
+    assert j.maybe_compact(lambda: live) is False
+
+
+def test_journal_append_fault_raises_and_counts(tmp_path):
+    j = JobJournal(str(tmp_path / "j.jsonl"))
+    FAULTS.arm("jobs.journal_append", "call:1")
+    with pytest.raises(InjectedFault):
+        j.append({"t": "submit", "id": "a", "ordinal": 0, "priority": 0, "doc": {}})
+    assert j.snapshot()["append_errors"] == 1
+    j.append({"t": "state", "id": "a", "state": "running", "ts": 1.0})
+    assert [r["t"] for r in JobJournal(j.path).replay()] == ["state"]
+
+
+# ---------------------------------------------------------------------------
+# CompileCache disk layer (fake disk spec — stdlib-only, no jax)
+# ---------------------------------------------------------------------------
+
+
+class FakeDisk:
+    """Duck-typed disk spec: 'serializes' to a fixed blob; load/invoke
+    count calls so tests can tell the disk path from the compile path."""
+
+    def __init__(self, path, token="tok-1", blob=b"fake-executable-bytes"):
+        self.path = str(path)
+        self.token = token
+        self.blob = blob
+        self.loads = 0
+        self.invokes = 0
+        self.fail_invoke = False
+
+    def load(self, blob):
+        assert blob == self.blob
+        self.loads += 1
+        return ("exec", blob)
+
+    def invoke(self, exec_obj):
+        self.invokes += 1
+        if self.fail_invoke:
+            raise RuntimeError("platform mismatch")
+        return "disk-result"
+
+    def serialize(self):
+        return self.blob
+
+
+def test_disk_store_then_warm_load(tmp_path):
+    path = tmp_path / "e.aot"
+    cc1 = CompileCache()
+    d1 = FakeDisk(path)
+    out = cc1.run("k", lambda: "compiled-result", disk=d1)
+    assert out == "compiled-result"
+    s1 = cc1.snapshot()
+    assert s1["disk_misses"] == 1 and s1["disk_stores"] == 1
+    header, _, blob = path.read_bytes().partition(b"\n")
+    meta = json.loads(header)
+    assert meta["v"] == 1 and meta["key"] == "tok-1"
+    assert meta["crc"] == (zlib.crc32(blob) & 0xFFFFFFFF)
+    # A "restarted process": fresh cache, same file -> no compile.
+    cc2 = CompileCache()
+    d2 = FakeDisk(path)
+    out = cc2.run("k", lambda: pytest.fail("compiled on a disk hit"), disk=d2)
+    assert out == "disk-result"
+    s2 = cc2.snapshot()
+    assert s2["disk_hits"] == 1 and d2.loads == 1 and d2.invokes == 1
+
+
+def test_disk_corrupt_blob_evicted_and_recompiled(tmp_path):
+    path = tmp_path / "e.aot"
+    cc = CompileCache()
+    cc.run("k", lambda: "r", disk=FakeDisk(path))
+    raw = bytearray(path.read_bytes())
+    raw[-3] ^= 0xFF  # hand-flip a blob byte: CRC must catch it
+    path.write_bytes(bytes(raw))
+    cc2 = CompileCache()
+    out = cc2.run("k", lambda: "recompiled", disk=FakeDisk(path))
+    assert out == "recompiled"
+    s = cc2.snapshot()
+    assert s["disk_evictions"] == 1 and s["disk_hits"] == 0
+    # The eviction unlinked, then the store re-persisted a good entry.
+    assert s["disk_stores"] == 1
+    assert json.loads(path.read_bytes().partition(b"\n")[0])["v"] == 1
+
+
+def test_disk_garbage_header_evicted(tmp_path):
+    path = tmp_path / "e.aot"
+    path.write_bytes(b"\x00\x01 not a header\nblob")
+    cc = CompileCache()
+    assert cc.run("k", lambda: "r", disk=FakeDisk(path)) == "r"
+    assert cc.snapshot()["disk_evictions"] == 1
+
+
+def test_disk_headerless_file_evicted(tmp_path):
+    """No newline at all — the partition finds no separator."""
+    path = tmp_path / "e.aot"
+    path.write_bytes(b'{"v": 1, "crc": 0, "key": "tok-1"}')
+    cc = CompileCache()
+    assert cc.run("k", lambda: "r", disk=FakeDisk(path)) == "r"
+    assert cc.snapshot()["disk_evictions"] == 1
+
+
+def test_disk_key_mismatch_evicted(tmp_path):
+    """A stale jaxlib (or hash-colliding path) changes the token; the
+    entry must never reach the deserializer."""
+    path = tmp_path / "e.aot"
+    cc = CompileCache()
+    cc.run("k", lambda: "r", disk=FakeDisk(path, token="jax-0.4.0|cpu|sig"))
+    cc2 = CompileCache()
+    d = FakeDisk(path, token="jax-9.9.9|cpu|sig")
+    assert cc2.run("k", lambda: "recompiled", disk=d) == "recompiled"
+    assert cc2.snapshot()["disk_evictions"] == 1
+    assert d.loads == 0  # blob never handed to load()
+
+
+def test_disk_exec_failure_evicts_and_falls_back(tmp_path):
+    path = tmp_path / "e.aot"
+    cc = CompileCache()
+    cc.run("k", lambda: "r", disk=FakeDisk(path))
+    cc2 = CompileCache()
+    d = FakeDisk(path)
+    d.fail_invoke = True
+    assert cc2.run("k", lambda: "recompiled", disk=d) == "recompiled"
+    s = cc2.snapshot()
+    assert s["disk_evictions"] == 1 and d.loads == 1 and d.invokes == 1
+
+
+def test_disk_serialize_none_skips_store(tmp_path):
+    path = tmp_path / "e.aot"
+    cc = CompileCache()
+    d = FakeDisk(path)
+    d.serialize = lambda: None  # non-exportable plan
+    assert cc.run("k", lambda: "r", disk=d) == "r"
+    assert cc.snapshot()["disk_stores"] == 0
+    assert not path.exists()
+
+
+# ---------------------------------------------------------------------------
+# Manager recovery (in-process restarts: new JobManager over the same dir)
+# ---------------------------------------------------------------------------
+
+
+def test_restart_serves_result_byte_identically(tmp_path):
+    jm = JobManager(workers=1, queue_limit=8, jobs_dir=str(tmp_path))
+    job = jm.submit(tiny_doc())
+    _wait(job, {"succeeded", "failed"})
+    state, result, _ = job.result_view()
+    assert state == "succeeded"
+    jm.shutdown()
+    jm2 = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    j2 = jm2.get(job.id)
+    assert j2 is not None
+    state2, result2, _ = j2.result_view()
+    assert state2 == "succeeded"
+    assert json.dumps(result2, sort_keys=True) == json.dumps(result, sort_keys=True)
+    jm2.shutdown()
+
+
+def test_di_container_builds_manager_eagerly_when_jobs_dir_set(
+    tmp_path, monkeypatch
+):
+    """A restarted SERVER must recover before the first tenant request:
+    the DI container's lazy job-plane build (a classic-surface
+    optimization) is skipped when KSIM_JOBS_DIR is set, otherwise a
+    journaled result 404s until something happens to force the manager
+    into existence — the gap an end-to-end restart drive caught."""
+    jm = JobManager(workers=1, queue_limit=8, jobs_dir=str(tmp_path))
+    job = jm.submit(tiny_doc())
+    _wait(job, {"succeeded", "failed"})
+    jm.shutdown()
+    monkeypatch.setenv("KSIM_JOBS_DIR", str(tmp_path))
+    monkeypatch.setenv("KSIM_JOBS_WORKERS", "0")
+    di = DIContainer()
+    try:
+        recovered = di.job_manager_if_built
+        assert recovered is not None  # built (and recovered) at construction
+        j2 = recovered.get(job.id)
+        assert j2 is not None
+        state, result, _ = j2.result_view()
+        assert state == "succeeded"
+        assert result["result"]["podsScheduled"] == 3
+    finally:
+        di.shutdown()
+
+
+def test_restart_marks_unfinished_jobs_interrupted(tmp_path):
+    jm = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    job = jm.submit(tiny_doc())  # no workers: stays queued forever
+    jm.shutdown()
+    jm2 = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    j2 = jm2.get(job.id)
+    state, result, error = j2.result_view()
+    assert state == "interrupted"
+    assert result is None and "restart" in error
+    jm2.shutdown()
+
+
+def test_resume_reenqueues_unfinished_jobs(tmp_path):
+    jm = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    job = jm.submit(tiny_doc())
+    jm.shutdown()
+    jm2 = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path), resume=True
+    )
+    j2 = jm2.get(job.id)
+    final = _wait(j2, {"succeeded", "failed", "interrupted"})
+    assert final["state"] == "succeeded", final
+    assert j2.result_view()[1]["result"]["podsScheduled"] == 3
+    jm2.shutdown()
+
+
+def test_interrupted_then_resume_still_reenqueues(tmp_path):
+    """Regression: a job journaled as `interrupted` by a resume-less
+    restart must still be reachable by a LATER restart with
+    KSIM_JOBS_RESUME=1 — interrupted is terminal for serving, not for
+    the resume policy."""
+    jm = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    job = jm.submit(tiny_doc())
+    jm.shutdown()
+    jm2 = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    assert jm2.get(job.id).result_view()[0] == "interrupted"
+    jm2.shutdown()
+    jm3 = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path), resume=True
+    )
+    final = _wait(jm3.get(job.id), {"succeeded", "failed", "interrupted"})
+    assert final["state"] == "succeeded", final
+    jm3.shutdown()
+
+
+def test_recovery_survives_torn_tail(tmp_path):
+    jm = JobManager(workers=1, queue_limit=8, jobs_dir=str(tmp_path))
+    job = jm.submit(tiny_doc())
+    _wait(job, {"succeeded"})
+    jm.shutdown()
+    torn = b'{"crc": 99, "rec": {"t": "subm'  # the kill -9 artifact
+    with open(os.path.join(str(tmp_path), JOURNAL_NAME), "ab") as f:
+        f.write(torn)
+    jm2 = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    assert jm2.get(job.id).result_view()[0] == "succeeded"
+    assert jm2.snapshot()["journal"]["truncated_bytes"] == len(torn)
+    jm2.shutdown()
+
+
+def test_submit_append_fault_fails_one_job_not_registry(tmp_path):
+    """An armed jobs.journal_append failure fails the ONE submission
+    whose record was lost; the manager and later submissions are
+    untouched."""
+    FAULTS.arm("jobs.journal_append", "first:1", exc=OSError)
+    # workers=0: the submit-path append is the only journal writer, so
+    # the armed first:1 lands on it deterministically.
+    jm = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    job = jm.submit(tiny_doc())
+    state, _, error = job.result_view()
+    assert state == "failed" and "journal append failed" in error
+    job2 = jm.submit(tiny_doc())
+    assert job2.status()["state"] == "queued"
+    jm.shutdown()
+    # The failed job's submit record never landed: a restart only
+    # knows the successful one — and resume runs it to completion.
+    jm2 = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path), resume=True
+    )
+    assert jm2.get(job.id) is None
+    assert _wait(jm2.get(job2.id), {"succeeded", "failed"})["state"] == "succeeded"
+    jm2.shutdown()
+
+
+def test_replay_fault_starts_empty_registry_not_crash(tmp_path):
+    jm = JobManager(workers=1, queue_limit=8, jobs_dir=str(tmp_path))
+    job = jm.submit(tiny_doc())
+    _wait(job, {"succeeded"})
+    jm.shutdown()
+    FAULTS.arm("jobs.journal_replay", "call:1", exc=OSError)
+    jm2 = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    assert jm2.jobs() == []  # lost the registry, kept the process
+    jm2.shutdown()
+
+
+def test_cancel_is_journaled(tmp_path):
+    jm = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    job = jm.submit(tiny_doc())
+    assert jm.cancel(job.id) == "cancelled"
+    jm.shutdown()
+    jm2 = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    assert jm2.get(job.id).result_view()[0] == "cancelled"
+    jm2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The kill -9: a real process dies mid-job, the next one recovers
+# ---------------------------------------------------------------------------
+
+_CRASH_CHILD = r"""
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ksim_tpu.jobs import JobManager
+from tests.helpers import make_node, make_pod
+
+# 200 one-pod steps (enough to still be mid-run when killed) on nodes
+# big enough that every pod fits — the resumed run must schedule ALL.
+ops = [
+    {"step": 0, "createOperation": {"object": make_node(f"n{i}", cpu="32")}}
+    for i in range(2)
+]
+ops += [
+    {"step": i + 1, "createOperation": {"object": make_pod(f"p{i}", cpu="100m")}}
+    for i in range(200)
+]
+doc = {"spec": {"scenario": {"operations": ops}}}
+
+jm = JobManager(workers=1, queue_limit=8, jobs_dir=sys.argv[1])
+job = jm.submit(doc)
+while job.status()["state"] == "queued":
+    time.sleep(0.01)
+print("RUNNING", job.id, flush=True)
+time.sleep(600)  # parent kills -9 long before this returns
+"""
+
+
+@pytest.mark.slow
+def test_sigkill_mid_job_then_restart_recovers(tmp_path):
+    """The acceptance scenario: kill -9 a server mid-job; a restarted
+    manager over the same KSIM_JOBS_DIR replays the journal, marks the
+    died-mid-run job `interrupted`, and a resume restart re-runs it to
+    completion."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CRASH_CHILD, str(tmp_path)],
+        env=sanitized_cpu_env(),
+        cwd="/root/repo",
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("RUNNING"), line
+        jid = line.split()[1]
+        time.sleep(0.2)  # let a few steps land in the running state
+    finally:
+        proc.kill()  # SIGKILL: no atexit, no flush, no goodbye
+        proc.wait()
+    jm = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    state, result, error = jm.get(jid).result_view()
+    assert state == "interrupted" and result is None
+    assert "restart" in error
+    jm.shutdown()
+    jm2 = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path), resume=True
+    )
+    final = _wait(jm2.get(jid), {"succeeded", "failed", "interrupted"}, 120.0)
+    assert final["state"] == "succeeded", final
+    assert jm2.get(jid).result_view()[1]["result"]["podsScheduled"] == 200
+    jm2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SSE hardening: aborted readers must not leak listeners
+# ---------------------------------------------------------------------------
+
+
+def test_sse_aborted_reader_releases_listener(monkeypatch):
+    """An EventSource that vanishes mid-stream (socket torn down, no
+    graceful close) must be detected by the heartbeat write and its
+    listener count released — the pre-round-15 handler leaked the
+    thread until the job finished."""
+    monkeypatch.setenv("KSIM_JOBS_WORKERS", "0")  # job stays queued: stream idles
+    monkeypatch.setenv("KSIM_JOBS_SSE_HEARTBEAT_S", "0.2")
+    di = DIContainer()
+    srv = SimulatorServer(di, port=0).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+        conn.request(
+            "POST", "/api/v1/jobs", json.dumps(tiny_doc()),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        jid = json.loads(resp.read())["id"]
+        assert resp.status == 202
+        conn.close()
+
+        raw = socket.create_connection(("127.0.0.1", srv.port), timeout=30)
+        raw.sendall(
+            f"GET /api/v1/jobs/{jid}/events HTTP/1.1\r\n"
+            f"Host: 127.0.0.1\r\n\r\n".encode()
+        )
+        first = raw.recv(4096)  # headers + the replayed queued event
+        assert b"text/event-stream" in first
+
+        job = di.job_manager_if_built.get(jid)
+        deadline = time.monotonic() + 10
+        while job.status()["sse_listeners"] != 1:
+            assert time.monotonic() < deadline, job.status()
+            time.sleep(0.02)
+
+        # Keepalives flow while the stream idles (nothing new to send).
+        buf = b""
+        deadline = time.monotonic() + 10
+        while b": keepalive" not in buf:
+            assert time.monotonic() < deadline, buf
+            buf += raw.recv(4096)
+
+        # The abort: RST the socket, no FIN handshake, reader gone.
+        raw.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER,
+            __import__("struct").pack("ii", 1, 0),
+        )
+        raw.close()
+        deadline = time.monotonic() + 10
+        while job.status()["sse_listeners"] != 0:
+            assert time.monotonic() < deadline, job.status()
+            time.sleep(0.05)
+    finally:
+        srv.shutdown_server()
+        di.shutdown()

@@ -156,6 +156,77 @@ def test_permanent_device_failure_trips_breaker(monkeypatch):
     assert driver.fallback_steps == len(res.steps)
 
 
+@pytest.mark.slow
+def test_breaker_half_open_probe_closes(monkeypatch):
+    """Half-open recovery (round 15): with KSIM_REPLAY_BREAKER_COOLDOWN_S
+    set, a tripped breaker admits ONE probe segment after the cooldown;
+    the injected fault is transient (first:1), so the probe dispatch
+    succeeds, the breaker closes and the rest of the run is back on the
+    device path."""
+    monkeypatch.setenv("KSIM_REPLAY_BREAKER_N", "1")
+    monkeypatch.setenv("KSIM_REPLAY_BREAKER_COOLDOWN_S", "0.05")
+    FAULTS.arm("replay.dispatch", "first:1@device")
+    runner = ScenarioRunner(
+        max_pods_per_pass=1024, pod_bucket_min=128,
+        device_replay=True, device_segment_steps=8,
+    )
+    runner.run(churn_scenario(0, n_nodes=100, n_events=1200, ops_per_step=40))
+    d = runner.replay_driver
+    assert d.breaker_probes >= 1
+    assert d.breaker_closes >= 1
+    assert d.breaker_reopens == 0
+    assert d.breaker_tripped is False
+    assert d.device_steps > 0  # post-close segments dispatched on-device
+    b = d.stats()["breaker"]
+    assert b["closes"] == d.breaker_closes
+    assert b["cooldown_current_s"] == 0.05  # close resets the ladder
+
+
+@pytest.mark.slow
+def test_breaker_failed_probes_double_cooldown(monkeypatch):
+    """A permanently dead backend: every probe fails, each failure
+    re-opens with a DOUBLED cooldown (bounded), and the run still
+    completes on the host path — recovery attempts never compromise
+    the fallback guarantee."""
+    monkeypatch.setenv("KSIM_REPLAY_BREAKER_N", "1")
+    monkeypatch.setenv("KSIM_REPLAY_BREAKER_COOLDOWN_S", "0.05")
+    FAULTS.arm("replay.dispatch", "always@device")
+    runner = ScenarioRunner(
+        max_pods_per_pass=1024, pod_bucket_min=128,
+        device_replay=True, device_segment_steps=8,
+    )
+    res = runner.run(
+        churn_scenario(0, n_nodes=100, n_events=1200, ops_per_step=40)
+    )
+    d = runner.replay_driver
+    assert d.breaker_tripped is True
+    assert d.breaker_reopens >= 1
+    assert d.breaker_closes == 0
+    assert d.device_steps == 0
+    assert d.fallback_steps == len(res.steps)
+    b = d.stats()["breaker"]
+    # Doubled at least once, never past the base * 2**reopens ladder.
+    assert b["cooldown_current_s"] >= 0.1
+    assert b["cooldown_current_s"] == pytest.approx(
+        min(0.05 * 2 ** d.breaker_reopens, 3600.0)
+    )
+
+
+def test_breaker_sticky_by_default(monkeypatch):
+    """Without KSIM_REPLAY_BREAKER_COOLDOWN_S the breaker stays sticky:
+    no probes, no closes — exactly the pre-round-15 contract."""
+    monkeypatch.setenv("KSIM_REPLAY_BREAKER_N", "1")
+    monkeypatch.delenv("KSIM_REPLAY_BREAKER_COOLDOWN_S", raising=False)
+    FAULTS.arm("replay.dispatch", "always")
+    runner = _small_runner()
+    runner.run(_small_stream())
+    d = runner.replay_driver
+    assert d.breaker_tripped is True
+    assert d.breaker_probes == 0
+    assert d.breaker_closes == 0
+    assert d.stats()["breaker"]["cooldown_s"] == 0.0
+
+
 # ---------------------------------------------------------------------------
 # Classification: programming errors must surface, not become fallbacks
 # ---------------------------------------------------------------------------
