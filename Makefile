@@ -54,9 +54,13 @@ jobs:
 # Crash-recovery verification (docs/jobs.md "Durability & recovery"):
 # the journal/AOT-cache unit matrix (torn tails, corrupt CRCs, corrupt
 # serialized executables — all hand-written bad bytes), manager replay
-# on restart, the SSE aborted-reader leak regression, and the slow
-# SIGKILL-mid-job-then-restart end-to-end (-m '' includes it).  Runs in
-# the sanitized CPU env so it works under ANY hardware condition.
+# on restart, the SSE aborted-reader leak regression, the round-16
+# incremental-resume matrix (crash after EVERY checkpoint boundary,
+# torn/corrupt checkpoint fallback, append-fault containment, gap-free
+# recovered SSE backlogs), and the two slow SIGKILL-then-restart
+# end-to-ends — interrupted-marking and checkpoint-resume on the locked
+# 6k stream (-m '' includes them).  Runs in the sanitized CPU env so it
+# works under ANY hardware condition.
 restart-check:
 	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
 	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \

@@ -635,6 +635,132 @@ def child_churn_restart(seed: int, n_nodes: int, n_events: int) -> dict:
     return out
 
 
+def child_churn_resume(
+    seed: int, n_nodes: int, n_events: int, phase: str, state_dir: str,
+    out_path: str,
+) -> dict:
+    """Incremental-resume rung (round 16, docs/jobs.md "Incremental
+    resume"): three fresh processes over ONE shared jobs dir.
+
+    ``victim`` submits the churn stream as a checkpointed device-replay
+    job, writes its evidence the moment the first segment checkpoint is
+    durable, then SIGKILLs itself — a real crash (no shutdown, no
+    flush; the journal's torn-tail rule owns whatever was mid-append).
+    ``resume`` restarts over the same dir with the resume switch on:
+    it must restore the checkpoint and replay ONLY the remaining
+    suffix.  ``scratch`` is the control — the same job, fresh in-memory
+    plane.  Both report the JOB's replay wall (compile included in
+    both, so the delta is the skipped prefix, not cache luck)."""
+    import signal as _signal
+
+    import jax
+
+    from ksim_tpu.jobs import JobManager
+    from ksim_tpu.scenario import churn_scenario, spec_from_operations
+
+    _child_setup()
+    jax.config.update("jax_enable_x64", False)
+    doc = {
+        "spec": {
+            "simulator": {
+                "deviceReplay": True,
+                "maxPodsPerPass": 1024,
+                "podBucketMin": 128,
+            },
+            "scenario": spec_from_operations(
+                list(
+                    churn_scenario(
+                        seed,
+                        n_nodes=n_nodes,
+                        n_events=n_events,
+                        ops_per_step=100,
+                    )
+                )
+            ),
+        }
+    }
+
+    def _job_record(job, wall: float) -> dict:
+        state, result, err = job.result_view()
+        rec: dict = {"job": job.id, "state": state, "error": err,
+                     "wall_s": round(wall, 2)}
+        if result:
+            rec["counts"] = [
+                result["result"]["podsScheduled"],
+                result["result"]["unschedulableAttempts"],
+            ]
+            rec["events"] = result["result"]["eventsApplied"]
+            rec["job_wall_s"] = result["result"]["wallSeconds"]
+            if result.get("resume"):
+                rec["resume"] = result["resume"]
+                rec["events_replayed"] = result["resume"]["eventsReplayed"]
+        return rec
+
+    if phase == "victim":
+        jm = JobManager(
+            workers=1, queue_limit=4, jobs_dir=state_dir, checkpoint_every=1
+        )
+        job = jm.submit(doc)
+        while True:
+            st = job.status()
+            if st["checkpoint_segment"] is not None or st["state"] in (
+                "succeeded", "failed",
+            ):
+                break
+            time.sleep(0.05)
+        out = {
+            "phase": "victim",
+            "job": job.id,
+            "state_at_kill": st["state"],
+            "checkpoint_segment": st["checkpoint_segment"],
+        }
+        out.update(_proc_watermarks())
+        print(
+            f"[churn_resume victim] checkpoint_segment="
+            f"{st['checkpoint_segment']} -> SIGKILL",
+            file=sys.stderr,
+            flush=True,
+        )
+        # The JSON must land BEFORE the crash: the parent reads it off
+        # disk regardless of our exit signal.
+        _write_json(out_path, out)
+        os.kill(os.getpid(), _signal.SIGKILL)
+        raise AssertionError("unreachable")  # pragma: no cover
+    if phase == "resume":
+        t0 = time.perf_counter()
+        jm = JobManager(
+            workers=1, queue_limit=4, jobs_dir=state_dir,
+            resume=True, checkpoint_every=0,
+        )
+        jobs = jm.jobs()
+        if len(jobs) != 1:
+            return {"error": f"resume found {len(jobs)} journaled jobs"}
+        job = jobs[0]
+        job.wait_done(CHURN_EXACT_TIMEOUT)
+        wall = time.perf_counter() - t0
+        jm.shutdown(timeout=5)
+        out = {"phase": "resume", **_job_record(job, wall)}
+        out["resumed_from"] = job.status()["resumed_from"]
+    else:
+        t0 = time.perf_counter()
+        jm = JobManager(workers=1, queue_limit=4)
+        job = jm.submit(doc)
+        job.wait_done(CHURN_EXACT_TIMEOUT)
+        wall = time.perf_counter() - t0
+        jm.shutdown(timeout=5)
+        out = {"phase": "scratch", **_job_record(job, wall)}
+    out["platform"] = jax.devices()[0].platform
+    print(
+        f"[churn_resume {phase} {n_events}ev/{n_nodes}n] "
+        f"{out.get('state')} in {out.get('wall_s')}s "
+        f"counts={out.get('counts')} "
+        f"events_replayed={out.get('events_replayed')}",
+        file=sys.stderr,
+        flush=True,
+    )
+    return out
+
+
 def child_churn_trace(
     trace_file: str, fmt: str, nodes: int, ops_per_step: int, max_events: int
 ) -> dict:
@@ -772,6 +898,15 @@ def _child_main(args: argparse.Namespace) -> None:
                 args.seed,
                 args.churn_nodes,
                 args.churn_events,
+            )
+        elif args.child == "churn_resume":
+            out = child_churn_resume(
+                args.seed,
+                args.churn_nodes,
+                args.churn_events,
+                args.resume_phase,
+                args.state_dir,
+                args.out,
             )
         elif args.child == "churn_trace":
             out = child_churn_trace(
@@ -1007,6 +1142,11 @@ def main() -> None:
     # child runs twice.
     ap.add_argument("--restart-events", type=int, default=1_000)
     ap.add_argument("--restart-nodes", type=int, default=500)
+    # Incremental-resume rung shape: the locked 6k churn prefix by
+    # default, so counts_match doubles as a behavior-lock check across
+    # the crash (docs/jobs.md "Incremental resume").
+    ap.add_argument("--resume-events", type=int, default=6_000)
+    ap.add_argument("--resume-nodes", type=int, default=2_000)
     ap.add_argument("--trace-nodes", type=int, default=24)
     ap.add_argument("--trace-ops-per-step", type=int, default=2)
     ap.add_argument("--trace-max-events", type=int, default=0)
@@ -1025,10 +1165,15 @@ def main() -> None:
         "--child",
         choices=[
             "probe", "rung", "churn", "churn_fleet", "churn_jobs",
-            "churn_trace", "churn_restart",
+            "churn_trace", "churn_restart", "churn_resume",
         ],
         default=None,
     )
+    ap.add_argument(
+        "--resume-phase", choices=["victim", "resume", "scratch"],
+        default="victim",
+    )
+    ap.add_argument("--state-dir", type=str, default="")
     ap.add_argument("--pods", type=int, default=0)
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--slice-pods", type=int, default=0)
@@ -1421,6 +1566,63 @@ def main() -> None:
             shutil.rmtree(state_dir, ignore_errors=True)
         orch.flush_partial()
 
+    def run_churn_resume_stage() -> None:
+        """Incremental-resume rung (round 16): victim (crashes after
+        its first durable checkpoint) -> resume (suffix-only replay
+        over the same jobs dir) -> scratch (the control).  The record
+        carries both walls, the events replayed vs the total, and a
+        ``counts_match`` flag — the crash-safe byte-identical-restore
+        claim (docs/jobs.md "Incremental resume") as bench evidence."""
+        if args.skip_churn or args.only:
+            return
+        if orch.remaining() < 180:
+            payload["rungs"]["churn_resume"] = {
+                "error": "skipped: budget exhausted"
+            }
+            return
+        state_dir = tempfile.mkdtemp(prefix="bench_resume_")
+        extra = [
+            "--seed", str(args.seed),
+            "--churn-events", str(args.resume_events),
+            "--churn-nodes", str(args.resume_nodes),
+            "--state-dir", state_dir,
+        ]
+        try:
+            victim = orch.run_child(
+                "churn_resume", extra + ["--resume-phase", "victim"],
+                env, CHURN_EXACT_TIMEOUT,
+            )
+            record: dict = {"victim": victim}
+            if (
+                "error" not in victim
+                and victim.get("checkpoint_segment") is not None
+                and orch.remaining() > 90
+            ):
+                resume = orch.run_child(
+                    "churn_resume", extra + ["--resume-phase", "resume"],
+                    env, CHURN_EXACT_TIMEOUT,
+                )
+                record["resume"] = resume
+                scratch = orch.run_child(
+                    "churn_resume", extra + ["--resume-phase", "scratch"],
+                    env, CHURN_EXACT_TIMEOUT,
+                )
+                record["scratch"] = scratch
+                if "error" not in resume and "error" not in scratch:
+                    rw, sw = resume.get("wall_s"), scratch.get("wall_s")
+                    if rw and sw:
+                        record["resume_speedup"] = round(sw / rw, 2)
+                    record["counts_match"] = (
+                        resume.get("counts") is not None
+                        and resume.get("counts") == scratch.get("counts")
+                    )
+                    record["events_replayed"] = resume.get("events_replayed")
+                    record["total_events"] = scratch.get("events")
+        finally:
+            shutil.rmtree(state_dir, ignore_errors=True)
+        payload["rungs"]["churn_resume"] = record
+        orch.flush_partial()
+
     def run_churn_exact_stage() -> None:
         """Bounded exact-mode (x64) churn: demonstrates in the driver
         record that the replay counts are mode- and platform-identical
@@ -1464,6 +1666,7 @@ def main() -> None:
     run_churn_jobs_stage()
     run_churn_trace_stage()
     run_churn_restart_stage()
+    run_churn_resume_stage()
     run_churn_exact_stage()
     if fallback:
         # The north-star shape still gets a measured record on CPU: the

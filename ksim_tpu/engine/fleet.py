@@ -188,6 +188,15 @@ class FleetDriver:
             active = [ln for ln in self.lanes if not ln.finished]
             if not active:
                 return
+            # Cooperative cancel (service round 4 (d)): every lane
+            # runner carries the PARENT run's cancel flag, so one check
+            # per round — the lane dispatch boundary — raises
+            # RunCancelled before the next shared lowering; a cancel
+            # landing later, mid-segment, aborts inside that lane's
+            # reconcile transaction instead (per-lane rollback, the
+            # solo semantics), and the exception ladders below
+            # deliberately do not catch it.
+            active[0].runner._check_cancelled()
             for ln in active:
                 ln.round_reason = None
             cohort = [ln for ln in active if ln.convergent]

@@ -1260,6 +1260,14 @@ class ReplayDriver:
 
         register_provider("replay", _stats)
 
+    @property
+    def segment_seq(self) -> int:
+        """Segments lowered so far (the trace-correlation counter).  The
+        job plane's checkpoint cadence keys off this — a restored run's
+        driver restarts at 0, which only re-bases span tags, never the
+        schedule (docs/jobs.md "Incremental resume")."""
+        return self._segment_seq
+
     def stats(self) -> dict:
         """Degradation evidence for runner stats / the bench JSON."""
         feat = self._featurizer

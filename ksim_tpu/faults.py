@@ -86,6 +86,8 @@ SITES: tuple[str, ...] = (
     "jobs.run",
     "jobs.journal_append",
     "jobs.journal_replay",
+    "jobs.checkpoint_append",
+    "jobs.checkpoint_restore",
 )
 
 

@@ -10,6 +10,7 @@ from ksim_tpu.jobs.manager import (
     Job,
     JobLimitExceeded,
     JobManager,
+    JobThrottled,
     parse_job_faults,
 )
 from ksim_tpu.jobs.queue import JobQueue, JobQueueFull
@@ -23,5 +24,6 @@ __all__ = [
     "JobManager",
     "JobQueue",
     "JobQueueFull",
+    "JobThrottled",
     "parse_job_faults",
 ]

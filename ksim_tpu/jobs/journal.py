@@ -26,7 +26,19 @@ record type:
 - ``result``: id, and the full result document (served byte-identically
   after a restart);
 - ``cancel``: id, ts (the cancel REQUEST; the resulting terminal state
-  arrives as its own ``state`` record).
+  arrives as its own ``state`` record);
+- ``checkpoint``: id, seq, cursor (committed step-key index), segment,
+  and the exact-state restore payload — ``store``
+  (``ClusterStore.checkpoint()``: objects verbatim + rv counter +
+  mutation epoch) plus ``service`` (backoff / pass counter /
+  featurizer slot order / pnts carries) and the partial ``result``
+  accounting (docs/jobs.md "Incremental resume").  Appended by the job
+  worker after committed segment reconciles, throttled by
+  ``KSIM_JOBS_CHECKPOINT_EVERY``; ``KSIM_JOBS_RESUME=1`` restores from
+  the NEWEST valid checkpoint and replays only the remaining suffix.
+  The torn-tail rule already gives checkpoint fallback for free: a
+  record torn mid-append truncates away, so recovery sees the previous
+  intact checkpoint.
 
 Recovery is torn-tail tolerant: a process killed mid-append leaves a
 partial (or checksum-failing) final line, and ``replay`` truncates the
@@ -34,7 +46,9 @@ file at the last valid record instead of crashing — corruption can lose
 the torn tail, never the journal.  Compaction (``maybe_compact``)
 bounds the file: past ``KSIM_JOBS_JOURNAL_MAX_BYTES`` the live registry
 is rewritten as a snapshot (atomic tmp-file + fsync + rename), dropping
-records of jobs the retention policy already pruned.
+records of jobs the retention policy already pruned and keeping only
+the NEWEST checkpoint per live job (older checkpoints are dead weight
+once a newer one is durable).
 
 The module is stdlib-only and jax-free: recovery must work in a fresh
 process whose backend may be wedged (the whole point of restarting).

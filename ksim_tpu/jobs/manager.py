@@ -55,16 +55,42 @@ results serve byte-identically, jobs that died mid-run surface as
 ``interrupted`` (or re-enqueue under ``KSIM_JOBS_RESUME=1``).  Unset,
 the plane is exactly the in-memory-only plane of rounds 13–14.
 
+Incremental resume (round 16, docs/jobs.md "Incremental resume"): a
+solo device-replay job also journals SEGMENT CHECKPOINTS — every
+``KSIM_JOBS_CHECKPOINT_EVERY`` committed segment reconciles, one
+``checkpoint`` record carries the exact store state
+(``ClusterStore.checkpoint``), the event-stream cursor, the service's
+determinism carries (pass counter, backoff map, featurizer slot order,
+pnts rotation) and the partial result accounting.  Under
+``KSIM_JOBS_RESUME=1`` the worker restores from the NEWEST valid
+checkpoint and replays only the remaining suffix, byte-identical to an
+uninterrupted run; an unusable checkpoint falls back to the previous
+one, then scratch.  Checkpoints are best-effort by policy: a
+non-restorable moment (Permit-waiting pods), an oversized snapshot
+(``KSIM_JOBS_CHECKPOINT_MAX_BYTES``) or an append failure SKIPS the
+checkpoint with a counted ``jobs.checkpoint`` event — never fails the
+job.
+
+Tenancy (round 16, ROADMAP service round 4 (c)): submissions carry a
+tenant label (HTTP ``X-Ksim-Tenant`` or ``spec.tenant``; default
+``default``) and the operator may bound each tenant's concurrency
+(``KSIM_JOBS_TENANT_MAX_ACTIVE``) and sustained submission rate
+(``KSIM_JOBS_TENANT_RATE``, a token bucket) — over either bound the
+submit raises ``JobThrottled`` (HTTP 429 with a ``Retry-After`` hint).
+
 Environment (docs/env.md "Job plane"): ``KSIM_JOBS_WORKERS``,
 ``KSIM_JOBS_QUEUE``, ``KSIM_JOBS_RING``, ``KSIM_JOBS_KEEP``,
 ``KSIM_JOBS_EVENTS``, ``KSIM_JOBS_FAULTS``, ``KSIM_JOBS_MAX_EVENTS``,
-``KSIM_JOBS_MAX_NODES``, ``KSIM_JOBS_SJF_BYPASS``; durability:
-``KSIM_JOBS_DIR``, ``KSIM_JOBS_RESUME``,
-``KSIM_JOBS_JOURNAL_MAX_BYTES``.
+``KSIM_JOBS_MAX_NODES``, ``KSIM_JOBS_SJF_BYPASS``,
+``KSIM_JOBS_TENANT_MAX_ACTIVE``, ``KSIM_JOBS_TENANT_RATE``;
+durability: ``KSIM_JOBS_DIR``, ``KSIM_JOBS_RESUME``,
+``KSIM_JOBS_JOURNAL_MAX_BYTES``, ``KSIM_JOBS_CHECKPOINT_EVERY``,
+``KSIM_JOBS_CHECKPOINT_MAX_BYTES``.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -85,6 +111,7 @@ __all__ = [
     "JobLimitExceeded",
     "JobManager",
     "JobQueueFull",
+    "JobThrottled",
     "parse_job_faults",
 ]
 
@@ -93,6 +120,19 @@ class JobLimitExceeded(Exception):
     """A submission exceeded the operator's per-job resource bounds
     (``KSIM_JOBS_MAX_EVENTS`` / ``KSIM_JOBS_MAX_NODES``) — HTTP 413
     upstream, with this message as the reason body."""
+
+
+class JobThrottled(Exception):
+    """A tenant is over its admission bound — the concurrency quota
+    (``KSIM_JOBS_TENANT_MAX_ACTIVE``) or the submission-rate token
+    bucket (``KSIM_JOBS_TENANT_RATE``).  HTTP 429 upstream with
+    ``retry_after`` (seconds) as the ``Retry-After`` header: the bucket
+    knows exactly when the next token lands, so the hint is a real
+    schedule, not a guess."""
+
+    def __init__(self, msg: str, *, retry_after: float) -> None:
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 #: Final job states (no transitions out).  ``interrupted`` is
 #: recovery-only: the journal saw the job queued/running when the
@@ -270,6 +310,7 @@ class Job:
         ring_cap: int,
         max_events: int,
         faults: "FaultPlane | None",
+        tenant: str = "default",
     ) -> None:
         self.id = job_id
         self.ordinal = ordinal
@@ -277,6 +318,7 @@ class Job:
         self.sim = sim
         self.priority = priority
         self.faults = faults
+        self.tenant = tenant
         self.cancel = threading.Event()
         self.created = time.time()
         self.steps_total = len({op.step for op in ops})
@@ -307,6 +349,16 @@ class Job:
         # them; None for queued jobs).
         self.store = None
         self.runner = None
+        # Incremental resume (docs/jobs.md): the journaled checkpoint
+        # records recovery stashed for the worker's restore attempt
+        # (single-threaded: written before the workers start, read only
+        # by the one worker that claims the job), the NEWEST durable
+        # checkpoint (re-emitted by compaction), and the status fields.
+        self.checkpoints: list[dict] = []
+        self._last_checkpoint: "dict | None" = None  # guarded-by: _cond
+        self.checkpoint_segment: "int | None" = None  # guarded-by: _cond
+        self.resumed_from: "int | None" = None  # guarded-by: _cond
+        self._resume_info: "dict | None" = None  # worker-thread only
 
     # -- event log (the SSE source) --------------------------------------
 
@@ -448,6 +500,7 @@ class Job:
                 "id": self.id,
                 "state": self.state,
                 "priority": self.priority,
+                "tenant": self.tenant,
                 "created": round(self.created, 3),
                 "started": round(self.started, 3) if self.started else None,
                 "finished": round(self.finished, 3) if self.finished else None,
@@ -459,6 +512,8 @@ class Job:
                 "events_dropped": self._dropped,
                 "sse_listeners": self.sse_listeners,
                 "cancel_requested": self.cancel.is_set(),
+                "checkpoint_segment": self.checkpoint_segment,
+                "resumed_from": self.resumed_from,
                 "error": self.error,
             }
 
@@ -527,6 +582,10 @@ class JobManager:
         jobs_dir: "str | None" = None,
         resume: "bool | None" = None,
         journal_max_bytes: "int | None" = None,
+        checkpoint_every: "int | None" = None,
+        checkpoint_max_bytes: "int | None" = None,
+        tenant_max_active: "int | None" = None,
+        tenant_rate: "float | None" = None,
     ) -> None:
         env = os.environ
         if workers is None:
@@ -552,6 +611,25 @@ class JobManager:
             jobs_dir = env.get("KSIM_JOBS_DIR", "")
         if resume is None:
             resume = env.get("KSIM_JOBS_RESUME", "") == "1"
+        if checkpoint_every is None:
+            checkpoint_every = int(env.get("KSIM_JOBS_CHECKPOINT_EVERY", "8"))
+        if checkpoint_max_bytes is None:
+            checkpoint_max_bytes = int(
+                env.get("KSIM_JOBS_CHECKPOINT_MAX_BYTES", str(64 * 1024 * 1024))
+            )
+        if tenant_max_active is None:
+            tenant_max_active = int(env.get("KSIM_JOBS_TENANT_MAX_ACTIVE", "0"))
+        if tenant_rate is None:
+            tenant_rate = float(env.get("KSIM_JOBS_TENANT_RATE", "0"))
+        # Checkpoint cadence/bounds (0 = off / unbounded) and tenant
+        # admission bounds (0 = off) — docs/env.md "Job plane".
+        self._checkpoint_every = max(int(checkpoint_every), 0)
+        self._checkpoint_max_bytes = max(int(checkpoint_max_bytes), 0)
+        self._tenant_max_active = max(int(tenant_max_active), 0)
+        self._tenant_rate = max(float(tenant_rate), 0.0)
+        # tenant -> token-bucket + counters (jobs section of the merged
+        # metrics document).
+        self._tenants: dict[str, dict] = {}  # guarded-by: _lock
         self._ring_cap = max(ring_cap, 16)
         self._keep = max(keep, 1)
         self._max_events = max(max_events, 64)
@@ -627,7 +705,7 @@ class JobManager:
             st = j.status()
             recs.append({
                 "t": "submit", "id": j.id, "ordinal": j.ordinal,
-                "priority": j.priority, "doc": j.doc,
+                "priority": j.priority, "tenant": j.tenant, "doc": j.doc,
                 "created": round(j.created, 3),
             })
             if st["started"]:
@@ -635,6 +713,14 @@ class JobManager:
                     "t": "state", "id": j.id, "state": "running",
                     "ts": st["started"],
                 })
+            if st["state"] not in TERMINAL_STATES:
+                # A LIVE job keeps exactly its newest durable checkpoint
+                # (older ones are dead weight once a newer one exists);
+                # terminal jobs keep none — their result is the record.
+                with j._cond:
+                    ck = j._last_checkpoint
+                if ck is not None:
+                    recs.append(ck)
             if st["state"] in TERMINAL_STATES:
                 _, result, _ = j.result_view()
                 if result is not None:
@@ -678,6 +764,7 @@ class JobManager:
                 "submit": None, "state": None, "error": None,
                 "result": None, "cancel": False,
                 "started": None, "finished": None,
+                "checkpoints": [], "history": [],
             })
             if t == "submit":
                 ent["submit"] = rec
@@ -688,10 +775,19 @@ class JobManager:
                     ent["started"] = rec.get("ts")
                 elif state in TERMINAL_STATES:
                     ent["finished"] = rec.get("ts")
+                # The full transition history, in journal order — the
+                # resumed job's SSE backlog replays it so a reconnecting
+                # tenant's stream is gap-free across the restart.
+                ent["history"].append({
+                    "state": state, "ts": rec.get("ts"),
+                    "error": rec.get("error"),
+                })
             elif t == "result":
                 ent["result"] = rec.get("result")
             elif t == "cancel":
                 ent["cancel"] = True
+            elif t == "checkpoint":
+                ent["checkpoints"].append(rec)
         interrupted = resumed = 0
         max_ordinal = -1
         for jid, ent in folded.items():
@@ -713,7 +809,7 @@ class JobManager:
                     or ent["state"] == "interrupted"
                 )
                 if resumable and resume:
-                    job = self._resume_job(jid, ordinal, priority, sub)
+                    job = self._resume_job(jid, ordinal, priority, sub, ent)
                     if job is not None:
                         resumed += 1
                 if job is None:
@@ -740,6 +836,7 @@ class JobManager:
         job = Job(
             jid, ordinal, [], {}, priority,
             ring_cap=self._ring_cap, max_events=self._max_events, faults=None,
+            tenant=str(sub.get("tenant") or "default"),
         )
         job.doc = sub.get("doc")
         state = ent["state"]
@@ -763,12 +860,14 @@ class JobManager:
         return job
 
     def _resume_job(
-        self, jid: str, ordinal: int, priority: int, sub: dict
+        self, jid: str, ordinal: int, priority: int, sub: dict, ent: dict
     ) -> "Job | None":
         """KSIM_JOBS_RESUME=1: re-parse the journaled spec and re-enqueue
-        the died-mid-run job under its original id/ordinal.  None when
-        the spec no longer parses or the queue is full — the caller
-        falls back to ``interrupted`` (recovery never crashes startup)."""
+        the died-mid-run job under its original id/ordinal, carrying its
+        journaled checkpoints for the worker's incremental restore.
+        None when the spec no longer parses or the queue is full — the
+        caller falls back to ``interrupted`` (recovery never crashes
+        startup)."""
         try:
             ops, sim, _, fault_spec = _parse_job_spec(sub.get("doc"))
             entries = list(self._fault_specs.get(ordinal, ()))
@@ -782,9 +881,25 @@ class JobManager:
             job = Job(
                 jid, ordinal, ops, sim, priority,
                 ring_cap=self._ring_cap, max_events=self._max_events,
-                faults=faults,
+                faults=faults, tenant=str(sub.get("tenant") or "default"),
             )
             job.doc = sub.get("doc")
+            # Gap-free SSE across the restart: replay the journaled
+            # lifecycle transitions into the fresh event log FIRST, so a
+            # reconnecting tenant streaming from index 0 sees the
+            # pre-restart history (queued→running→...) ahead of the
+            # re-enqueue — not a log that starts mid-life.
+            for h in ent.get("history", ()):
+                ev = {"event": "state", "state": h["state"], "recovered": True}
+                if h.get("error"):
+                    ev["error"] = h["error"]
+                job.emit(ev, vital=True)
+            job.checkpoints = list(ent.get("checkpoints", ()))
+            if job.checkpoints:
+                last = job.checkpoints[-1]
+                with job._cond:
+                    job._last_checkpoint = last
+                    job.checkpoint_segment = last.get("segment")
             job.emit({"event": "state", "state": "queued", "resumed": True},
                      vital=True)
             self.queue.put(job, priority=priority, cost=len(ops))
@@ -795,12 +910,22 @@ class JobManager:
 
     # -- submission ------------------------------------------------------
 
-    def submit(self, doc: Any, *, priority: "int | None" = None) -> Job:
+    def submit(
+        self,
+        doc: Any,
+        *,
+        priority: "int | None" = None,
+        tenant: "str | None" = None,
+    ) -> Job:
         """Validate + enqueue one tenant job document.  Raises
         ``ScenarioSpecError`` on a bad spec (HTTP 400),
         ``JobLimitExceeded`` when the spec exceeds the operator's
-        per-job bounds (HTTP 413), and ``JobQueueFull`` on a saturated
-        queue (HTTP 429).
+        per-job bounds (HTTP 413), ``JobThrottled`` when the tenant is
+        over its quota/rate (HTTP 429 + Retry-After), and
+        ``JobQueueFull`` on a saturated queue (HTTP 429).
+
+        ``tenant`` (the HTTP layer's ``X-Ksim-Tenant`` header) wins
+        over ``spec.tenant``; absent both, jobs pool under ``default``.
 
         The submission ordinal (the ``KSIM_JOBS_FAULTS`` key) commits
         only on a SUCCESSFUL enqueue: a refused submission must not
@@ -813,6 +938,9 @@ class JobManager:
         ops, sim, spec_priority, fault_spec = _parse_job_spec(doc)
         if priority is None:
             priority = spec_priority
+        if tenant is None:
+            scope = (doc.get("spec") or doc) if isinstance(doc, dict) else {}
+            tenant = str(scope.get("tenant") or "") or "default"
         # Resource bounds, AFTER parsing/ingestion: what is measured is
         # the stream the job would actually replay (a trace-sourced job
         # is bounded by its compiled size, not its reference's).
@@ -832,6 +960,11 @@ class JobManager:
                     f"bound of {self._max_job_nodes} (KSIM_JOBS_MAX_NODES)"
                 )
         with self._lock:
+            # Tenant admission BEFORE the ordinal reservation: a
+            # throttled submission must not shift which job an armed
+            # KSIM_JOBS_FAULTS ordinal lands on, same as every other
+            # refusal in this block.
+            self._admit_tenant_locked(tenant)
             ordinal = self._seq
             # The job's private plane is built FRESH per submission from
             # the operator's per-ordinal schedules plus the spec's own
@@ -872,6 +1005,7 @@ class JobManager:
                 ring_cap=self._ring_cap,
                 max_events=self._max_events,
                 faults=faults,
+                tenant=tenant,
             )
             # The queued event lands BEFORE the queue hand-off: once
             # put() returns, a worker may claim (and emit "running")
@@ -894,7 +1028,7 @@ class JobManager:
         if self._journal is not None:
             ok = self._journal_append({
                 "t": "submit", "id": job.id, "ordinal": job.ordinal,
-                "priority": priority, "doc": doc,
+                "priority": priority, "tenant": job.tenant, "doc": doc,
                 "created": round(job.created, 3),
             })
             if ok:
@@ -906,6 +1040,56 @@ class JobManager:
         )
         self._maybe_compact()
         return job
+
+    def _admit_tenant_locked(self, tenant: str) -> None:  # ksimlint: lock-held(_lock)
+        """Per-tenant admission (ROADMAP service round 4 (c)): the
+        concurrency quota counts the tenant's non-terminal jobs in the
+        registry; the rate limit is a token bucket refilled at
+        ``KSIM_JOBS_TENANT_RATE`` tokens/s with burst
+        ``max(rate, 1)``.  Raises ``JobThrottled`` with a computed
+        ``retry_after`` — for the bucket it is exactly the time until
+        the next token, for the quota a fixed re-poll hint (job
+        durations are unknowable at admission)."""
+        ent = self._tenants.get(tenant)
+        if ent is None:
+            ent = self._tenants[tenant] = {
+                "tokens": max(self._tenant_rate, 1.0),
+                "last": time.monotonic(),
+                "admitted": 0,
+                "throttled": 0,
+            }
+        if self._tenant_max_active:
+            active = sum(
+                1
+                for j in self._jobs.values()
+                if j.tenant == tenant
+                and j.status()["state"] not in TERMINAL_STATES
+            )
+            if active >= self._tenant_max_active:
+                ent["throttled"] += 1
+                raise JobThrottled(
+                    f"tenant {tenant!r} has {active} active jobs, at the "
+                    f"per-tenant bound of {self._tenant_max_active} "
+                    "(KSIM_JOBS_TENANT_MAX_ACTIVE)",
+                    retry_after=5.0,
+                )
+        if self._tenant_rate:
+            now = time.monotonic()
+            burst = max(self._tenant_rate, 1.0)
+            ent["tokens"] = min(
+                burst, ent["tokens"] + (now - ent["last"]) * self._tenant_rate
+            )
+            ent["last"] = now
+            if ent["tokens"] < 1.0:
+                ent["throttled"] += 1
+                raise JobThrottled(
+                    f"tenant {tenant!r} is over the sustained submission "
+                    f"rate of {self._tenant_rate:g}/s "
+                    "(KSIM_JOBS_TENANT_RATE)",
+                    retry_after=(1.0 - ent["tokens"]) / self._tenant_rate,
+                )
+            ent["tokens"] -= 1.0
+        ent["admitted"] += 1
 
     def _prune_locked(self) -> None:  # ksimlint: lock-held(_lock)
         """Bound the registry: drop the oldest TERMINAL jobs beyond the
@@ -1003,7 +1187,20 @@ class JobManager:
                 fleet=int(fleet),
                 cancel=job.cancel,
             )
-        else:
+            job.runner = runner
+            res = runner.run(job.ops)
+            return res, runner
+        # Solo path: restore from the newest valid journaled checkpoint
+        # when recovery carried any (KSIM_JOBS_RESUME=1), else build
+        # fresh; either way the runner gets the checkpoint-cadence hook.
+        resume_cursor = 0
+        resume_result = None
+        store = service = None
+        if job.checkpoints:
+            restored = self._restore_checkpoint(job, sim)
+            if restored is not None:
+                store, service, resume_cursor, resume_result = restored
+        if store is None:
             store = ClusterStore()
             if sim.get("initialSnapshot"):
                 from ksim_tpu.state.snapshot import SnapshotService
@@ -1017,17 +1214,192 @@ class JobManager:
                 max_pods_per_pass=sim.get("maxPodsPerPass"),
                 pod_bucket_min=sim.get("podBucketMin"),
             )
-            runner = ScenarioRunner(
-                store=store,
-                service=service,
-                device_replay=bool(sim.get("deviceReplay", False)),
-                cancel=job.cancel,
-                private_faults=job.faults,
-            )
-            job.store = store
+        hook = None
+        if self._journal is not None and self._checkpoint_every > 0:
+            hook = self._checkpoint_hook_for(job, store, service)
+        runner = ScenarioRunner(
+            store=store,
+            service=service,
+            device_replay=bool(sim.get("deviceReplay", False)),
+            cancel=job.cancel,
+            private_faults=job.faults,
+            checkpoint_hook=hook,
+        )
+        job.store = store
         job.runner = runner
-        res = runner.run(job.ops)
+        res = runner.run(
+            job.ops, resume_cursor=resume_cursor, resume_result=resume_result
+        )
         return res, runner
+
+    def _checkpoint_hook_for(self, job: Job, store, service):
+        """The runner's post-commit segment callback: every
+        ``KSIM_JOBS_CHECKPOINT_EVERY``-th COMMITTED segment appends one
+        checkpoint record.  Committed segments are counted here (not
+        ``segment_seq``, which also counts segments that later rolled
+        back) so the cadence is exactly "every N durable advances"."""
+        state = {"committed": 0, "seq": 0}
+
+        def hook(cursor: int, driver, result) -> None:
+            state["committed"] += 1
+            if state["committed"] % self._checkpoint_every:
+                return
+            state["seq"] += 1
+            self._append_checkpoint(
+                job, store, service, cursor, driver, result, state["seq"]
+            )
+
+        return hook
+
+    def _append_checkpoint(
+        self, job: Job, store, service, cursor: int, driver, result, seq: int
+    ) -> None:
+        """Build + durably append one segment checkpoint.  Best-effort
+        by contract: a non-restorable moment (Permit-waiting pods), an
+        oversized snapshot, or any append/snapshot failure SKIPS the
+        checkpoint with a counted ``jobs.checkpoint`` event — the run
+        itself must never degrade because its insurance did."""
+        try:
+            with TRACE.span(
+                "jobs.checkpoint_append", job=job.id, cursor=cursor
+            ):
+                FAULTS.check("jobs.checkpoint_append")
+                carries = service.checkpoint_carries()
+                if carries.pop("waiting"):
+                    # Pods parked in a Permit plugin's waiting map are
+                    # scheduling state with no restore story — resuming
+                    # without them would double-admit or drop them.
+                    TRACE.event(
+                        "jobs.checkpoint", job=job.id,
+                        skipped=True, reason="waiting_pods",
+                    )
+                    return
+                rec = {
+                    "t": "checkpoint",
+                    "id": job.id,
+                    "seq": seq,
+                    "cursor": int(cursor),
+                    "segment": int(driver.segment_seq),
+                    "store": store.checkpoint(),
+                    "service": carries,
+                    "result": {
+                        "events_applied": result.events_applied,
+                        "pods_scheduled": result.pods_scheduled,
+                        "unschedulable_attempts": result.unschedulable_attempts,
+                        "steps": [
+                            [
+                                s.step, s.ops_applied, s.scheduled,
+                                s.unschedulable, s.pending_after,
+                            ]
+                            for s in result.steps
+                        ],
+                    },
+                    "ts": round(time.time(), 3),
+                }
+                size = len(json.dumps(rec, separators=(",", ":")))
+                if self._checkpoint_max_bytes and size > self._checkpoint_max_bytes:
+                    TRACE.event(
+                        "jobs.checkpoint", job=job.id, skipped=True,
+                        reason="max_bytes", bytes=size,
+                    )
+                    return
+                if not self._journal_append(rec):
+                    TRACE.event(
+                        "jobs.checkpoint", job=job.id,
+                        skipped=True, reason="append_failed",
+                    )
+                    return
+                with job._cond:
+                    job._last_checkpoint = rec
+                    job.checkpoint_segment = rec["segment"]
+                TRACE.event(
+                    "jobs.checkpoint", job=job.id, cursor=cursor,
+                    segment=rec["segment"], bytes=size,
+                )
+        except Exception:
+            # Injected jobs.checkpoint_append faults and unexpected
+            # snapshot failures land here: counted, contained, the run
+            # continues (and retries at the next cadence point).
+            logger.exception("job %s checkpoint append failed", job.id)
+            TRACE.event(
+                "jobs.checkpoint", job=job.id,
+                skipped=True, reason="append_failed",
+            )
+
+    def _restore_checkpoint(self, job: Job, sim: dict):
+        """Newest-first restore attempts over the job's journaled
+        checkpoints (worker thread — the only place the jax/scheduler
+        stack may load).  Returns (store, service, cursor, partial
+        result) or None (every checkpoint unusable → replay from
+        scratch).  A failed attempt falls back to the PREVIOUS
+        checkpoint: the mid-file analogue of the journal's torn-tail
+        rule, which already drops a checkpoint torn mid-append before
+        recovery ever sees it."""
+        from ksim_tpu.scenario.runner import ScenarioResult, StepResult
+        from ksim_tpu.scheduler.service import SchedulerService
+        from ksim_tpu.state.cluster import ClusterStore
+
+        for rec in reversed(job.checkpoints):
+            seg = rec.get("segment")
+            try:
+                with TRACE.span(
+                    "jobs.checkpoint_restore", job=job.id, segment=seg
+                ):
+                    FAULTS.check("jobs.checkpoint_restore")
+                    store = ClusterStore.from_checkpoint(rec["store"])
+                    # The service rebuilds from the SPEC (its config is
+                    # deterministic given the document); the
+                    # initialSnapshot is deliberately NOT re-loaded —
+                    # its objects are already inside the restored store.
+                    service = SchedulerService(
+                        store,
+                        config=sim.get("schedulerConfig"),
+                        record=sim.get("recordMode", "selection"),
+                        preemption=bool(sim.get("preemption", False)),
+                        max_pods_per_pass=sim.get("maxPodsPerPass"),
+                        pod_bucket_min=sim.get("podBucketMin"),
+                    )
+                    service.restore_carries(rec.get("service") or {})
+                    acc = rec.get("result") or {}
+                    result = ScenarioResult(
+                        events_applied=int(acc.get("events_applied", 0)),
+                        pods_scheduled=int(acc.get("pods_scheduled", 0)),
+                        unschedulable_attempts=int(
+                            acc.get("unschedulable_attempts", 0)
+                        ),
+                    )
+                    for row in acc.get("steps") or ():
+                        result.steps.append(
+                            StepResult(*[int(v) for v in row])
+                        )
+                    cursor = int(rec["cursor"])
+            except Exception as e:
+                logger.exception(
+                    "job %s checkpoint (segment %s) unusable; falling "
+                    "back to the previous one", job.id, seg,
+                )
+                TRACE.event(
+                    "jobs.checkpoint_restore", job=job.id, restored=False,
+                    segment=seg, error=type(e).__name__,
+                )
+                continue
+            TRACE.event(
+                "jobs.checkpoint_restore", job=job.id, restored=True,
+                segment=seg, cursor=cursor,
+            )
+            with job._cond:
+                job.resumed_from = seg
+                job.checkpoint_segment = seg
+                # The progress baseline: the restored steps are done,
+                # only suffix segments/passes add to it from here.
+                job.steps_done = len(result.steps)
+            job._resume_info = {
+                "fromSegment": seg,
+                "cursor": cursor,
+                "carried_events": result.events_applied,
+            }
+            return store, service, cursor, result
+        return None
 
     def _result_doc(self, job: Job, res, runner) -> dict:
         doc: dict = {
@@ -1048,6 +1420,16 @@ class JobManager:
             doc["lanes"] = [
                 [r.pods_scheduled, r.unschedulable_attempts] for r in res.lanes
             ]
+        info = job._resume_info
+        if info is not None:
+            # eventsReplayed counts only THIS process's suffix — the
+            # restart-check/bench evidence that an incremental resume
+            # did strictly less work than a from-scratch replay.
+            doc["resume"] = {
+                "fromSegment": info["fromSegment"],
+                "cursor": info["cursor"],
+                "eventsReplayed": res.events_applied - info["carried_events"],
+            }
         drv = getattr(runner, "replay_driver", None)
         if drv is not None:
             doc["replay"] = drv.stats()  # includes the shared compile_cache
@@ -1101,9 +1483,18 @@ class JobManager:
         with self._lock:
             jobs = list(self._jobs.values())
             active = self._active
+            tenants = {
+                t: {
+                    "admitted": e["admitted"],
+                    "throttled": e["throttled"],
+                    "tokens": round(e["tokens"], 3),
+                }
+                for t, e in self._tenants.items()
+            }
         doc = {
             "queue": self.queue.stats(),
             "workers": {"pool": len(self._threads), "active": active},
+            "tenants": tenants,
             "jobs": {
                 j.id: dict(j.status(), trace=j.trace_summary()) for j in jobs
             },

@@ -105,6 +105,14 @@ SPAN_NAMES: tuple[str, ...] = (
     #                         record behind every submission/transition)
     "jobs.journal_replay",  # one startup journal replay: scan + torn-
     #                         tail truncation + registry reconstruction
+    "jobs.checkpoint_append",  # one segment-checkpoint record built and
+    #                            durably appended to the job journal
+    #                            (ksim_tpu/jobs/manager.py; wraps the
+    #                            nested jobs.journal_append span)
+    "jobs.checkpoint_restore",  # one restore attempt from a journaled
+    #                             checkpoint: store + service carries
+    #                             reconstructed on the worker thread
+    #                             before the suffix replay
 )
 
 #: Instant event names.
@@ -147,6 +155,15 @@ EVENT_NAMES: tuple[str, ...] = (
     "jobs.journal_recover",  # startup journal replay reconstructed the
     #                          job registry (args: jobs / interrupted /
     #                          resumed / truncated_bytes)
+    "jobs.checkpoint",  # segment-checkpoint cadence outcome: written
+    #                     (args: job / segment / cursor / bytes) or
+    #                     skipped (args.skipped=True, args.reason:
+    #                     max_bytes / waiting_pods / append_failed —
+    #                     a skip never fails the job)
+    "jobs.checkpoint_restore",  # restore-from-checkpoint outcome
+    #                             (args.restored True/False; a failed
+    #                             attempt falls back to the previous
+    #                             checkpoint, then to scratch)
 )
 
 _KNOWN_NAMES = frozenset(SPAN_NAMES) | frozenset(EVENT_NAMES)

@@ -101,6 +101,7 @@ class ScenarioRunner:
         fleet_faults: str | None = None,
         cancel: "Any | None" = None,
         private_faults: "Any | None" = None,
+        checkpoint_hook: "Any | None" = None,
     ) -> None:
         """``device_replay=True`` routes supported step segments through
         the device-resident path (engine/replay.py): K steps of event
@@ -130,6 +131,17 @@ class ScenarioRunner:
         THIS run alone while concurrent runs in the same process stay
         healthy.  Mutually exclusive with ``fleet`` (use
         ``fleet_faults`` there).
+
+        ``checkpoint_hook`` (the job plane's incremental-resume cadence,
+        ksim_tpu/jobs/manager.py) is called as ``hook(cursor, driver,
+        result)`` after every COMMITTED device segment — cursor is the
+        index into the sorted step keys the next iteration starts from,
+        i.e. exactly the ``resume_cursor`` a later ``run`` needs to
+        replay only the remaining suffix.  The hook runs outside the
+        store transaction (the segment is fully committed; a mid-hook
+        crash loses at most the not-yet-journaled checkpoint, never
+        store integrity) and must not raise for policy reasons — skip
+        internally and return.
 
         ``fleet=S`` (requires ``device_replay=True``) replays S
         INDEPENDENT trajectories — each with its own store, service and
@@ -196,6 +208,10 @@ class ScenarioRunner:
         self._lane_faults = private_faults
         # Cooperative cancellation flag (Event-like; see __init__ doc).
         self._cancel = cancel
+        # Post-commit segment callback (job-plane checkpoints; see
+        # __init__ doc).  None for fleet lanes — cohort segments commit
+        # lane-by-lane and a per-lane cursor is not a resume point.
+        self._checkpoint_hook = checkpoint_hook
         # The last run's ReplayDriver (evidence counters: device_steps,
         # fallback_steps, device_round_trips, unsupported reasons).
         self.replay_driver = None
@@ -511,6 +527,8 @@ class ScenarioRunner:
         ops: Iterable[Operation],
         *,
         lane_ops: "dict[int, Iterable[Operation]] | None" = None,
+        resume_cursor: int = 0,
+        resume_result: "ScenarioResult | None" = None,
     ) -> ScenarioResult:
         """Apply operations grouped by step; one scheduling pass per step
         (every pending pod is attempted each pass, like the upstream
@@ -518,15 +536,31 @@ class ScenarioRunner:
         supported K-step segments run as single device dispatches (see
         engine/replay.py); everything else takes this per-pass loop.
 
+        ``resume_cursor``/``resume_result`` are the incremental-resume
+        entry (docs/jobs.md): replay starts at sorted-step-key index
+        ``resume_cursor`` — the cursor a ``checkpoint_hook`` reported —
+        accumulating into ``resume_result`` (the checkpoint's partial
+        accounting) instead of a fresh result.  The caller owns restoring
+        the matching store/service state first; given that, the suffix
+        replay is byte-identical to the uninterrupted run's tail (the
+        restored store carries the exact rv counter and mutation epoch,
+        the service its pass/backoff/slot-order carries).
+        ``wall_seconds`` covers only THIS process's replay.
+
         With ``fleet=S`` the stream replays on every lane (``lane_ops``
         overrides individual lanes' streams — those lanes run the solo
         device path, outside the shared-universe cohort) and the result
         carries the per-lane results on ``.lanes``."""
         if self._fleet is not None:
+            if resume_cursor or resume_result is not None:
+                raise ValueError(
+                    "incremental resume is the solo-run path; fleet runs "
+                    "restart from scratch (no per-lane cursor yet)"
+                )
             return self._run_fleet(ops, lane_ops)
         if lane_ops:
             raise ValueError("lane_ops requires fleet=S")
-        result = ScenarioResult()
+        result = resume_result if resume_result is not None else ScenarioResult()
         # Per-phase wall-clock split rides on the trace plane's latency
         # histograms; timing-only mode costs two clock reads per span at
         # segment/pass granularity and never touches scheduling state
@@ -547,7 +581,7 @@ class ScenarioRunner:
                 lane_faults=self._lane_faults,
             )
             self.replay_driver = driver
-        i = 0
+        i = resume_cursor
         while i < len(keys):
             self._check_cancelled()
             if driver is not None:
@@ -574,6 +608,8 @@ class ScenarioRunner:
                     result,
                 ):
                     i += len(seg.steps)
+                    if self._checkpoint_hook is not None:
+                        self._checkpoint_hook(i, driver, result)
                     continue
             step = keys[i]
             if driver is not None:
@@ -611,9 +647,11 @@ class ScenarioRunner:
         are byte-identical to its solo ``device_replay=True`` run."""
         import os
 
-        # Fleet runs cancel at the submission boundary only (the cohort
-        # dispatch has no per-lane abort point yet — ROADMAP "fleet
-        # round 2"); a flag set mid-run is honored by the NEXT run.
+        # The submission-boundary check catches a cancel that landed
+        # before the fleet ever built; mid-run cancels thread through to
+        # every lane runner below, so a DELETE lands at the next lane
+        # dispatch/reconcile boundary (service round 4 (d)) — the
+        # in-flight lane segment rolls back exactly like the solo path.
         self._check_cancelled()
         from ksim_tpu.engine.fleet import FleetDriver, FleetLane, parse_fleet_faults
         from ksim_tpu.engine.replay import SEGMENT_STEPS, ReplayDriver
@@ -642,12 +680,14 @@ class ScenarioRunner:
                     requeue_on_node_delete=self._requeue,
                     device_replay=True,
                     device_segment_steps=self._device_segment_steps,
+                    cancel=self._cancel,
                 )
             else:
                 lane_runner = ScenarioRunner(
                     requeue_on_node_delete=self._requeue,
                     device_replay=True,
                     device_segment_steps=self._device_segment_steps,
+                    cancel=self._cancel,
                     **self._lane_cfg,
                 )
             lane_runner._lane = idx

@@ -34,7 +34,9 @@ job plane (docs/jobs.md):
     POST   /api/v1/jobs                 -> submit a scenario job
                                            (202 {job}, 400 bad spec,
                                            413 over per-job bounds,
-                                           429 queue full)
+                                           429 queue full or tenant
+                                           throttled — the throttle
+                                           carries Retry-After)
     GET    /api/v1/jobs                 -> list job statuses
     GET    /api/v1/jobs/<id>            -> one job's status
     GET    /api/v1/jobs/<id>/result     -> final result document
@@ -112,11 +114,15 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Access-Control-Allow-Origin", origin)
             self.send_header("Access-Control-Allow-Credentials", "true")
 
-    def _json(self, code: int, obj) -> None:
+    def _json(
+        self, code: int, obj, headers: "dict[str, str] | None" = None
+    ) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self._cors()
         self.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -353,6 +359,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "bypass_pops": 0,
                 },
                 "workers": {"pool": 0, "active": 0},
+                "tenants": {},
                 "jobs": {},
             }
         )
@@ -363,8 +370,11 @@ class _Handler(BaseHTTPRequestHandler):
     def _job_submit(self) -> None:
         """POST /api/v1/jobs: validate + enqueue a tenant scenario job.
         202 with the job status on success; 400 on a bad spec; 429 when
-        the bounded queue refuses (backpressure the tenant can act on)."""
-        from ksim_tpu.jobs import JobLimitExceeded, JobQueueFull
+        the bounded queue refuses or the submitting tenant
+        (``X-Ksim-Tenant`` header, else ``spec.tenant``) is over its
+        quota/rate — the throttle response carries a ``Retry-After``
+        header with the token bucket's computed wait."""
+        from ksim_tpu.jobs import JobLimitExceeded, JobQueueFull, JobThrottled
         from ksim_tpu.scenario.spec import ScenarioSpecError
 
         try:
@@ -382,7 +392,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"message": "Internal Server Error"})
             return
         try:
-            job = jm.submit(doc)
+            job = jm.submit(doc, tenant=self.headers.get("X-Ksim-Tenant"))
         except ScenarioSpecError as e:
             self._json(400, {"message": str(e)})
             return
@@ -390,6 +400,15 @@ class _Handler(BaseHTTPRequestHandler):
             # Payload-too-large, with the bound in the reason body so
             # the tenant can resize instead of guessing.
             self._json(413, {"message": str(e)})
+            return
+        except JobThrottled as e:
+            # Retry-After is whole seconds (RFC 9110), rounded UP so an
+            # obedient client never retries into the same empty bucket.
+            self._json(
+                429,
+                {"message": str(e)},
+                headers={"Retry-After": str(max(1, int(e.retry_after + 0.999)))},
+            )
             return
         except JobQueueFull as e:
             self._json(429, {"message": str(e)})

@@ -50,6 +50,22 @@ class NodeSlots:
         # whose new dict recycled the old dict's address.
         self._objs: list[JSON] = []
 
+    def seed(self, names: Sequence[str]) -> None:
+        """Install a recorded name order VERBATIM (checkpoint restore,
+        ksim_tpu/jobs/manager.py).  Slot order is scheduling-visible —
+        selectHost breaks score ties by lowest slot index, and the
+        evolved swap-remove order diverges from first-seen order — so a
+        resumed run must start from the order the interrupted run had,
+        not rediscover it from the caller's list.  Per-slot object refs
+        reset to fresh sentinels: the next ``sync`` sees an identity
+        mismatch on every slot and marks them all changed, so the
+        additive families repair/rebuild against real objects (a fresh
+        featurizer rebuilds from scratch anyway — the seed trades one
+        full repair for the exact ORDER)."""
+        self.slot_of = {nm: i for i, nm in enumerate(names)}
+        self._names = list(names)
+        self._objs = [{} for _ in names]
+
     def sync(self, nodes: Sequence[JSON]) -> tuple[list[JSON], set[int]]:
         """Update the assignment for the current node set.
 

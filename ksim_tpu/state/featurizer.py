@@ -218,6 +218,22 @@ class Featurizer:
         self.pod_rows_reused = 0
         self.featurize_passes = 0
 
+    def slot_names(self) -> list[str]:
+        """The current node-slot order, lowest slot first — the carry a
+        segment checkpoint records so ``seed_slots`` can reinstall it on
+        a restored run (scheduler/service.py ``checkpoint_carries``)."""
+        return list(self._slots._names)
+
+    def seed_slots(self, names: Sequence[str]) -> None:
+        """Install a checkpoint-recorded node-slot order on a FRESH
+        featurizer (job-plane incremental resume — see
+        ``boundagg.NodeSlots.seed``).  Every seeded slot is queued as
+        changed so the first featurize repairs families against the
+        live objects; on a fresh instance that repair is the from-
+        scratch rebuild it would have done anyway."""
+        self._slots.seed(names)
+        self._pending_changed |= set(range(len(names)))
+
     def advance_slots(self, nodes: Sequence[JSON]) -> None:
         """Advance the persistent node-slot history WITHOUT featurizing.
 

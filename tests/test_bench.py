@@ -373,6 +373,102 @@ def test_bench_churn_restart_child_records_warm_restart_evidence(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_churn_resume_children_record_resume_evidence(tmp_path):
+    """Round 16: the churn_resume rung's three children over ONE shared
+    jobs dir. The victim writes its evidence JSON the moment the first
+    segment checkpoint is durable and then SIGKILLs itself (the JSON
+    must land despite the -9 exit); the resume child restores that
+    checkpoint and replays only the suffix; scratch is the control.
+    Counts must match byte-identically across resume and scratch."""
+    state = tmp_path / "state"
+    state.mkdir()
+    # 200 creates + 32 churn steps = two K=16 segments: the first
+    # checkpoint lands with a full segment of work still ahead, so the
+    # kill is mid-run, not a degenerate post-completion snapshot.
+    shape = ["--seed", "0", "--churn-events", "3400", "--churn-nodes", "200"]
+    recs = {}
+    for phase in ("victim", "resume", "scratch"):
+        out = tmp_path / f"resume_{phase}.json"
+        proc = subprocess.run(
+            [
+                sys.executable, str(REPO / "bench.py"),
+                "--child", "churn_resume", "--out", str(out),
+                "--resume-phase", phase, "--state-dir", str(state),
+                *shape,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=420,
+            cwd=REPO,
+            env=sanitized_cpu_env(),
+        )
+        if phase == "victim":
+            # The victim dies by its own SIGKILL — after the JSON.
+            assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+        else:
+            assert proc.returncode == 0, proc.stderr[-2000:]
+        recs[phase] = json.loads(out.read_text())
+    victim, resume, scratch = recs["victim"], recs["resume"], recs["scratch"]
+    assert victim["state_at_kill"] == "running"
+    assert victim["checkpoint_segment"] is not None
+    assert resume["state"] == "succeeded" and scratch["state"] == "succeeded"
+    # Crash-safe restore, byte-identical counts (wall excluded).
+    assert resume["counts"] == scratch["counts"]
+    assert resume["events"] == scratch["events"]
+    assert resume["resumed_from"] == victim["checkpoint_segment"]
+    assert 0 < resume["events_replayed"] < resume["events"]
+    assert resume["resume"]["cursor"] > 0
+
+
+@pytest.mark.slow
+def test_bench_churn_resume_child_survives_dead_device(tmp_path):
+    """One-JSON-line-under-any-hardware, resume edition: with every
+    dispatch failing the job degrades to the per-pass host path, which
+    never commits segments, so NO checkpoint ever lands — the victim's
+    poll exits on job completion instead, and the resume child serves
+    the journaled terminal result rather than replaying. The rung still
+    writes valid JSON at every phase."""
+    state = tmp_path / "state"
+    state.mkdir()
+    env = sanitized_cpu_env(
+        {
+            "KSIM_FAULTS": "replay.dispatch=always@device",
+            "KSIM_REPLAY_BREAKER_N": "2",
+        }
+    )
+    shape = ["--seed", "0", "--churn-events", "800", "--churn-nodes", "100"]
+    recs = {}
+    for phase in ("victim", "resume"):
+        out = tmp_path / f"resume_dead_{phase}.json"
+        proc = subprocess.run(
+            [
+                sys.executable, str(REPO / "bench.py"),
+                "--child", "churn_resume", "--out", str(out),
+                "--resume-phase", phase, "--state-dir", str(state),
+                *shape,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=420,
+            cwd=REPO,
+            env=env,
+        )
+        if phase == "victim":
+            assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+        else:
+            assert proc.returncode == 0, proc.stderr[-2000:]
+        recs[phase] = json.loads(out.read_text())
+    # Host path == no segment commits == no checkpoints: the victim ran
+    # to completion before its kill, and resume folds the journaled
+    # terminal state instead of restoring.
+    assert recs["victim"]["checkpoint_segment"] is None
+    assert recs["victim"]["state_at_kill"] == "succeeded"
+    assert recs["resume"]["state"] == "succeeded"
+    assert recs["resume"]["resumed_from"] is None
+    assert recs["resume"]["counts"] is not None
+
+
+@pytest.mark.slow
 def test_bench_emits_json_when_probe_backend_is_dead():
     """A wedged/absent accelerator at PROBE time (the chip-tunnel
     failure mode the stdlib-only parent exists for): the probe child

@@ -3,7 +3,11 @@ engine/compilecache.py disk layer): crash-safe journal units
 (torn-tail/corrupt-CRC bytes are HAND-WRITTEN, never derived from the
 writer), persistent-executable cache units (fake disk spec, jax-free),
 in-process restart recovery, the kill -9 end-to-end (slow; `make
-restart-check` runs it), and the SSE listener-leak regression."""
+restart-check` runs it), the SSE listener-leak regression, and the
+round-16 segment-checkpoint matrix: crash at every checkpoint
+boundary, corrupt-checkpoint fallback, skip containment, and the
+SIGKILL-mid-run incremental resume (slow) whose suffix replay must
+land the locked 6k churn counts byte-identically."""
 
 from __future__ import annotations
 
@@ -495,6 +499,346 @@ def test_sigkill_mid_job_then_restart_recovers(tmp_path):
     assert final["state"] == "succeeded", final
     assert jm2.get(jid).result_view()[1]["result"]["podsScheduled"] == 200
     jm2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Segment checkpoints + incremental resume (round 16, docs/jobs.md)
+# ---------------------------------------------------------------------------
+
+
+def churn_device_doc(
+    seed: int = 3, n_nodes: int = 32, n_steps: int = 40, **sim_extra
+) -> dict:
+    """A device-replay churn job long enough to cross several segment
+    commits (K=16 steps each): step 0 bootstraps the fleet, then
+    ``n_steps`` churn steps of 20 events."""
+    from ksim_tpu.scenario import churn_scenario, spec_from_operations
+
+    ops = list(
+        churn_scenario(
+            seed,
+            n_nodes=n_nodes,
+            n_events=n_nodes + 20 * n_steps,
+            ops_per_step=20,
+        )
+    )
+    sim = {"deviceReplay": True, "podBucketMin": 64, **sim_extra}
+    return {"spec": {"simulator": sim, "scenario": spec_from_operations(ops)}}
+
+
+def _locked_counts(result_doc: dict) -> dict:
+    """The byte-identical slice of a job result: everything except the
+    wall-clock fields (a resumed run's ``wallSeconds`` covers only its
+    own suffix replay — documented, and exactly the point)."""
+    return {
+        k: v for k, v in result_doc["result"].items() if k != "wallSeconds"
+    }
+
+
+def _run_checkpointed(tmp_path, doc, **mgr_kw) -> tuple[str, dict]:
+    """Run one job to completion with checkpoints on; return
+    (job_id, final result doc)."""
+    jm = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        checkpoint_every=mgr_kw.pop("checkpoint_every", 1), **mgr_kw,
+    )
+    job = jm.submit(doc)
+    final = _wait(job, {"succeeded", "failed"}, 300.0)
+    assert final["state"] == "succeeded", final
+    _, result, _ = job.result_view()
+    jm.shutdown()
+    return job.id, result
+
+
+def _rewrite_journal(tmp_path, recs) -> str:
+    """Replace the dir's journal with exactly ``recs`` (each re-appended
+    through the writer, so CRCs are valid)."""
+    path = os.path.join(str(tmp_path), JOURNAL_NAME)
+    os.unlink(path)
+    j = JobJournal(path)
+    for r in recs:
+        j.append(r)
+    return path
+
+
+def test_checkpoints_append_at_cadence_and_throttle(tmp_path):
+    """checkpoint_every=1 appends one record per committed segment with
+    monotonically increasing cursors; a coarser cadence appends strictly
+    fewer.  The newest checkpoint's segment shows in job status."""
+    jid, _ = _run_checkpointed(tmp_path, churn_device_doc())
+    recs = JobJournal(os.path.join(str(tmp_path), JOURNAL_NAME)).replay()
+    cks = [r for r in recs if r["t"] == "checkpoint"]
+    assert len(cks) >= 2
+    cursors = [c["cursor"] for c in cks]
+    assert cursors == sorted(set(cursors))
+    assert all(c["id"] == jid for c in cks)
+    for c in cks:
+        assert c["store"]["objects"]["nodes"]  # exact state rode along
+        assert "pass_count" in c["service"]
+    jm = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    assert jm.get(jid).status()["checkpoint_segment"] is None  # terminal: not carried
+    jm.shutdown()
+
+    coarse = tmp_path / "coarse"
+    coarse.mkdir()
+    _run_checkpointed(coarse, churn_device_doc(), checkpoint_every=2)
+    coarse_cks = [
+        r
+        for r in JobJournal(os.path.join(str(coarse), JOURNAL_NAME)).replay()
+        if r["t"] == "checkpoint"
+    ]
+    assert 0 < len(coarse_cks) < len(cks)
+
+
+def test_resume_from_every_checkpoint_boundary_byte_identical(tmp_path):
+    """The crash matrix: truncate the journal right after EACH
+    checkpoint record in turn (the crash window between the checkpoint
+    append and the next journaled transition), resume, and require the
+    final counts byte-identical to the uninterrupted run — with the
+    suffix replay doing strictly less work the later the crash."""
+    jid, full = _run_checkpointed(tmp_path, churn_device_doc())
+    recs = JobJournal(os.path.join(str(tmp_path), JOURNAL_NAME)).replay()
+    ck_idx = [i for i, r in enumerate(recs) if r["t"] == "checkpoint"]
+    assert len(ck_idx) >= 2
+    total_events = full["result"]["eventsApplied"]
+    replayed = []
+    for idx in ck_idx:
+        _rewrite_journal(tmp_path, recs[: idx + 1])
+        jm = JobManager(
+            workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+            resume=True, checkpoint_every=0,
+        )
+        job = jm.get(jid)
+        final = _wait(job, {"succeeded", "failed", "interrupted"}, 300.0)
+        assert final["state"] == "succeeded", final
+        _, res, _ = job.result_view()
+        assert _locked_counts(res) == _locked_counts(full)
+        assert res["resume"]["cursor"] == recs[idx]["cursor"]
+        assert final["resumed_from"] == recs[idx]["segment"]
+        replayed.append(res["resume"]["eventsReplayed"])
+        jm.shutdown()
+    # Later checkpoints leave strictly less to replay, and even the
+    # earliest resume did less work than a from-scratch replay.
+    assert replayed == sorted(replayed, reverse=True)
+    assert replayed[0] < total_events
+
+
+def test_resume_with_torn_tail_after_checkpoint(tmp_path):
+    """kill -9 mid-append AFTER the last checkpoint: the torn bytes are
+    dropped by the journal's tail rule and the checkpoint restores."""
+    jid, full = _run_checkpointed(tmp_path, churn_device_doc())
+    recs = JobJournal(os.path.join(str(tmp_path), JOURNAL_NAME)).replay()
+    last_ck = max(i for i, r in enumerate(recs) if r["t"] == "checkpoint")
+    path = _rewrite_journal(tmp_path, recs[: last_ck + 1])
+    with open(path, "ab") as f:
+        f.write(b'{"crc": 7, "rec": {"t": "checkpo')  # the kill artifact
+    jm = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        resume=True, checkpoint_every=0,
+    )
+    final = _wait(jm.get(jid), {"succeeded", "failed", "interrupted"}, 300.0)
+    assert final["state"] == "succeeded", final
+    assert _locked_counts(jm.get(jid).result_view()[1]) == _locked_counts(full)
+    jm.shutdown()
+
+
+def test_corrupt_checkpoint_falls_back_to_previous(tmp_path):
+    """A checkpoint whose CRC validates but whose payload no longer
+    restores (bit rot past the line hash, a format drift) must fall
+    back to the PREVIOUS checkpoint, not fail the job or restart it
+    from scratch."""
+    jid, full = _run_checkpointed(tmp_path, churn_device_doc())
+    recs = JobJournal(os.path.join(str(tmp_path), JOURNAL_NAME)).replay()
+    ck_idx = [i for i, r in enumerate(recs) if r["t"] == "checkpoint"]
+    assert len(ck_idx) >= 2
+    keep = recs[: ck_idx[-1] + 1]
+    keep[-1] = dict(keep[-1], store={"not": "a store"})  # re-CRC'd on append
+    _rewrite_journal(tmp_path, keep)
+    jm = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        resume=True, checkpoint_every=0,
+    )
+    job = jm.get(jid)
+    final = _wait(job, {"succeeded", "failed", "interrupted"}, 300.0)
+    assert final["state"] == "succeeded", final
+    assert final["resumed_from"] == recs[ck_idx[-2]]["segment"]
+    assert _locked_counts(job.result_view()[1]) == _locked_counts(full)
+    jm.shutdown()
+
+
+def test_restore_fault_falls_back_to_scratch(tmp_path):
+    """Every checkpoint unusable (armed jobs.checkpoint_restore): the
+    resumed job replays from scratch and still lands the identical
+    result — restore is an optimization, never a correctness gate."""
+    jid, full = _run_checkpointed(tmp_path, churn_device_doc())
+    recs = JobJournal(os.path.join(str(tmp_path), JOURNAL_NAME)).replay()
+    last_ck = max(i for i, r in enumerate(recs) if r["t"] == "checkpoint")
+    _rewrite_journal(tmp_path, recs[: last_ck + 1])
+    FAULTS.arm("jobs.checkpoint_restore", "always")
+    jm = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        resume=True, checkpoint_every=0,
+    )
+    job = jm.get(jid)
+    final = _wait(job, {"succeeded", "failed", "interrupted"}, 300.0)
+    assert final["state"] == "succeeded", final
+    _, res, _ = job.result_view()
+    assert _locked_counts(res) == _locked_counts(full)
+    assert "resume" not in res and final["resumed_from"] is None
+    jm.shutdown()
+
+
+def test_checkpoint_append_fault_never_fails_the_job(tmp_path):
+    """The best-effort contract: an armed jobs.checkpoint_append (or
+    any snapshot failure) skips checkpoints with a counted event; the
+    run itself completes untouched."""
+    FAULTS.arm("jobs.checkpoint_append", "always", exc=OSError)
+    jid, result = _run_checkpointed(tmp_path, churn_device_doc())
+    assert result["result"]["podsScheduled"] > 0
+    recs = JobJournal(os.path.join(str(tmp_path), JOURNAL_NAME)).replay()
+    assert not [r for r in recs if r["t"] == "checkpoint"]
+
+
+def test_checkpoint_max_bytes_skips_oversized_snapshots(tmp_path):
+    """A snapshot over KSIM_JOBS_CHECKPOINT_MAX_BYTES is skipped (the
+    journal must not bloat unboundedly); the job still succeeds."""
+    jid, result = _run_checkpointed(
+        tmp_path, churn_device_doc(), checkpoint_max_bytes=64
+    )
+    assert result["result"]["podsScheduled"] > 0
+    recs = JobJournal(os.path.join(str(tmp_path), JOURNAL_NAME)).replay()
+    assert not [r for r in recs if r["t"] == "checkpoint"]
+
+
+def test_compaction_keeps_newest_checkpoint_for_live_jobs(tmp_path, monkeypatch):
+    """The compaction snapshot re-emits exactly ONE checkpoint — the
+    newest — for each non-terminal job (a terminal job's checkpoints
+    are dead weight and dropped)."""
+    jid, _ = _run_checkpointed(tmp_path, churn_device_doc())
+    recs = JobJournal(os.path.join(str(tmp_path), JOURNAL_NAME)).replay()
+    ck = [r for r in recs if r["t"] == "checkpoint"]
+    assert len(ck) >= 2
+    # Crash right after the last checkpoint; the resumed-but-unserved
+    # job is LIVE (workers=0: it stays queued).
+    last_ck = max(i for i, r in enumerate(recs) if r["t"] == "checkpoint")
+    _rewrite_journal(tmp_path, recs[: last_ck + 1])
+    monkeypatch.setenv("KSIM_JOBS_JOURNAL_MAX_BYTES", "1")  # force compaction
+    jm = JobManager(
+        workers=0, queue_limit=8, jobs_dir=str(tmp_path), resume=True
+    )
+    live = [r for r in jm._journal_records() if r["t"] == "checkpoint"]
+    assert len(live) == 1 and live[0]["seq"] == ck[-1]["seq"]
+    assert jm._journal.maybe_compact(jm._journal_records) is True
+    jm.shutdown()
+    # The compacted journal still resumes from that checkpoint.
+    jm2 = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        resume=True, checkpoint_every=0,
+    )
+    final = _wait(jm2.get(jid), {"succeeded", "failed", "interrupted"}, 300.0)
+    assert final["state"] == "succeeded", final
+    assert final["resumed_from"] == ck[-1]["segment"]
+    jm2.shutdown()
+
+
+def test_resumed_job_sse_backlog_is_gap_free(tmp_path):
+    """Satellite regression: a tenant reconnecting to a resumed job's
+    SSE stream must see the PRE-restart lifecycle (queued→running)
+    replayed ahead of the re-enqueue, not a log that starts mid-life."""
+    jm = JobManager(workers=0, queue_limit=8, jobs_dir=str(tmp_path))
+    job = jm.submit(tiny_doc())
+    jm.shutdown()
+    # The crashed worker's journal footprint: it had started running.
+    JobJournal(os.path.join(str(tmp_path), JOURNAL_NAME)).append(
+        {"t": "state", "id": job.id, "state": "running", "ts": 1.0}
+    )
+    jm2 = JobManager(
+        workers=0, queue_limit=8, jobs_dir=str(tmp_path), resume=True
+    )
+    j2 = jm2.get(job.id)
+    with j2._cond:
+        events = [dict(e) for e in j2._events]
+    states = [
+        (e["state"], e.get("recovered", False), e.get("resumed", False))
+        for e in events
+        if e.get("event") == "state"
+    ]
+    assert states == [
+        ("running", True, False),  # the journaled pre-crash history
+        ("queued", False, True),  # then the re-enqueue
+    ]
+    jm2.shutdown()
+
+
+_CKPT_CRASH_CHILD = r"""
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ksim_tpu.jobs import JobManager
+from ksim_tpu.scenario import churn_scenario, spec_from_operations
+
+# The locked 6k churn prefix (repo CLAUDE.md), as a device-replay job.
+ops = list(churn_scenario(0, n_nodes=2000, n_events=6000, ops_per_step=100))
+doc = {"spec": {
+    "simulator": {
+        "deviceReplay": True, "maxPodsPerPass": 1024, "podBucketMin": 128,
+    },
+    "scenario": spec_from_operations(ops),
+}}
+jm = JobManager(workers=1, queue_limit=8, jobs_dir=sys.argv[1],
+                checkpoint_every=1)
+job = jm.submit(doc)
+while True:
+    st = job.status()
+    if st["checkpoint_segment"] is not None:
+        break
+    if st["state"] in ("succeeded", "failed"):
+        print("FINISHED-EARLY", st["state"], flush=True)
+        sys.exit(2)
+    time.sleep(0.05)
+print("CHECKPOINTED", job.id, flush=True)
+time.sleep(600)  # parent kills -9 long before this returns
+"""
+
+
+@pytest.mark.slow
+def test_sigkill_mid_run_resumes_suffix_with_locked_counts(tmp_path):
+    """The round-16 acceptance scenario: kill -9 a worker after its
+    first durable checkpoint; a KSIM_JOBS_RESUME=1 restart restores the
+    checkpoint and replays ONLY the remaining suffix — strictly fewer
+    events than the full stream — landing the locked 6k churn counts
+    (2524/471, seed 0, 2000 nodes) byte-identically."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CKPT_CRASH_CHILD, str(tmp_path)],
+        env=sanitized_cpu_env(),
+        cwd="/root/repo",
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("CHECKPOINTED"), line
+        jid = line.split()[1]
+    finally:
+        proc.kill()  # SIGKILL: no atexit, no flush, no goodbye
+        proc.wait()
+    jm = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        resume=True, checkpoint_every=0,
+    )
+    job = jm.get(jid)
+    assert job is not None
+    final = _wait(job, {"succeeded", "failed", "interrupted"}, 300.0)
+    assert final["state"] == "succeeded", final
+    _, res, _ = job.result_view()
+    assert res["result"]["eventsApplied"] == 6430
+    assert (
+        res["result"]["podsScheduled"],
+        res["result"]["unschedulableAttempts"],
+    ) == (2524, 471)
+    assert final["resumed_from"] is not None
+    assert 0 < res["resume"]["eventsReplayed"] < 6430
+    jm.shutdown()
 
 
 # ---------------------------------------------------------------------------

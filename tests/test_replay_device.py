@@ -497,6 +497,41 @@ def test_fleet_lane_ops_override_runs_divergent_lane():
     assert len(lanes[2].driver.lower_log) == 0
 
 
+def test_fleet_cancel_lands_at_dispatch_boundary():
+    """Round 16: a cancel raised mid-run inside a fleet cohort aborts
+    at the NEXT lane dispatch boundary (the per-round check in
+    FleetDriver.run), propagating RunCancelled through the group
+    exception ladders — which deliberately do not catch it — with
+    every lane's store left at a committed segment boundary."""
+    from ksim_tpu.errors import RunCancelled
+
+    class FlipAfter:
+        """A cancel flag that trips after N polls — mid-run, not
+        before the first round."""
+
+        def __init__(self, n):
+            self.n = n
+            self.polls = 0
+
+        def is_set(self):
+            self.polls += 1
+            return self.polls > self.n
+
+    jax.config.update("jax_enable_x64", False)
+    flag = FlipAfter(3)
+    fleet_r = ScenarioRunner(
+        device_replay=True, fleet=2, cancel=flag,
+        max_pods_per_pass=1024, pod_bucket_min=128, device_segment_steps=8,
+    )
+    with pytest.raises(RunCancelled):
+        fleet_r.run(_small_churn())
+    assert flag.polls > 3  # the run made progress before the trip
+    # Rollback invariant: no lane's store holds a torn segment — every
+    # store transaction either committed whole or rolled back.
+    for ln in fleet_r.fleet_lanes or ():
+        assert ln.runner.store._txn is None
+
+
 def test_fleet_rejects_bad_config():
     with pytest.raises(ValueError, match="device_replay"):
         ScenarioRunner(fleet=2)
