@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs restart-check
+.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs restart-check shard-check
 
 test:
 	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
@@ -21,9 +21,23 @@ test:
 # device path, and the 2524/471 counts still hold byte-identically.
 # The analyzer gates the lock run: a lock/kernel/registry contract
 # violation is exactly the class of bug the 50k stepwise run exists to
-# catch, and lint finds it in seconds instead of minutes.
+# catch, and lint finds it in seconds instead of minutes.  Round 17
+# adds the SHARDED legs: the locked 6k prefix and the full 50k stream
+# replayed over a tp=8 virtual mesh (8 host devices), every step
+# byte-identical to the solo counts with zero shard_mesh fallbacks.
 lock-check: lint
-	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_device_vs_per_pass tests/test_behavior_locks.py::test_churn_fleet_lock_6k_lanes8 tests/test_behavior_locks.py::test_churn_lock_6k_holds_under_dispatch_faults_with_recovery -q -rs -m slow
+	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_device_vs_per_pass tests/test_behavior_locks.py::test_churn_fleet_lock_6k_lanes8 tests/test_behavior_locks.py::test_churn_lock_6k_holds_under_dispatch_faults_with_recovery tests/test_behavior_locks.py::test_churn_lock_6k_sharded_tp8 tests/test_behavior_locks.py::test_churn_lock_50k_stepwise_sharded_tp8 -q -rs -m slow
+
+# Sharded-replay verification (docs/scaling.md "Sharded device
+# replay"): the fast tier-1 sharded-vs-solo parity matrix (byte parity
+# on churn + full-record annotations + preemption, the explicit-mesh
+# contract, dead-device containment, the prewarm plane, and the bench
+# churn_shard rung) plus the slow 6k sharded lock leg.  Gated on lint
+# for the same reason lock-check is.
+shard-check: lint
+	$(PY) -m pytest tests/test_replay_device.py tests/test_replay_cache.py -q -k "sharded or prewarm"
+	$(PY) -m pytest tests/test_bench.py -q -k "churn_shard"
+	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_6k_sharded_tp8 -q -rs -m slow
 
 # The fault suite (docs/faults.md) on CPU in the sanitized environment
 # (tests/helpers.sanitized_cpu_env drops the axon sitecustomize that

@@ -346,6 +346,114 @@ def child_churn(
     return out
 
 
+def child_churn_shard(
+    seed: int, n_nodes: int, n_events: int, shard_tp: int
+) -> dict:
+    """Sharded device replay rung (round 17, KSIM_REPLAY_TP): the SAME
+    churn stream through the device path at tp=1 and tp=``shard_tp``
+    (the node axis laid over a dp=1 mesh) in ONE child, so the two
+    walls share a process, a backend state and a warmed jax runtime.
+    Evidence the record must carry: byte-identical counts and device
+    coverage between the widths (``counts_match``/``device_steps_match``
+    — GSPMD value-preservation is the product claim), each width's
+    fallback histogram with zero ``shard_mesh`` entries, the per-shard
+    full-record byte budget from the lower log, and the per-chip
+    device-memory watermark next to the phases split (the 100k-node
+    memory story is per-chip, not per-host).  On a CPU host the tp mesh
+    runs on forced virtual devices; on a host with fewer devices than
+    the mesh the tp leg degrades through the device-error ladder and
+    the record says so — the JSON line exists under any hardware
+    condition."""
+    # The virtual mesh must exist BEFORE jax initializes its backend —
+    # harmless on real multi-device hosts (the flag only affects the
+    # host platform).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    from ksim_tpu.scenario import ScenarioRunner, churn_scenario
+
+    _child_setup()
+    jax.config.update("jax_enable_x64", False)
+
+    def per_chip_peak() -> "dict | None":
+        """Per-device peak_bytes_in_use, when the backend exposes it
+        (TPU does; CPU returns None) — guarded so a backend without
+        memory_stats never breaks the one-JSON-line contract."""
+        stats = {}
+        for d in jax.devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                return None
+            if not ms or "peak_bytes_in_use" not in ms:
+                return None
+            stats[str(d.id)] = int(ms["peak_bytes_in_use"])
+        return stats
+
+    out: dict = {"shard_tp": shard_tp, "modes": {}}
+    sigs = {}
+    for tp in (1, shard_tp):
+        os.environ["KSIM_REPLAY_TP"] = str(tp)
+        runner = ScenarioRunner(
+            max_pods_per_pass=1024,
+            pod_bucket_min=128,
+            device_replay=True,
+            preemption=True,
+        )
+        res = runner.run(
+            churn_scenario(
+                seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100
+            )
+        )
+        drv = runner.replay_driver
+        mode: dict = {
+            "wall_s": round(res.wall_seconds, 1),
+            "events_per_sec": round(res.events_per_second),
+            "pods_scheduled": res.pods_scheduled,
+            "unschedulable_attempts": res.unschedulable_attempts,
+            "device_steps": drv.device_steps,
+            "fallback_steps": drv.fallback_steps,
+            "unsupported": dict(drv.unsupported),
+            "lowered_tps": sorted({e["tp"] for e in drv.lower_log}),
+            "full_bytes_per_shard_max": max(
+                (e["full_bytes_per_shard"] for e in drv.lower_log), default=0
+            ),
+        }
+        if res.phase_seconds:
+            mode["phases"] = {
+                name: {
+                    "seconds": res.phase_seconds[name],
+                    "count": res.phase_counts[name],
+                }
+                for name in sorted(res.phase_seconds)
+            }
+        mode["per_chip_peak_bytes"] = per_chip_peak()
+        out["modes"][f"tp{tp}"] = mode
+        sigs[tp] = (
+            res.pods_scheduled,
+            res.unschedulable_attempts,
+            [(s.step, s.scheduled, s.unschedulable) for s in res.steps],
+        )
+        print(
+            f"[churn_shard tp={tp} {n_events}ev/{n_nodes}n] "
+            f"{res.wall_seconds:.1f}s ({res.pods_scheduled} scheduled, "
+            f"{drv.device_steps} device steps)",
+            file=sys.stderr,
+            flush=True,
+        )
+    out["counts_match"] = sigs[1] == sigs[shard_tp]
+    out["device_steps_match"] = (
+        out["modes"]["tp1"]["device_steps"]
+        == out["modes"][f"tp{shard_tp}"]["device_steps"]
+    )
+    out["platform"] = jax.devices()[0].platform
+    return out
+
+
 def child_churn_fleet(seed: int, n_nodes: int, n_events: int, lanes: int) -> dict:
     """Fleet replay rung (engine/fleet.py): the SAME churn stream on S
     independent trajectories, one vmapped device dispatch per window,
@@ -878,6 +986,13 @@ def _child_main(args: argparse.Namespace) -> None:
                 args.churn_preempt,
                 args.churn_record_full,
             )
+        elif args.child == "churn_shard":
+            out = child_churn_shard(
+                args.seed,
+                args.churn_nodes,
+                args.churn_events,
+                args.shard_tp,
+            )
         elif args.child == "churn_fleet":
             out = child_churn_fleet(
                 args.seed,
@@ -1125,6 +1240,7 @@ def main() -> None:
     except ValueError:
         default_fleet = 8
     ap.add_argument("--fleet-lanes", type=int, default=default_fleet)
+    ap.add_argument("--shard-tp", type=int, default=8)
     # Job-plane rung shape (the stdlib-only parent forwards the numbers;
     # the child reads no environment for them).
     ap.add_argument("--jobs-count", type=int, default=8)
@@ -1164,8 +1280,8 @@ def main() -> None:
     ap.add_argument(
         "--child",
         choices=[
-            "probe", "rung", "churn", "churn_fleet", "churn_jobs",
-            "churn_trace", "churn_restart", "churn_resume",
+            "probe", "rung", "churn", "churn_shard", "churn_fleet",
+            "churn_jobs", "churn_trace", "churn_restart", "churn_resume",
         ],
         default=None,
     )
@@ -1444,6 +1560,26 @@ def main() -> None:
             CHURN_TIMEOUT,
         )
 
+    def run_churn_shard_stage() -> None:
+        """Sharded device replay rung (round 17): tp=1 vs tp=8 over the
+        6k prefix in one child — counts_match/device_steps_match, zero
+        shard_mesh fallbacks, the per-shard full-record byte budget,
+        and the per-chip memory watermark next to the phases split.
+        Always the 6k prefix: the rung runs the stream twice and the
+        sharding claims are about layout, not stream length."""
+        run_secondary_churn_rung(
+            "churn_shard",
+            lambda resized: [
+                "--seed", str(args.seed),
+                "--churn-events", str(min(args.churn_events, 6_000)),
+                "--churn-nodes", str(min(args.churn_nodes, CPU_CHURN_CAP[1])),
+                "--shard-tp", str(args.shard_tp),
+            ],
+            CHURN_TIMEOUT,
+            min_budget=120,
+            mode="churn_shard",
+        )
+
     def run_churn_fleet_stage() -> None:
         """Fleet replay rung (round 12, engine/fleet.py): S independent
         trajectories of the 6k prefix at 2k nodes through one vmapped
@@ -1662,6 +1798,7 @@ def main() -> None:
     # a wedged child here must not starve the 10kx5k rung's budget.
     run_churn_device_stage()
     run_churn_device_full_stage()
+    run_churn_shard_stage()
     run_churn_fleet_stage()
     run_churn_jobs_stage()
     run_churn_trace_stage()

@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import signal
 import sys
 import threading
@@ -104,6 +105,20 @@ def start_simulator(argv: list[str] | None = None) -> int:
                 "continuous kube sync (resourceSyncEnabled + kubeConfig), "
                 "not one-shot import or a snapshot file"
             )
+
+    if os.environ.get("KSIM_AOT_PREWARM") == "1":
+        # Load-only AOT warm start: deserialize the shape-ladder rungs
+        # already on disk so the first tenant dispatch of each skips
+        # the deserialize round (engine/replay.py prewarm_aot_cache —
+        # it never cold-compiles; the persistent XLA compilation cache
+        # enabled above covers the compile half).  Daemon thread: a
+        # wedged chip tunnel inside jax device init must never block
+        # server startup — the dispatch-path watchdog owns that risk.
+        from ksim_tpu.engine.replay import prewarm_aot_cache
+
+        threading.Thread(
+            target=prewarm_aot_cache, name="aot-prewarm", daemon=True
+        ).start()
 
     if args.profile_dir:
         di.scheduler_service.start_profiling(args.profile_dir)

@@ -94,6 +94,7 @@ class CompileCache:
         self.disk_misses = 0  # guarded-by: _lock (leaders that found no entry)
         self.disk_stores = 0  # guarded-by: _lock (fresh executables persisted)
         self.disk_evictions = 0  # guarded-by: _lock (corrupt/mismatched unlinks)
+        self.disk_prewarmed = 0  # guarded-by: _lock (startup-deserialized entries)
 
     def run(
         self,
@@ -183,6 +184,42 @@ class CompileCache:
             if exec_obj is not None:
                 return disk.invoke(exec_obj)
         return fn()
+
+    def note_prewarmed(self, n: int) -> None:
+        """Count ``n`` entries deserialized by the startup prewarm pass
+        (engine/replay.py ``prewarm_aot_cache``, ``KSIM_AOT_PREWARM``)
+        — evidence only; the entries themselves live with the caller."""
+        with self._lock:
+            self.disk_prewarmed += n
+
+    @staticmethod
+    def read_disk_entry(path: str) -> "tuple[str, bytes] | None":
+        """Non-destructively parse one on-disk entry: validate the
+        header shape and blob CRC, return ``(stored token, blob)`` —
+        or None for unreadable/corrupt files.  Unlike ``_disk_load``
+        this NEVER evicts and does no token comparison: it serves scans
+        (the prewarm pass) that do not know which rung identity the
+        entry belongs to; eviction authority stays with the dispatch
+        path, where the expected token is known."""
+        try:
+            with open(path, "rb") as f:
+                header, sep, blob = f.read().partition(b"\n")
+        except OSError:
+            return None
+        try:
+            meta = json.loads(header)
+            crc = int(meta.get("crc", -1))
+            token = meta.get("key")
+            ok_shape = bool(sep) and meta.get("v") == 1
+        except (ValueError, TypeError):
+            return None
+        if (
+            not ok_shape
+            or not isinstance(token, str)
+            or (zlib.crc32(blob) & 0xFFFFFFFF) != crc
+        ):
+            return None
+        return token, blob
 
     # -- the persistent layer (leader-only helpers) ----------------------
 
@@ -286,6 +323,7 @@ class CompileCache:
                 "disk_misses": self.disk_misses,
                 "disk_stores": self.disk_stores,
                 "disk_evictions": self.disk_evictions,
+                "disk_prewarmed": self.disk_prewarmed,
                 "rungs": rungs,
                 "shared_rungs": shared,
                 "shared_single_compile_rungs": shared_hot,
@@ -306,6 +344,7 @@ class CompileCache:
             self.disk_misses = 0
             self.disk_stores = 0
             self.disk_evictions = 0
+            self.disk_prewarmed = 0
 
 
 #: The process-wide cache every segment dispatch consults — one compile

@@ -73,9 +73,11 @@ from __future__ import annotations
 import bisect
 import hashlib
 import logging
+import math
 import os
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field, fields, is_dataclass
 from functools import partial
 from typing import Any, Sequence
@@ -172,6 +174,25 @@ def _watchdog_seconds() -> float:
 
 def _breaker_threshold() -> int:
     return int(os.environ.get("KSIM_REPLAY_BREAKER_N", str(BREAKER_DEFAULT_N)))
+
+
+def _replay_tp() -> int:
+    """``KSIM_REPLAY_TP``: lay every node-axis tensor of the segment
+    program over a ``make_mesh(tp, dp=1)`` node mesh (round 17).  1 (the
+    default) keeps the single-device layout.  The byte bound
+    (``KSIM_REPLAY_FULL_BYTES``) and the preemption search bounds
+    (``KSIM_REPLAY_CMAX``/``KSIM_REPLAY_VMAX``) are PER-SHARD budgets —
+    record="full" and bounded-exact preemption scale with the mesh.
+    Read at ReplayDriver construction; an explicit service ``shard_mesh``
+    takes precedence over the env knob."""
+    return max(int(os.environ.get("KSIM_REPLAY_TP", "1")), 1)
+
+
+#: Minimum node rows per shard before _lower narrows the mesh width.
+#: Empirical partitioner-hazard floor, NOT tunable: below it the SPMD
+#: preemption scan silently doubled sel/nom values (see the narrowing
+#: comment in _lower and docs/churn_floor.md).
+_MIN_SHARD_NODES = 4
 
 
 #: Half-open cooldown doubling is bounded here: a backend that stays
@@ -404,8 +425,9 @@ class _SegmentStatics:
     n_dom: int  # inter-pod padded domain count (segment id space)
     record: str = "selection"  # "selection" | "full" (streamed results)
     preempt: bool = False  # on-device DefaultPreemption victim search
-    c_max: int = PREEMPT_CANDIDATES  # candidate-node scan bound
-    v_max: int = PREEMPT_VICTIMS  # victims-per-candidate bound
+    c_max: int = PREEMPT_CANDIDATES  # candidate-node scan bound (per shard)
+    v_max: int = PREEMPT_VICTIMS  # victims-per-candidate bound (per shard)
+    tp: int = 1  # node-axis mesh width (round 17 sharded replay)
 
 
 # ---------------------------------------------------------------------------
@@ -491,11 +513,14 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
     n_filters = sum(1 for sp in prog.plugins if sp.filter_enabled)
     n_scores = sum(1 for sp in prog.plugins if sp.score_enabled)
     bits_dtype, final_dtype = prog._result_dtypes()
-    # Effective search bounds: the configured statics clamped to the
-    # padded axes (top_k needs k <= axis; small universes can't overflow
-    # a bound wider than themselves anyway).
-    c_eff = min(st.c_max, N)
-    v_eff = min(st.v_max, P)
+    # Effective search bounds: the configured statics are PER-SHARD
+    # budgets (round 17) — multiplied by the mesh width, then clamped to
+    # the padded axes (top_k needs k <= axis; small universes can't
+    # overflow a bound wider than themselves anyway).  At tp=1 this is
+    # the historical global bound; bounded-exact semantics are unchanged
+    # (overflow still discards the segment before any store effect).
+    c_eff = min(st.c_max * st.tp, N)
+    v_eff = min(st.v_max * st.tp, P)
 
     def _victim_deltas(rows, act):
         """Summed universe-row contributions of ``rows`` where ``act``
@@ -1218,6 +1243,15 @@ class ReplayDriver:
         self._last_plan: "_SegmentPlan | None" = None  # guarded-by: main-thread
         self._dev_consts: dict[int, tuple[Any, Any]] = {}  # guarded-by: main-thread
         self._dev_consts_x64: "bool | None" = None  # guarded-by: main-thread
+        self._dev_consts_tp: "int | None" = None  # guarded-by: main-thread
+        # Sharded replay (round 17): the requested node-mesh width.  An
+        # explicit service shard_mesh (validated in service_supported)
+        # wins over the env knob; fleet lanes force tp=1 — their lane
+        # axis already owns the mesh (dp), and a lane's segment scan
+        # must stay whole on its device.
+        self._tp_env = _replay_tp() if lane is None else 1
+        self._tp_req = self._tp_env  # guarded-by: main-thread
+        self._shard_mesh_obj: Any = None  # guarded-by: main-thread
         # Default: ON where re-transfer is the only cost (cpu backend),
         # OFF on the axon remote-tunnel runtime — pinning extra live
         # device buffers there slows every subsequent execution/transfer
@@ -1339,8 +1373,26 @@ class ReplayDriver:
             self._reject("pnts_emulation")
             return False
         if svc._shard_mesh is not None:
-            self._reject("shard_mesh")
-            return False
+            # Round 17: a node-axis (tp) mesh is SUPPORTED — the segment
+            # program lays every [N]/[N, R] tensor over it and GSPMD
+            # inserts the per-step collectives.  Only genuinely
+            # unsupported shapes still reject: a dp>1 mesh would split
+            # the pod axis under the sequential-commit scan (order is
+            # the parity contract), and a mesh without a tp axis has
+            # nothing to lay the node axis over.  Axis sizes come off
+            # the mesh object itself — no backend init on this thread.
+            from ksim_tpu.engine.sharding import DP, TP
+
+            mesh = svc._shard_mesh
+            axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if axes.get(DP, 1) != 1 or TP not in axes or self.lane is not None:
+                self._reject("shard_mesh")
+                return False
+            self._shard_mesh_obj = mesh
+            self._tp_req = int(axes[TP])
+        else:
+            self._shard_mesh_obj = None
+            self._tp_req = self._tp_env
         if svc._featurizer_override is not None:
             self._reject("featurizer_override")
             return False
@@ -1741,7 +1793,12 @@ class ReplayDriver:
         if (
             self._dev_cache_on
             and self._dev_consts_x64 == bool(jax.config.jax_enable_x64)
+            and self._dev_consts_tp == plan.statics.tp
         ):
+            # Round 17: the reuse map holds buffers already laid out for
+            # ONE mesh width — a tp change re-shards everything, so only
+            # a same-tp dispatch may hit it (changed host arrays still
+            # miss by id and re-shard individually).
             plan.dev_reuse = self._dev_consts
         return plan
 
@@ -1800,6 +1857,7 @@ class ReplayDriver:
             # the next one (main thread: _run never mutates the driver).
             self._dev_consts = plan.dev_map_out
             self._dev_consts_x64 = bool(jax.config.jax_enable_x64)
+            self._dev_consts_tp = plan.statics.tp
             self.dev_const_hits += plan.dev_hits
             self.dev_const_misses += plan.dev_misses
 
@@ -2262,6 +2320,30 @@ class ReplayDriver:
         N = feats.nodes.padded
         P = feats.pods.requests.shape[0]
         K = k_pad
+        # Round 17: the mesh width for THIS universe.  N is a power-of-
+        # two bucket, so gcd against the requested width finds the
+        # largest divisor both agree on — the node axis always splits
+        # evenly, and a universe narrower than the requested mesh just
+        # runs at a narrower tp instead of rejecting.  An EXPLICIT
+        # service shard_mesh is a layout contract, not a hint: a
+        # universe its tp axis cannot divide is a genuinely unsupported
+        # mesh shape (the narrowed "shard_mesh" reason).
+        #
+        # The per-shard width floor is a partitioner-hazard guard, not a
+        # perf heuristic: at N=8 with tp>=4 the SPMD-partitioned
+        # preemption scan returned sel/nom tensors with every value
+        # DOUBLED (-1 came back -2, node 2 came back 4 — a partial sum
+        # an all-reduce never folded), byte-identical at every width
+        # with >= _MIN_SHARD_NODES rows per shard.  Silent corruption,
+        # caught only because the doubled slot overran node_names — so
+        # narrow below the floor rather than trust the compiler there.
+        # A universe this small has nothing to gain from sharding
+        # anyway; see docs/churn_floor.md.
+        tp = math.gcd(self._tp_req, N)
+        while tp > 1 and N // tp < _MIN_SHARD_NODES:
+            tp //= 2
+        if self._shard_mesh_obj is not None and tp != self._tp_req:
+            raise _Unsupported("shard_mesh")
         ipa = feats.aux["interpod"]
         spread = feats.aux["spread"]
 
@@ -2435,6 +2517,7 @@ class ReplayDriver:
             preempt=preempt_plan,
             c_max=PREEMPT_CANDIDATES,
             v_max=PREEMPT_VICTIMS,
+            tp=tp,
         )
         const = {
             "node": dict(
@@ -2464,19 +2547,24 @@ class ReplayDriver:
         for p in cur_pods:
             if p.get("status", {}).get("nominatedNodeName"):
                 nominated0[row_of[_pod_key(p)]] = True
-        if self._record_mode == "full":
-            # Stacked result tensors multiply one pass's [Q, F|S, N]
-            # footprint by K on-device — bound it before dispatch.
-            bits_dt, final_dt = prog._result_dtypes()
-            n_f = sum(1 for sp in plugins if sp.filter_enabled)
-            n_s = sum(1 for sp in plugins if sp.score_enabled)
-            per_cell = (
-                n_f * np.dtype(bits_dt).itemsize
-                + n_s * 4
-                + n_s * np.dtype(final_dt).itemsize
-            )
-            if K * q * N * per_cell > FULL_RECORD_BYTES:
-                raise _Unsupported("full_record_bytes")
+        # Stacked result tensors multiply one pass's [Q, F|S, N]
+        # footprint by K on-device — bound it before dispatch.  The
+        # budget is PER SHARD (round 17): each chip holds N/tp node
+        # columns of every stacked tensor, so record="full" headroom
+        # scales with the mesh.  Computed in every record mode (the
+        # lower_log / bench rung report it as sizing evidence); only
+        # record="full" actually allocates, so only it rejects.
+        bits_dt, final_dt = prog._result_dtypes()
+        n_f = sum(1 for sp in plugins if sp.filter_enabled)
+        n_s = sum(1 for sp in plugins if sp.score_enabled)
+        per_cell = (
+            n_f * np.dtype(bits_dt).itemsize
+            + n_s * 4
+            + n_s * np.dtype(final_dt).itemsize
+        )
+        full_bytes_shard = K * q * (N // tp) * per_cell
+        if self._record_mode == "full" and full_bytes_shard > FULL_RECORD_BYTES:
+            raise _Unsupported("full_record_bytes")
         if preempt_plan:
             from ksim_tpu.scheduler.preemption import (
                 more_important_key,
@@ -2578,6 +2666,8 @@ class ReplayDriver:
                 "universe": U,
                 "rows_built": self._featurizer.pod_rows_built - rows_built0,
                 "cache_hit": use_cache,
+                "tp": tp,
+                "full_bytes_per_shard": int(full_bytes_shard),
             }
         )
         return _SegmentPlan(
@@ -2603,6 +2693,7 @@ class ReplayDriver:
             prio_gen=prio_gen,
             sched_names=sched_names,
             dev_collect=bool(self._dev_cache_on),
+            mesh=self._shard_mesh_obj,
         )
 
     @staticmethod
@@ -2735,9 +2826,22 @@ class ReplayDriver:
         from ksim_tpu.engine.core import _pull_tree_to_host
 
         FAULTS.check("replay.dispatch")
-        const_dev, (ev_dev, state_dev) = _pack_plan_buffers(
-            plan, (plan.ev, plan.state0)
-        )
+        if plan.statics.tp > 1:
+            # Round 17: committed NamedShardings on every input leaf —
+            # GSPMD lays the node axis over the tp mesh and inserts the
+            # per-step collectives; the scan carry stays sharded on
+            # device end to end.  An explicit service mesh rides on the
+            # plan; the env-knob mesh is built lazily HERE (this is the
+            # watchdogged worker — jax.devices() may initialize the
+            # backend, which must never happen on the main thread).
+            mesh = plan.mesh if plan.mesh is not None else _tp_mesh(plan.statics.tp)
+            const_dev, (ev_dev, state_dev) = _shard_plan_buffers(
+                plan, (plan.ev, plan.state0), mesh
+            )
+        else:
+            const_dev, (ev_dev, state_dev) = _pack_plan_buffers(
+                plan, (plan.ev, plan.state0)
+            )
         final_state, outs = COMPILE_CACHE.run(
             _compile_cache_key("solo", plan, (const_dev, ev_dev, state_dev)),
             lambda: _segment_fn(
@@ -3125,9 +3229,16 @@ class _AotDiskSpec:
     def load(self, blob: bytes):
         """Serialized entry -> a dispatchable callable.  ``jax.jit``
         over the exported call keeps repeat dispatches on the fast
-        C++ path."""
+        C++ path.  A matching startup-prewarmed executable
+        (``prewarm_aot_cache``) is served instead of deserializing
+        again — the crc re-check means a rewritten entry can never be
+        handed a stale program."""
         from jax import export as jax_export
 
+        with _PREWARM_LOCK:
+            ent = _PREWARMED.get(self.path)
+        if ent is not None and ent[0] == (zlib.crc32(blob) & 0xFFFFFFFF):
+            return ent[1]
         return jax.jit(jax_export.deserialize(blob).call)
 
     def invoke(self, exec_obj):
@@ -3167,9 +3278,61 @@ def _aot_disk_spec(kind: str, plan: "_SegmentPlan", args) -> "_AotDiskSpec | Non
     ))
     if body is None:
         return None
-    token = f"{jax.__version__}|{jax.default_backend()}|{body}"
+    # The device count joins the version/backend prefix (round 17): a
+    # serialized executable bakes its input shardings in, so a warm
+    # restart on a DIFFERENT topology (tp=8 entry, single-device host)
+    # must be a counted miss/eviction, never a wrong load.  The mesh
+    # width itself already rides in the statics (``tp``) inside body.
+    token = f"{jax.__version__}|{jax.default_backend()}|d{jax.device_count()}|{body}"
     name = hashlib.sha256(token.encode()).hexdigest()[:32] + ".aot"
     return _AotDiskSpec(os.path.join(base, name), token, plan, args)
+
+
+#: Executables deserialized at server startup (``prewarm_aot_cache``):
+#: path -> (crc32 of the stored blob, jitted call).  Consulted by
+#: ``_AotDiskSpec.load`` so the first tenant dispatch of an
+#: already-learned shape rung skips the deserialize round.
+_PREWARM_LOCK = threading.Lock()
+_PREWARMED: dict = {}  # guarded-by: _PREWARM_LOCK
+
+
+def prewarm_aot_cache() -> int:
+    """``KSIM_AOT_PREWARM=1`` (cmd/simulator.py): walk the on-disk AOT
+    directory at server startup and deserialize every entry whose token
+    matches THIS process's jax version / backend / device count —
+    load-only, never cold-compiles.  A corrupt, foreign-version or
+    foreign-topology entry is SKIPPED, not evicted: eviction authority
+    stays with the dispatch path's token check, where the exact rung
+    identity is known.  Returns the number prewarmed; the process-wide
+    ``compile_cache`` counters carry it as ``disk_prewarmed``."""
+    base = _aot_cache_dir()
+    if base is None or not os.path.isdir(base):
+        return 0
+    from jax import export as jax_export
+
+    prefix = f"{jax.__version__}|{jax.default_backend()}|d{jax.device_count()}|"
+    n = 0
+    for fname in sorted(os.listdir(base)):
+        if not fname.endswith(".aot"):
+            continue
+        path = os.path.join(base, fname)
+        ent = COMPILE_CACHE.read_disk_entry(path)
+        if ent is None:
+            continue
+        token, blob = ent
+        if not token.startswith(prefix):
+            continue
+        try:
+            call = jax.jit(jax_export.deserialize(blob).call)
+        except Exception:
+            logger.warning("aot prewarm: skipping undeserializable %s", fname)
+            continue
+        with _PREWARM_LOCK:
+            _PREWARMED[path] = (zlib.crc32(blob) & 0xFFFFFFFF, call)
+        n += 1
+    if n:
+        COMPILE_CACHE.note_prewarmed(n)
+    return n
 
 
 def _plan_const_parts(plan: "_SegmentPlan"):
@@ -3233,6 +3396,168 @@ def _pack_plan_buffers(plan: "_SegmentPlan", transient):
     )
     const_dev = _const_dev_dict(jax.tree_util.tree_unflatten(c_def, dev_c))
     transient_dev = jax.tree_util.tree_unflatten(t_def, packed[len(miss_idx):])
+    return const_dev, transient_dev
+
+
+#: Lazily built (1, tp) node meshes for env-requested sharded dispatch,
+#: memoized per width (mesh construction touches jax.devices()).
+_TP_MESH_LOCK = threading.Lock()
+_TP_MESHES: dict = {}  # guarded-by: _TP_MESH_LOCK
+
+
+def _tp_mesh(tp: int):
+    """The ``make_mesh(tp, dp=1)`` node mesh for ``KSIM_REPLAY_TP``
+    dispatches.  Built on the watchdogged worker only (``jax.devices``
+    initializes the backend — a wedged tunnel becomes a watchdog
+    timeout, never a main-thread hang); a host with fewer devices than
+    the requested width raises DeviceUnavailableError, which feeds the
+    ordinary device-error ladder and breaker instead of crashing the
+    run — dead-device containment is identical to tp=1."""
+    from ksim_tpu.engine import sharding
+
+    with _TP_MESH_LOCK:
+        mesh = _TP_MESHES.get(tp)
+        if mesh is None:
+            n = len(jax.devices())
+            if n < tp:
+                raise DeviceUnavailableError(
+                    f"KSIM_REPLAY_TP={tp} but only {n} device(s) present"
+                )
+            mesh = sharding.make_mesh(tp, dp=1)
+            _TP_MESHES[tp] = mesh
+        return mesh
+
+
+#: Carried cluster-state keys whose LEADING axis is the node axis [N] /
+#: [N, R] — sharded over tp.  Everything else in state0 (the pod-axis
+#: queue state and the pass counter) replicates: every chip needs the
+#: whole pod table to score its node shard, and the pod rows are tiny
+#: next to the node tensors (docs/scaling.md memory budgets).
+_NODE_STATE_KEYS = frozenset(
+    {"valid", "requested", "nonzero_requested", "pod_count",
+     "spread", "ip_cnt", "ip_eat", "ip_vw"}
+)
+
+
+def _plan_shard_specs(plan: "_SegmentPlan", transient, mesh):
+    """NamedSharding spec trees mirroring ``_plan_const_parts(plan)``
+    and the ``(ev, state0)`` transient tree, structure-identical so the
+    flattened leaves zip with the data leaves:
+
+    - node statics and node-leading aux tables ("node" in the AXES map,
+      state/encoding.py) lay their leading axis over tp;
+    - the per-step rank tensors (``rank``/``name_rank``, [K, N]) shard
+      axis 1 — their leading axis is the step;
+    - pod rows, event index lists, scalars and everything else
+      replicate (the pod axis must stay whole: the sequential-commit
+      scan's queue order is the parity contract).
+
+    The aux specs iterate the dict pairs manually: ``_aux_host``'s axes
+    tree carries ``None`` at leaf positions, which jax's tree_map would
+    read as an empty subtree and raise on."""
+    from ksim_tpu.engine import sharding
+
+    def node_lead(a):
+        return sharding.node_leading_sharding(mesh, np.ndim(a))
+
+    def repl(a):
+        return sharding.replicated_sharding(mesh, np.ndim(a))
+
+    node_spec = {k: node_lead(v) for k, v in plan.const["node"].items()}
+    pods_spec = {k: repl(v) for k, v in plan.const["pods"].items()}
+    extra_spec = {
+        k: repl(plan.const[k])
+        for k in ("resolv", "empty_start_rank")
+        if k in plan.const
+    }
+    from ksim_tpu.engine.core import _aux_host
+
+    aux_host, aux_axes = _aux_host(plan.aux)
+    aux_spec: dict = {}
+    for k, v in aux_host.items():
+        ax = aux_axes[k]
+        if isinstance(v, dict):
+            aux_spec[k] = {
+                name: node_lead(arr)
+                if ax.get(name) == "node" and np.ndim(arr)
+                else repl(arr)
+                for name, arr in v.items()
+            }
+        else:
+            aux_spec[k] = jax.tree_util.tree_map(repl, v)
+    ev, state0 = transient
+    ev_spec = {
+        k: sharding.node_axis_sharding(mesh, np.ndim(v), 1)
+        if k in ("rank", "name_rank")
+        else repl(v)
+        for k, v in ev.items()
+    }
+    state_spec = {
+        k: node_lead(v) if k in _NODE_STATE_KEYS else repl(v)
+        for k, v in state0.items()
+    }
+    return (node_spec, pods_spec, extra_spec, aux_spec), (ev_spec, state_spec)
+
+
+def _shard_plan_buffers(plan: "_SegmentPlan", transient, mesh):
+    """The tp>1 mirror of ``_pack_plan_buffers``: the same id-keyed
+    constant-buffer reuse protocol, but every transferred leaf goes up
+    COMMITTED to its NamedSharding (one batched ``jax.device_put`` over
+    the miss + transient leaves — jit then respects the input layouts
+    without in_shardings and GSPMD propagates them through the scan).
+    Reuse hits return buffers already laid out for this mesh width: the
+    driver only attaches a reuse map whose recorded tp matches the
+    plan's (prepare_segment), so a tp change re-shards everything while
+    an unchanged-universe redispatch re-shards only changed host arrays.
+
+    Returns ``(const_dev, transient_dev)`` exactly like the packed
+    path."""
+    c_spec, t_spec = _plan_shard_specs(plan, transient, mesh)
+    cacheable = _plan_const_parts(plan)
+    c_leaves, c_def = jax.tree_util.tree_flatten(cacheable)
+    cs_leaves = jax.tree_util.tree_leaves(c_spec)
+    t_leaves, t_def = jax.tree_util.tree_flatten(transient)
+    ts_leaves = jax.tree_util.tree_leaves(t_spec)
+
+    # Mirror _pack_tree_to_device's host canonicalization EXACTLY, so a
+    # sharded dispatch sees the same avals as a packed one and shares
+    # its compiled shape rung: np.ascontiguousarray promotes 0-d leaves
+    # to (1,) (pass_count, scalar aux), and with x64 off 64-bit leaves
+    # downcast by value.  A () -vs- (1,) skew here is not cosmetic — it
+    # compiles a DIFFERENT program whose broadcasting silently corrupts
+    # the scan (selected slots past N were observed under tp=4).
+    x64 = bool(jax.config.jax_enable_x64)
+
+    def _canon(a):
+        if isinstance(a, np.ndarray):
+            a = np.ascontiguousarray(a)
+            if not x64 and a.dtype.itemsize == 8 and a.dtype.kind in "iuf":
+                a = a.astype(np.dtype(f"{a.dtype.kind}4"))
+        return a
+    reuse = plan.dev_reuse
+    dev_c: list[Any] = [None] * len(c_leaves)
+    miss_idx: list[int] = []
+    for i, a in enumerate(c_leaves):
+        ent = reuse.get(id(a)) if reuse else None
+        if ent is not None and ent[0] is a:
+            dev_c[i] = ent[1]
+        else:
+            miss_idx.append(i)
+    put = jax.device_put(
+        [_canon(c_leaves[i]) for i in miss_idx] + [_canon(a) for a in t_leaves],
+        [cs_leaves[i] for i in miss_idx] + ts_leaves,
+    )
+    for pos, i in enumerate(miss_idx):
+        dev_c[i] = put[pos]
+    plan.dev_hits = len(c_leaves) - len(miss_idx)
+    plan.dev_misses = len(miss_idx)
+    plan.dev_map_out = (
+        {id(a): (a, d) for a, d in zip(c_leaves, dev_c)}
+        if plan.dev_collect
+        else None
+    )
+    const_dev = _const_dev_dict(jax.tree_util.tree_unflatten(c_def, dev_c))
+    transient_dev = jax.tree_util.tree_unflatten(t_def, put[len(miss_idx):])
     return const_dev, transient_dev
 
 
@@ -3327,6 +3652,10 @@ class _SegmentPlan:
     dev_map_out: "dict | None" = None
     dev_hits: int = 0
     dev_misses: int = 0
+    # Round 17: the EXPLICIT service shard_mesh this plan was lowered
+    # for (None for env-knob sharding — _device_exec builds that mesh
+    # lazily on the worker — and for tp=1 plans).
+    mesh: Any = None
 
 
 class _Unsupported(ReplayFallback):

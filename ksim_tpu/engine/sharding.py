@@ -103,6 +103,29 @@ def replicate_tree(tree, mesh: Mesh):
     return jax.tree_util.tree_map(put, tree)
 
 
+def node_leading_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Sharding laying a tensor's LEADING axis over ``tp`` (the node
+    axis of the device replay's ``[N]``/``[N, R]`` state); later axes
+    replicated.  Scalars replicate."""
+    if ndim == 0:
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(TP, *([None] * (ndim - 1))))
+
+
+def node_axis_sharding(mesh: Mesh, ndim: int, axis: int) -> NamedSharding:
+    """Sharding laying one interior axis over ``tp`` (the replay's
+    per-step ``[K, N]`` event rank tables shard axis 1, not 0)."""
+    spec = [None] * ndim
+    spec[axis] = TP
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Fully replicated sharding (pod-axis and scalar replay state:
+    every chip needs the whole pod table to score its node shard)."""
+    return NamedSharding(mesh, P(*([None] * ndim)))
+
+
 def shard_aux(aux: dict, axes: dict, mesh: Mesh) -> dict:
     """Shard encoding arrays by their declared leading-axis kind
     ("node" -> TP, "pod" -> DP, None -> replicated) — see the AXES

@@ -127,7 +127,10 @@ class SchedulerService:
         # Optional jax.sharding.Mesh: every engine this service builds is
         # laid out over it (node axis over "tp", engine/sharding.py).  The
         # sequential scan wants replicated pod rows — pass a dp=1 mesh
-        # (make_mesh(n, dp=1)) for the scheduling path.
+        # (make_mesh(n, dp=1)) for the scheduling path.  The device
+        # churn replay honors the same mesh (round 17): a dp=1 mesh
+        # with a tp axis shards the segment scan's node tensors; any
+        # other shape is a "shard_mesh" per-pass fallback.
         self._shard_mesh = shard_mesh
         # builderImport in runtime-applied configs (HTTP / snapshot load)
         # executes arbitrary imports; off unless the operator opts in.

@@ -326,3 +326,98 @@ def test_churn_fleet_lock_6k_lanes8(mode, monkeypatch):
     )
     cache = leader.stats()["lower_cache"]
     assert cache["misses"] == 1 and cache["invalidations"] == 0, cache
+
+
+# ---------------------------------------------------------------------------
+# Round 17: the locked counts through the tp-SHARDED device path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_churn_lock_6k_sharded_tp8(monkeypatch):
+    """The flagship locked prefix with the node axis laid over a tp=8
+    mesh (8 virtual CPU devices, conftest): 2524/471 byte-identical,
+    stepwise-identical to the SOLO device run, same device coverage,
+    zero shard_mesh fallbacks, every lowered segment at tp=8.  GSPMD
+    value-preservation is the claim under test — the collectives the
+    partitioner inserts must never show up in the counts."""
+    jax.config.update("jax_enable_x64", False)
+
+    def run():
+        runner = ScenarioRunner(
+            max_pods_per_pass=1024,
+            pod_bucket_min=128,
+            device_replay=True,
+            device_segment_steps=16,
+        )
+        res = runner.run(
+            churn_scenario(0, n_nodes=2000, n_events=6000, ops_per_step=100)
+        )
+        return runner, res
+
+    monkeypatch.delenv("KSIM_REPLAY_TP", raising=False)
+    solo_r, solo = run()
+    monkeypatch.setenv("KSIM_REPLAY_TP", "8")
+    shard_r, shard = run()
+    assert shard.events_applied == LOCK_EVENTS
+    assert (shard.pods_scheduled, shard.unschedulable_attempts) == (
+        LOCK_SCHEDULED,
+        LOCK_UNSCHEDULABLE,
+    )
+    solo_sig = [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in solo.steps
+    ]
+    shard_sig = [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in shard.steps
+    ]
+    assert shard_sig == solo_sig
+    d = shard_r.replay_driver
+    assert d.device_steps == solo_r.replay_driver.device_steps
+    assert d.device_steps >= 32
+    assert "shard_mesh" not in d.unsupported, d.unsupported
+    assert sorted({e["tp"] for e in d.lower_log}) == [8], d.lower_log
+    # The per-shard full-record budget evidence rides on every entry.
+    assert all("full_bytes_per_shard" in e for e in d.lower_log)
+
+
+@pytest.mark.slow
+def test_churn_lock_50k_stepwise_sharded_tp8(monkeypatch):
+    """The FULL 50k flagship stream under the tp=8 mesh: 52781/42829,
+    stepwise-identical to the per-pass path, zero fallbacks — the
+    100k-node-scale memory story (per-shard budgets) must not cost a
+    single count.  Bench-tier wall clock; `make lock-check`."""
+    jax.config.update("jax_enable_x64", False)
+
+    monkeypatch.delenv("KSIM_REPLAY_TP", raising=False)
+    base = ScenarioRunner(max_pods_per_pass=1024, pod_bucket_min=128).run(
+        churn_scenario(0, n_nodes=2000, n_events=50_000, ops_per_step=100)
+    )
+    assert (base.pods_scheduled, base.unschedulable_attempts) == (
+        LOCK_50K_SCHEDULED,
+        LOCK_50K_UNSCHEDULABLE,
+    )
+    monkeypatch.setenv("KSIM_REPLAY_TP", "8")
+    runner = ScenarioRunner(
+        max_pods_per_pass=1024,
+        pod_bucket_min=128,
+        device_replay=True,
+        device_segment_steps=16,
+    )
+    dev = runner.run(
+        churn_scenario(0, n_nodes=2000, n_events=50_000, ops_per_step=100)
+    )
+    assert (dev.pods_scheduled, dev.unschedulable_attempts) == (
+        LOCK_50K_SCHEDULED,
+        LOCK_50K_UNSCHEDULABLE,
+    )
+    base_sig = [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in base.steps
+    ]
+    dev_sig = [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in dev.steps
+    ]
+    assert dev_sig == base_sig
+    d = runner.replay_driver
+    assert d.fallback_steps == 0, d.unsupported
+    assert d.device_steps == len(dev.steps)
+    assert sorted({e["tp"] for e in d.lower_log}) == [8]

@@ -491,3 +491,76 @@ def test_bench_emits_json_when_probe_backend_is_dead():
     assert out["metric"] == "sched_pairs_per_sec"
     assert out["value"] > 0
     assert out["platform"] == "cpu"  # the fallback environment ran it
+
+
+def test_bench_churn_shard_child_records_shard_evidence(tmp_path):
+    """Round 17: the churn_shard child runs the SAME stream at tp=1 and
+    tp=8 in one process and its record carries the sharding acceptance
+    evidence — counts_match/device_steps_match, every tp=8 segment
+    lowered at width 8 with zero shard_mesh fallbacks, the per-shard
+    full-record byte budget shrunk by the mesh width, and the per-chip
+    memory watermark field next to the phases split (null on CPU, whose
+    backend has no memory_stats)."""
+    out = tmp_path / "shard.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_shard", "--out", str(out),
+            "--seed", "0", "--churn-events", "800", "--churn-nodes", "200",
+            "--shard-tp", "8",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=sanitized_cpu_env(),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["counts_match"] is True
+    assert rec["device_steps_match"] is True
+    tp1, tp8 = rec["modes"]["tp1"], rec["modes"]["tp8"]
+    assert tp1["lowered_tps"] == [1] and tp8["lowered_tps"] == [8]
+    for mode in (tp1, tp8):
+        assert "shard_mesh" not in mode["unsupported"], mode["unsupported"]
+        assert mode["fallback_steps"] == 0
+        assert mode["device_steps"] >= 1
+        assert "phases" in mode and "replay.dispatch" in mode["phases"]
+        assert "per_chip_peak_bytes" in mode
+    # The round-17 memory claim in one line: the full-record budget is
+    # per shard, so tp=8 carries 1/8th of tp=1's bytes per chip.
+    assert (
+        tp8["full_bytes_per_shard_max"] * 8 == tp1["full_bytes_per_shard_max"]
+    )
+
+
+def test_bench_churn_shard_child_survives_dead_device(tmp_path):
+    """One-JSON-line-under-any-hardware, shard edition: with every
+    dispatch failing, BOTH widths degrade to the per-pass host path,
+    the counts still match between them, and the record still exists."""
+    out = tmp_path / "shard_dead.json"
+    env = sanitized_cpu_env(
+        {
+            "KSIM_FAULTS": "replay.dispatch=always@device",
+            "KSIM_REPLAY_BREAKER_N": "2",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_shard", "--out", str(out),
+            "--seed", "0", "--churn-events", "300", "--churn-nodes", "64",
+            "--shard-tp", "8",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["counts_match"] is True  # the host path carried both widths
+    for mode in rec["modes"].values():
+        assert mode["device_steps"] == 0
+        assert mode["unsupported"].get("device_error", 0) >= 1

@@ -411,3 +411,123 @@ def test_lock_holds_with_prelower_fault_6k():
     assert FAULTS.fired("replay.prelower") == 1
     assert d.prelower_faults == 1
     assert d.fallback_steps == 0
+
+
+# ---------------------------------------------------------------------------
+# Round 17: startup AOT prewarm (KSIM_AOT_PREWARM — load-only warm start)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _clean_aot_plane():
+    """Process-wide prewarm registry + compile cache, restored after."""
+    import ksim_tpu.engine.replay as R
+    from ksim_tpu.engine.compilecache import COMPILE_CACHE
+
+    with R._PREWARM_LOCK:
+        R._PREWARMED.clear()
+    COMPILE_CACHE.reset()
+    yield
+    with R._PREWARM_LOCK:
+        R._PREWARMED.clear()
+    COMPILE_CACHE.reset()
+
+
+def _prewarm_stream():
+    from tests.helpers import make_node, make_pod
+
+    for i in range(4):
+        yield Operation(
+            step=0, op="create", kind="nodes",
+            obj=make_node(f"n-{i}", cpu="8", memory="16Gi"),
+        )
+    for step in (1, 2, 3):
+        yield Operation(
+            step=step, op="create", kind="pods",
+            obj=make_pod(f"p-{step}", cpu="500m", memory="512Mi"),
+        )
+
+
+def test_aot_prewarm_serves_without_deserializing(
+    tmp_path, monkeypatch, _clean_aot_plane
+):
+    """The startup pass (prewarm_aot_cache) deserializes every on-disk
+    rung ONCE; the first tenant dispatch of each rung is then served
+    from the prewarm registry.  Proof: with jax.export.deserialize
+    broken after the prewarm, a cold-cache run still lands every disk
+    load as a hit with ZERO evictions — the dispatch path never needed
+    the deserializer."""
+    import os
+
+    import ksim_tpu.engine.replay as R
+    from ksim_tpu.engine.compilecache import COMPILE_CACHE
+
+    monkeypatch.setenv("KSIM_AOT_CACHE", str(tmp_path))
+    runner = ScenarioRunner(device_replay=True, device_segment_steps=4)
+    runner.run(_prewarm_stream())
+    assert runner.replay_driver.device_steps >= 1
+    stored = [f for f in os.listdir(tmp_path) if f.endswith(".aot")]
+    assert stored, "seeding run persisted no AOT entries"
+    assert COMPILE_CACHE.snapshot()["disk_stores"] >= 1
+
+    # "Restarted server": cold in-memory cache, same disk.
+    COMPILE_CACHE.reset()
+    n = R.prewarm_aot_cache()
+    assert n == len(stored)
+    snap = COMPILE_CACHE.snapshot()
+    assert snap["disk_prewarmed"] == n
+    with R._PREWARM_LOCK:
+        assert len(R._PREWARMED) == n
+
+    def boom(_blob):
+        raise AssertionError("dispatch path deserialized despite prewarm")
+
+    monkeypatch.setattr("jax.export.deserialize", boom)
+    runner2 = ScenarioRunner(device_replay=True, device_segment_steps=4)
+    runner2.run(_prewarm_stream())
+    assert runner2.replay_driver.device_steps >= 1
+    snap2 = COMPILE_CACHE.snapshot()
+    assert snap2["disk_hits"] >= 1
+    assert snap2["disk_evictions"] == 0, snap2
+
+
+def test_aot_prewarm_skips_foreign_entries_without_evicting(
+    tmp_path, monkeypatch, _clean_aot_plane
+):
+    """Load-only means load-only: a foreign-version token, a corrupt
+    blob and a garbage header are all SKIPPED — counted nowhere,
+    deleted never (eviction authority stays with the dispatch path's
+    token check)."""
+    import json
+    import os
+    import zlib
+
+    import ksim_tpu.engine.replay as R
+    from ksim_tpu.engine.compilecache import COMPILE_CACHE
+
+    monkeypatch.setenv("KSIM_AOT_CACHE", str(tmp_path))
+    blob = b"not-an-executable"
+
+    def entry(token, payload, crc=None):
+        header = json.dumps(
+            {"v": 1, "key": token, "crc": crc if crc is not None else (zlib.crc32(payload) & 0xFFFFFFFF)}
+        ).encode()
+        return header + b"\n" + payload
+
+    foreign = f"jax-9.9.9|cpu|d{jax.device_count()}|rest"
+    native_prefix = f"{jax.__version__}|{jax.default_backend()}|d{jax.device_count()}|rest"
+    (tmp_path / "foreign.aot").write_bytes(entry(foreign, blob))
+    # Native prefix but the blob is not a serialized executable: the
+    # deserialize attempt fails and the entry is skipped in place.
+    (tmp_path / "undeser.aot").write_bytes(entry(native_prefix, blob))
+    (tmp_path / "corrupt.aot").write_bytes(entry(native_prefix, blob, crc=1))
+    (tmp_path / "garbage.aot").write_bytes(b"\x00 no header here")
+
+    assert R.prewarm_aot_cache() == 0
+    assert COMPILE_CACHE.snapshot()["disk_prewarmed"] == 0
+    assert COMPILE_CACHE.snapshot()["disk_evictions"] == 0
+    with R._PREWARM_LOCK:
+        assert not R._PREWARMED
+    assert sorted(os.listdir(tmp_path)) == [
+        "corrupt.aot", "foreign.aot", "garbage.aot", "undeser.aot",
+    ]

@@ -664,3 +664,194 @@ def test_fleet_vmap_cohort_tiny_stream(monkeypatch):
     assert fleet_r.fleet_driver.stats()["lanes_on_device"] == 1.0
     for ln in fleet_r.fleet_lanes:
         assert _steps_sig(ln.result) == _steps_sig(solo), f"lane {ln.idx}"
+
+
+# ---------------------------------------------------------------------------
+# Round 17: tp-sharded device replay (KSIM_REPLAY_TP / service shard_mesh)
+# ---------------------------------------------------------------------------
+
+
+def _run_sharded_pair(stream_factory, tp, monkeypatch, *, k=8, **runner_kw):
+    """The same stream through the solo device path and the
+    KSIM_REPLAY_TP-sharded one (conftest forces 8 virtual CPU devices);
+    returns both results and both drivers so callers can pin counts AND
+    coverage evidence."""
+    jax.config.update("jax_enable_x64", False)
+    monkeypatch.delenv("KSIM_REPLAY_TP", raising=False)
+    solo_r = ScenarioRunner(device_replay=True, device_segment_steps=k, **runner_kw)
+    solo = solo_r.run(stream_factory())
+    monkeypatch.setenv("KSIM_REPLAY_TP", str(tp))
+    shard_r = ScenarioRunner(device_replay=True, device_segment_steps=k, **runner_kw)
+    shard = shard_r.run(stream_factory())
+    return solo, solo_r, shard, shard_r
+
+
+def _lowered_tps(driver):
+    return sorted({e["tp"] for e in driver.lower_log})
+
+
+def test_device_sharded_small_churn_byte_parity(monkeypatch):
+    """KSIM_REPLAY_TP=8 lays the node axis over a tp mesh: per-step
+    triples, totals and device coverage must all be byte-identical to
+    the solo device run, with proof the mesh was honored (every lowered
+    segment at tp=8) and zero shard_mesh fallbacks."""
+    solo, solo_r, shard, shard_r = _run_sharded_pair(
+        lambda: churn_scenario(0, n_nodes=200, n_events=800, ops_per_step=50),
+        8, monkeypatch, max_pods_per_pass=1024, pod_bucket_min=128,
+    )
+    assert _steps_sig(shard) == _steps_sig(solo)
+    assert (shard.pods_scheduled, shard.unschedulable_attempts) == (
+        solo.pods_scheduled, solo.unschedulable_attempts,
+    )
+    d = shard_r.replay_driver
+    assert d.device_steps == solo_r.replay_driver.device_steps
+    assert d.device_steps >= 8
+    assert "shard_mesh" not in d.unsupported, d.unsupported
+    assert _lowered_tps(d) == [8], d.lower_log
+    assert _lowered_tps(solo_r.replay_driver) == [1]
+
+
+def test_device_sharded_full_record_annotations_byte_parity(monkeypatch):
+    """record="full" under the mesh: result tensors stream out of the
+    sharded scan per shard, and the host decode must land every pod
+    annotation (filter/score/finalscore maps, history, selected-node)
+    byte-identical to the solo device run.  The per-shard byte budget is
+    the point of round 17 — the lower log must carry it."""
+
+    def annos(runner):
+        return {
+            p["metadata"]["name"]: p["metadata"].get("annotations", {})
+            for p in runner.store.list("pods")
+        }
+
+    solo, solo_r, shard, shard_r = _run_sharded_pair(
+        lambda: churn_scenario(0, n_nodes=24, n_events=160, ops_per_step=16),
+        8, monkeypatch, record="full", max_pods_per_pass=64, pod_bucket_min=32,
+    )
+    assert _steps_sig(shard) == _steps_sig(solo)
+    assert annos(shard_r) == annos(solo_r)
+    d = shard_r.replay_driver
+    assert d.device_steps == solo_r.replay_driver.device_steps
+    assert _lowered_tps(d) == [8]
+    for entry in d.lower_log:
+        assert entry["full_bytes_per_shard"] > 0
+
+
+def test_device_sharded_preemption_parity_narrows_tiny_universe(monkeypatch):
+    """Preemption through the sharded scan on a universe SMALLER than
+    the requested mesh: the width floor (_MIN_SHARD_NODES) narrows
+    tp=8 to tp=2 at N=8 instead of trusting the partitioner below it
+    (the sel/nom doubling hazard — see _lower), and the narrowed run
+    still lands store, eviction order and counts byte-identical."""
+
+    def stream():
+        for i in range(3):
+            yield Operation(
+                step=0, op="create", kind="nodes",
+                obj=make_node(f"n-{i}", cpu="4", memory="16Gi"),
+            )
+        for step in range(1, 17):
+            prio = [0, 0, 5, 10][step % 4]
+            pod = make_pod(
+                f"p-{step}", cpu="1500m", memory="256Mi", priority=prio
+            )
+            pod["metadata"]["creationTimestamp"] = f"2026-01-{step:02d}T00:00:00Z"
+            yield Operation(step=step, op="create", kind="pods", obj=pod)
+
+    def pods_state(runner):
+        return sorted(
+            (
+                p["metadata"]["name"],
+                p.get("spec", {}).get("nodeName"),
+                p.get("status", {}).get("nominatedNodeName"),
+            )
+            for p in runner.store.list("pods")
+        )
+
+    jax.config.update("jax_enable_x64", False)
+    monkeypatch.delenv("KSIM_REPLAY_TP", raising=False)
+    solo_r = ScenarioRunner(
+        preemption=True, device_replay=True, device_segment_steps=4
+    )
+    ev_solo = _collect_evictions(solo_r)
+    solo = solo_r.run(stream())
+    monkeypatch.setenv("KSIM_REPLAY_TP", "8")
+    shard_r = ScenarioRunner(
+        preemption=True, device_replay=True, device_segment_steps=4
+    )
+    ev_shard = _collect_evictions(shard_r)
+    shard = shard_r.run(stream())
+    assert _steps_sig(shard) == _steps_sig(solo)
+    assert pods_state(shard_r) == pods_state(solo_r)
+    assert ev_shard == ev_solo
+    assert ev_solo, "stream never triggered preemption — fixture is vacuous"
+    d = shard_r.replay_driver
+    assert d.device_steps == solo_r.replay_driver.device_steps
+    assert _lowered_tps(d) == [2], d.lower_log  # the floor, not the request
+
+
+def test_device_sharded_explicit_mesh_contract():
+    """An explicit service shard_mesh is a layout contract: a dp=1 tp
+    mesh is honored by the device path (every segment lowered at its
+    width); any other shape falls back per-pass with the narrowed
+    "shard_mesh" reason — and both land the same counts."""
+    from ksim_tpu.engine.sharding import make_mesh
+    from ksim_tpu.scheduler.service import SchedulerService
+    from ksim_tpu.state.cluster import ClusterStore
+
+    jax.config.update("jax_enable_x64", False)
+
+    def run(mesh):
+        store = ClusterStore()
+        svc = SchedulerService(store, shard_mesh=mesh)
+        runner = ScenarioRunner(
+            store, svc, device_replay=True, device_segment_steps=8,
+            max_pods_per_pass=1024, pod_bucket_min=128,
+        )
+        res = runner.run(
+            churn_scenario(0, n_nodes=48, n_events=200, ops_per_step=20)
+        )
+        return res, runner.replay_driver
+
+    base, base_d = run(None)
+    tp_res, tp_d = run(make_mesh(8, dp=1))
+    dp_res, dp_d = run(make_mesh(8, dp=2))
+    for res in (tp_res, dp_res):
+        assert (res.pods_scheduled, res.unschedulable_attempts) == (
+            base.pods_scheduled, base.unschedulable_attempts,
+        )
+    assert [(s.step, s.scheduled) for s in tp_res.steps] == [
+        (s.step, s.scheduled) for s in base.steps
+    ]
+    assert tp_d.device_steps == base_d.device_steps
+    assert _lowered_tps(tp_d) == [8]
+    assert "shard_mesh" not in tp_d.unsupported
+    # dp=2: rejected up front, every segment per-pass, counts intact.
+    assert dp_d.device_steps == 0
+    assert dp_d.unsupported.get("shard_mesh", 0) >= 1
+    assert not dp_d.lower_log
+
+
+def test_device_sharded_dead_device_contained(monkeypatch):
+    """A mesh wider than the host's devices is a DEVICE error, not a
+    lowering bug: the ladder counts device_error, the breaker opens
+    after the threshold, and the whole stream still lands the per-pass
+    counts (containment, repo invariant since round 4)."""
+    jax.config.update("jax_enable_x64", False)
+    monkeypatch.delenv("KSIM_REPLAY_TP", raising=False)
+    solo = ScenarioRunner(device_replay=True, device_segment_steps=8).run(
+        churn_scenario(0, n_nodes=48, n_events=200, ops_per_step=20)
+    )
+    # N=64 -> gcd(64, 64)=64, width-floor-narrowed to 16 — still wider
+    # than the 8 virtual devices, so every dispatch attempt dies in
+    # _tp_mesh before touching a buffer.
+    monkeypatch.setenv("KSIM_REPLAY_TP", "64")
+    shard_r = ScenarioRunner(device_replay=True, device_segment_steps=8)
+    shard = shard_r.run(
+        churn_scenario(0, n_nodes=48, n_events=200, ops_per_step=20)
+    )
+    assert _steps_sig(shard) == _steps_sig(solo)
+    d = shard_r.replay_driver
+    assert d.device_steps == 0
+    assert d.unsupported.get("device_error", 0) >= 1, d.unsupported
+    assert d.breaker_tripped
