@@ -75,7 +75,7 @@ jobs:
 # end-to-ends — interrupted-marking and checkpoint-resume on the locked
 # 6k stream (-m '' includes them).  Runs in the sanitized CPU env so it
 # works under ANY hardware condition.
-restart-check:
+restart-check: lint
 	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
 	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
 	'tests/test_jobs_durability.py', '-q', '-m', ''], env=sanitized_cpu_env()))"

@@ -697,7 +697,7 @@ def child_churn_restart(seed: int, n_nodes: int, n_events: int) -> dict:
     stop = threading.Event()
     t0 = time.perf_counter()
 
-    def _watch_first_bind() -> None:
+    def _watch_first_bind() -> None:  # ksimlint: thread-role(service-loop)
         while not stop.is_set():
             if runner.store.pods_with_node():
                 first_sched[0] = round(time.perf_counter() - t0, 3)

@@ -532,9 +532,13 @@ class FleetDriver:
                 return None
             if self._mesh is None:
                 try:
-                    self._mesh = fleet_mesh(self.dp)
+                    # Deliberate worker-side store: the mesh is built
+                    # lazily ON the dispatch worker so a wedged chip
+                    # tunnel hangs the watchdogged worker, never the
+                    # main thread; _mesh_lock makes both writes safe.
+                    self._mesh = fleet_mesh(self.dp)  # ksimlint: disable=thread-role
                 except Exception as e:
-                    self._mesh_failed = True
+                    self._mesh_failed = True  # ksimlint: disable=thread-role
                     logger.warning(
                         "KSIM_FLEET_DP=%d mesh unavailable (%s: %s); fleet "
                         "dispatch stays single-device",

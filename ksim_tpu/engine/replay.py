@@ -3296,7 +3296,7 @@ _PREWARM_LOCK = threading.Lock()
 _PREWARMED: dict = {}  # guarded-by: _PREWARM_LOCK
 
 
-def prewarm_aot_cache() -> int:
+def prewarm_aot_cache() -> int:  # ksimlint: thread-role(service-loop)
     """``KSIM_AOT_PREWARM=1`` (cmd/simulator.py): walk the on-disk AOT
     directory at server startup and deserialize every entry whose token
     matches THIS process's jax version / backend / device count —

@@ -113,10 +113,18 @@ class JobJournal:
     """Append-only JSONL WAL for one JobManager's registry.
 
     Thread-safe: appends from the submit path and every worker thread
-    serialize on ``_lock``.  Lock order: ``_lock`` is a LEAF — nothing
-    is called under it that takes a manager or job lock (the manager
-    calls ``maybe_compact`` with no locks held and passes a snapshot
-    callable that takes its own lock while ``_lock`` is free)."""
+    serialize on ``_lock``.  Lock order: ``_lock`` comes FIRST —
+    ``append``/``replay`` consult the fault plane under it, and
+    ``maybe_compact`` invokes the manager's snapshot callable under it
+    (journal ``_lock`` -> manager ``_lock`` -> job ``_cond``; the
+    manager side of that chain is declared beside
+    ``JobManager._journal_records``)."""
+
+    # Machine-checked acquisition order (tools/ksimlint lock-order —
+    # docs/lint.md "Lock order"): the fault plane, and the trace plane
+    # it emits into, are leaves under the journal lock.
+    # ksimlint: lock-order(JobJournal._lock<FaultPlane._lock)
+    # ksimlint: lock-order(JobJournal._lock<TracePlane._lock)
 
     def __init__(self, path: str, *, max_bytes: "int | None" = None) -> None:
         if max_bytes is None:
@@ -189,7 +197,7 @@ class JobJournal:
 
     # -- compaction ------------------------------------------------------
 
-    def maybe_compact(self, snapshot_fn: Callable[[], Iterable[dict]]) -> bool:
+    def maybe_compact(self, snapshot_fn: Callable[[], Iterable[dict]]) -> bool:  # ksimlint: thread-role(compactor)
         """Rewrite the journal as a snapshot of the LIVE registry when
         it outgrew ``max_bytes``.  ``snapshot_fn`` is called under the
         journal lock and must not take it again (the manager's registry

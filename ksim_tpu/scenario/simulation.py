@@ -29,6 +29,7 @@ from __future__ import annotations
 import json
 from typing import Any
 
+from ksim_tpu.errors import RunCancelled
 from ksim_tpu.scenario.runner import ScenarioResult, ScenarioRunner
 from ksim_tpu.scenario.spec import ScenarioSpecError, load_scenario
 from ksim_tpu.scheduler.service import SchedulerService
@@ -109,6 +110,10 @@ def run_scheduler_simulation(doc: "JSONObj | str | bytes") -> JSONObj:
     try:
         res = runner.run(ops)
         status = _result_status(res)
+    except RunCancelled:
+        # Cancellation is not a Failed phase: it must reach the job
+        # worker, which owns the cancelled-state transition.
+        raise
     except Exception as e:  # the KEP's Failed phase with a message
         status = {"phase": "Failed", "message": f"{type(e).__name__}: {e}"}
 

@@ -484,6 +484,17 @@ class SchedulerService:
                 return self._schedule_pending_inner()
         return self._schedule_pending_inner()
 
+    # Machine-checked acquisition order (tools/ksimlint lock-order —
+    # docs/lint.md "Lock order"): one pass takes the pass lock
+    # OUTERMOST, then everything it needs under it; the backoff lock
+    # nests a read-only store lookup; the planes are leaves.
+    # ksimlint: lock-order(SchedulerService._pass_lock<SchedulerService._backoff_lock<ClusterStore._lock)
+    # ksimlint: lock-order(SchedulerService._pass_lock<SchedulerService._waiting_lock)
+    # ksimlint: lock-order(SchedulerService._pass_lock<SchedulerService._own_rvs_lock)
+    # ksimlint: lock-order(SchedulerService._pass_lock<ClusterStore._lock)
+    # ksimlint: lock-order(SchedulerService._pass_lock<FaultPlane._lock)
+    # ksimlint: lock-order(SchedulerService._pass_lock<Metrics._lock)
+    # ksimlint: lock-order(SchedulerService._pass_lock<TracePlane._lock)
     def _schedule_pending_inner(self) -> dict[str, str | None]:
         with self._pass_lock:
             # The span covers the pass body only (not the lock wait):
@@ -1830,7 +1841,7 @@ class SchedulerService:
             self._own_rvs.add(updated["metadata"]["resourceVersion"])
         self._extenders.store.delete_data(pod)
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # ksimlint: thread-role(service-loop)
         stream = self._store.watch(self.WATCH_KINDS)
         try:
             try:

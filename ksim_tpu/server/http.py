@@ -468,7 +468,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:  # events: the SSE stream
             self._job_events(job)
 
-    def _job_events(self, job) -> None:
+    def _job_events(self, job) -> None:  # ksimlint: thread-role(sse-handler)
         """Server push of one job's progress + trace events as
         Server-Sent Events on a flushed chunked response — the
         listwatchresources streaming pattern (eventproxy.go:66-80)

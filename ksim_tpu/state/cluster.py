@@ -158,6 +158,10 @@ class ClusterStore:
 
     # -- transactions -------------------------------------------------------
 
+    # Machine-checked acquisition order (tools/ksimlint lock-order):
+    # commit/rollback emit trace events while holding the store lock —
+    # the trace plane is a leaf under it.
+    # ksimlint: lock-order(ClusterStore._lock<TracePlane._lock)
     @contextlib.contextmanager
     def transaction(self, *, epoch_exempt: bool = False):
         """All-or-nothing write batch.

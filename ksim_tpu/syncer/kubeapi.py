@@ -666,7 +666,7 @@ class KubeWatchStream:
                 )
         return rv, fresh
 
-    def _run_kind(self, kind: str, rv: str | None, known: set[str]) -> None:
+    def _run_kind(self, kind: str, rv: str | None, known: set[str]) -> None:  # ksimlint: thread-role(service-loop)
         path = _API_PATHS[kind]
         while not self._stop.is_set():
             try:

@@ -249,7 +249,7 @@ class Syncer:
             raise
         self._stop.clear()
 
-        def loop() -> None:
+        def loop() -> None:  # ksimlint: thread-role(service-loop)
             try:
                 while not self._stop.is_set():
                     ev = stream.next(timeout=0.1)

@@ -144,7 +144,7 @@ class LiveWriteBack:
 
     # -- event loop ----------------------------------------------------------
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # ksimlint: thread-role(service-loop)
         try:
             while not self._stop.is_set():
                 try:

@@ -1,0 +1,23 @@
+"""Seeded exception-flow violation (tests/test_lint.py).
+
+NOT imported by anything.  ``run_all``'s broad handler absorbs the
+RunCancelled that ``_step`` may raise (visible only through the call
+graph) without an ``except RunCancelled: raise`` arm and without
+re-raising or capturing the bound exception — the one expected
+finding.
+"""
+
+
+class RunCancelled(BaseException):
+    pass
+
+
+def _step():
+    raise RunCancelled()
+
+
+def run_all():
+    try:
+        _step()
+    except Exception:
+        return None

@@ -1,0 +1,40 @@
+"""Compliant exception flow — zero exception-flow findings
+(tests/test_lint.py).
+
+NOT imported by anything.  Pins every compliant shape: an explicit
+``except RunCancelled`` arm above the broad ladder, the
+capture-for-the-caller box pattern, and ReplayFallback raised only
+inside a ``_reject`` constructor.
+"""
+
+
+class RunCancelled(BaseException):
+    pass
+
+
+class ReplayFallback(Exception):
+    pass
+
+
+def _step():
+    raise RunCancelled()
+
+
+def guarded():
+    try:
+        _step()
+    except RunCancelled:
+        raise
+    except Exception:
+        return None
+
+
+def captured(box):
+    try:
+        _step()
+    except BaseException as e:
+        box["err"] = e
+
+
+def _reject(reason):
+    raise ReplayFallback(reason)

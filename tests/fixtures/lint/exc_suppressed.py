@@ -1,0 +1,21 @@
+"""Broad RunCancelled absorption with an inline waiver
+(tests/test_lint.py).
+
+NOT imported by anything.  Same shape as exc_bad.py; the ``disable``
+comment on the handler line records a justified exception.
+"""
+
+
+class RunCancelled(BaseException):
+    pass
+
+
+def _step():
+    raise RunCancelled()
+
+
+def run_all():
+    try:
+        _step()
+    except Exception:  # ksimlint: disable=exception-flow
+        return None

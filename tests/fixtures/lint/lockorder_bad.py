@@ -1,0 +1,38 @@
+"""Seeded lock-order deadlock (tests/test_lint.py).
+
+NOT imported by anything — the analyzer reads it as text.  BOTH
+nestings are DECLARED below, so neither edge is an undeclared-nesting
+finding; the one expected finding is the cycle: ``take_ab`` holds
+``_a`` across a call that acquires ``_b`` while ``take_ba`` holds
+``_b`` across a call that acquires ``_a`` — the classic ABBA deadlock,
+visible only interprocedurally (neither function nests two ``with``
+blocks lexically).
+"""
+
+import threading
+
+
+# Two declarations that together ARE a deadlock — the analyzer must
+# reject the pair, not trust them individually:
+# ksimlint: lock-order(Pair._a<Pair._b)
+# ksimlint: lock-order(Pair._b<Pair._a)
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def _grab_a(self):
+        with self._a:
+            return "a"
+
+    def _grab_b(self):
+        with self._b:
+            return "b"
+
+    def take_ab(self):
+        with self._a:
+            return self._grab_b()
+
+    def take_ba(self):
+        with self._b:
+            return self._grab_a()

@@ -1,0 +1,38 @@
+"""Declared, acyclic lock nesting — zero lock-order findings
+(tests/test_lint.py).
+
+NOT imported by anything.  ``Store.txn`` holds its RLock across a call
+into ``Plane.poke`` (receiver typed by the ``__init__`` parameter
+annotation); the nesting is declared, and the nested RE-acquisition of
+the RLock in ``_locked_size`` pins the reentrant-self-deadlock
+exemption for RLock domains.
+"""
+
+import threading
+
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = 0  # guarded-by: _lock
+
+    def poke(self):
+        with self._lock:
+            self.events += 1
+
+
+# ksimlint: lock-order(Store._lock<Plane._lock)
+class Store:
+    def __init__(self, plane: "Plane"):
+        self._lock = threading.RLock()
+        self.plane = plane
+        self.size = 0  # guarded-by: _lock
+
+    def _locked_size(self):
+        with self._lock:  # reentrant: fine, _lock is an RLock
+            return self.size
+
+    def txn(self):
+        with self._lock:
+            self.plane.poke()
+            return self._locked_size()

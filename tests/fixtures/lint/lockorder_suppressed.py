@@ -1,0 +1,21 @@
+"""Undeclared lock nesting with an inline waiver (tests/test_lint.py).
+
+NOT imported by anything.  ``nest`` acquires ``_inner`` under
+``_outer`` without a ``lock-order`` declaration; the ``disable``
+comment on the acquisition line suppresses the finding AND — because
+every witness of the edge is suppressed — waives the edge out of the
+cycle graph (tools/ksimlint/rules/lock_order.py).
+"""
+
+import threading
+
+
+class Holder:
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+
+    def nest(self):
+        with self._outer:
+            with self._inner:  # ksimlint: disable=lock-order
+                return 1

@@ -1,0 +1,24 @@
+"""Seeded thread-role violation (tests/test_lint.py).
+
+NOT imported by anything.  ``_work`` carries the dispatch-worker role;
+``_apply`` is reachable from it along a same-receiver edge and stores
+to ``self`` — the one expected finding.  The round-8 lexical check
+cannot see it (the store is not IN the annotated function), which is
+exactly what the interprocedural propagation adds.
+"""
+
+import threading
+
+
+class Driver:
+    def __init__(self):
+        self.done = 0
+
+    def start(self):
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):  # ksimlint: thread-role(dispatch-worker)
+        self._apply()
+
+    def _apply(self):
+        self.done = 1  # worker-reachable store: the seeded finding

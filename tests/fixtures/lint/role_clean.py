@@ -1,0 +1,25 @@
+"""Role-annotated threads with no violations (tests/test_lint.py).
+
+NOT imported by anything.  The worker only READS off-main (snapshot
+tearing is tolerated); the main-thread-pinned ``apply_result`` is
+never called from the worker's reachable set.
+"""
+
+import threading
+
+
+class Driver:
+    def __init__(self):
+        self.applied = 0  # guarded-by: main-thread
+
+    def start(self):
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):  # ksimlint: thread-role(dispatch-worker)
+        return self._peek()
+
+    def _peek(self):
+        return self.applied  # off-main read: tolerated
+
+    def apply_result(self):  # ksimlint: thread-role(main-thread)
+        self.applied = 1

@@ -1,0 +1,23 @@
+"""Worker-reachable store with an inline waiver (tests/test_lint.py).
+
+NOT imported by anything.  Same shape as role_bad.py; the ``disable``
+comment on the store line records a justified exception (the
+fleet-driver lazy-mesh pattern: a lock-guarded write that MUST happen
+on the worker so a wedged backend hangs the watchdogged thread).
+"""
+
+import threading
+
+
+class Driver:
+    def __init__(self):
+        self.done = 0
+
+    def start(self):
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):  # ksimlint: thread-role(dispatch-worker)
+        self._apply()
+
+    def _apply(self):
+        self.done = 1  # ksimlint: disable=thread-role

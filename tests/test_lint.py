@@ -23,10 +23,13 @@ import pytest
 from tools.ksimlint.core import DEFAULT_TARGETS, Project, mark_suppressed, run
 from tools.ksimlint.rules import (
     env_contract,
+    exception_flow,
     import_boundary,
     kernel_purity,
     lock_discipline,
+    lock_order,
     registry_literals,
+    thread_role,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -239,6 +242,170 @@ def test_env_contract_missing_docs_is_a_finding():
 
 
 # ---------------------------------------------------------------------------
+# lock-order (interprocedural — tools/ksimlint/callgraph.py)
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_seeded_deadlock_is_exactly_one_cycle():
+    """The ABBA fixture declares BOTH orders, so the only finding is
+    the cycle itself — visible only through the call graph (neither
+    function nests two with-blocks lexically)."""
+    open_, suppressed = _run_rule(lock_order.check, _project("lockorder_bad.py"))
+    assert not suppressed
+    assert len(open_) == 1, [f.message for f in open_]
+    assert "cycle" in open_[0].message
+    assert "Pair._a" in open_[0].message and "Pair._b" in open_[0].message
+
+
+def test_lock_order_suppression_waives_and_clean():
+    # Suppressing EVERY witness of an edge also waives it out of the
+    # cycle graph — one suppressed finding, nothing open.
+    open_, suppressed = _run_rule(
+        lock_order.check, _project("lockorder_suppressed.py")
+    )
+    assert not open_ and len(suppressed) == 1
+    assert "undeclared lock nesting" in suppressed[0].message
+    # Declared acyclic nesting + RLock reentrancy: nothing at all.
+    open_, suppressed = _run_rule(lock_order.check, _project("lockorder_clean.py"))
+    assert not open_ and not suppressed
+
+
+def test_lock_order_graph_covers_annotated_domains():
+    """Every annotated lock domain in the tree is a node the analyzer
+    can reason about — the coverage claim behind the zero-cycle gate."""
+    graph = Project.load(REPO, DEFAULT_TARGETS).callgraph()
+    required = {
+        "ClusterStore._lock",
+        "TracePlane._lock",
+        "FaultPlane._lock",
+        "JobQueue._cond",
+        "Job._cond",
+        "JobManager._lock",
+        "JobJournal._lock",
+        "CompileCache._lock",
+        "replay._PREWARM_LOCK",
+        "replay._TP_MESH_LOCK",
+    }
+    assert required <= set(graph.lock_kinds), sorted(graph.lock_kinds)
+    # The documented compaction chain is OBSERVED, not just declared:
+    # the qualified lock-held on JobManager._journal_records is what
+    # makes the dynamic snapshot_fn callback visible.
+    assert ("JobJournal._lock", "JobManager._lock") in graph.observed_edges()
+
+
+# ---------------------------------------------------------------------------
+# thread-role
+# ---------------------------------------------------------------------------
+
+
+def test_thread_role_seeded_worker_store_is_exactly_one_finding():
+    """The store lives in a helper the round-8 lexical check cannot
+    see; the interprocedural propagation reaches it."""
+    open_, suppressed = _run_rule(thread_role.check, _project("role_bad.py"))
+    assert not suppressed
+    assert len(open_) == 1, [f.message for f in open_]
+    assert "store to self.done" in open_[0].message
+    assert "reachable from dispatch-worker root" in open_[0].message
+
+
+def test_thread_role_suppression_and_clean():
+    open_, suppressed = _run_rule(thread_role.check, _project("role_suppressed.py"))
+    assert not open_ and len(suppressed) == 1
+    open_, suppressed = _run_rule(thread_role.check, _project("role_clean.py"))
+    assert not open_ and not suppressed
+
+
+def test_thread_role_unknown_role_and_missing_role_fire():
+    """A typo'd role would silently opt out of every propagated check;
+    an unannotated resolved Thread target is the same hazard."""
+    import textwrap
+
+    from tools.ksimlint.core import SourceFile
+
+    src = textwrap.dedent(
+        """
+        import threading
+
+
+        class D:
+            def start(self):
+                threading.Thread(target=self._work).start()
+                threading.Thread(target=self._other).start()
+
+            def _work(self):  # ksimlint: thread-role(cowboy)
+                pass
+
+            def _other(self):
+                pass
+        """
+    )
+    sf = SourceFile("m.py", "m.py", src)
+    findings = thread_role.check(Project("/tmp", {"m.py": sf}, ("m.py",)))
+    joined = "\n".join(f.message for f in findings)
+    assert "unknown thread-role 'cowboy'" in joined
+    assert "has no role annotation" in joined
+
+
+# ---------------------------------------------------------------------------
+# exception-flow
+# ---------------------------------------------------------------------------
+
+
+def test_exception_flow_seeded_absorption_is_exactly_one_finding():
+    """run_all's broad handler absorbs the RunCancelled its callee may
+    raise — known only through the call graph."""
+    open_, suppressed = _run_rule(exception_flow.check, _project("exc_bad.py"))
+    assert not suppressed
+    assert len(open_) == 1, [f.message for f in open_]
+    assert "broad except absorbs RunCancelled" in open_[0].message
+    assert "_step" in open_[0].message
+
+
+def test_exception_flow_suppression_and_clean():
+    open_, suppressed = _run_rule(exception_flow.check, _project("exc_suppressed.py"))
+    assert not open_ and len(suppressed) == 1
+    # Explicit RunCancelled arm, capture-box pattern, _reject-raised
+    # ReplayFallback: all compliant shapes, zero findings.
+    open_, suppressed = _run_rule(exception_flow.check, _project("exc_clean.py"))
+    assert not open_ and not suppressed
+
+
+def test_exception_flow_fault_and_fallback_channels():
+    """except InjectedFault outside the containment scopes and a direct
+    ReplayFallback raise outside _reject/_Unsupported both fire."""
+    import textwrap
+
+    from tools.ksimlint.core import SourceFile
+
+    src = textwrap.dedent(
+        """
+        class InjectedFault(Exception):
+            pass
+
+
+        class ReplayFallback(Exception):
+            pass
+
+
+        def contain(op):
+            try:
+                return op()
+            except InjectedFault:
+                return None
+
+
+        def bail(reason):
+            raise ReplayFallback(reason)
+        """
+    )
+    sf = SourceFile("m.py", "m.py", src)
+    findings = exception_flow.check(Project("/tmp", {"m.py": sf}, ("m.py",)))
+    joined = "\n".join(f.message for f in findings)
+    assert "explicit `except InjectedFault` outside" in joined
+    assert "direct `raise ReplayFallback(...)`" in joined
+
+
+# ---------------------------------------------------------------------------
 # The full tree (the same gate as `make lint`)
 # ---------------------------------------------------------------------------
 
@@ -252,8 +419,10 @@ def test_full_tree_has_zero_unsuppressed_findings():
     assert not open_, "\n" + "\n".join(f.format() for f in open_)
     # The suppressions that exist are the documented, justified ones;
     # a new suppression should be a conscious reviewable event, so pin
-    # the count.
-    assert len(findings) - len(open_) == 2, [f.format() for f in findings if f.suppressed]
+    # the count: two round-11 lock-discipline snapshots, the fleet
+    # driver's two deliberate on-worker mesh stores, and the waived
+    # construction-time JobManager._recover journal edge.
+    assert len(findings) - len(open_) == 5, [f.format() for f in findings if f.suppressed]
 
 
 def test_cli_human_and_json(tmp_path, capsys):
@@ -281,6 +450,73 @@ def test_cli_human_and_json(tmp_path, capsys):
     assert main(["--root", str(tmp_path), "mod.py", "--json"]) == 1
     doc = json_mod.loads(capsys.readouterr().out)
     assert doc["unsuppressed"] == 1 and doc["findings"][0]["rule"] == "lock-discipline"
+
+
+def test_cli_exits_1_on_seeded_concurrency_fixtures(capsys):
+    """The gate the ISSUE pins: the analyzer run on either seeded
+    fixture fails the build (exit 1) with exactly one finding."""
+    from tools.ksimlint.__main__ import main
+
+    assert main(["--root", REPO, "tests/fixtures/lint/lockorder_bad.py"]) == 1
+    out = capsys.readouterr().out
+    assert out.count("[lock-order]") == 1 and "cycle" in out
+    assert main(["--root", REPO, "tests/fixtures/lint/role_bad.py"]) == 1
+    out = capsys.readouterr().out
+    assert out.count("[thread-role]") == 1
+
+
+def test_cli_rule_flag_filters(capsys):
+    """--rule is the repeatable single-rule spelling of --rules; an
+    unknown rule is still a loud exit 2."""
+    from tools.ksimlint.__main__ import main
+
+    assert (
+        main(
+            [
+                "--root", REPO, "--rule", "exception-flow",
+                "tests/fixtures/lint/exc_bad.py",
+            ]
+        )
+        == 1
+    )
+    assert "[exception-flow]" in capsys.readouterr().out
+    assert main(["--root", REPO, "--rule", "lock-ordr"]) == 2
+
+
+def test_cli_sarif_output(capsys):
+    """--format sarif: schema-shaped SARIF 2.1.0 with rule metadata,
+    physical locations, and in-source suppression objects."""
+    import json as json_mod
+
+    from tools.ksimlint.__main__ import main
+
+    assert main(["--root", REPO, "--format", "sarif"]) == 0
+    doc = json_mod.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0" and doc["$schema"].endswith("sarif-2.1.0.json")
+    run0 = doc["runs"][0]
+    driver = run0["tool"]["driver"]
+    assert driver["name"] == "ksimlint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert len(rule_ids) == 8 and "lock-order" in rule_ids
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    # The real tree's findings are all suppressed: each result carries
+    # the in-source suppression object so an upload stays green.
+    assert run0["results"], "expected the audited suppressions to appear"
+    for res in run0["results"]:
+        assert res["ruleId"] in rule_ids
+        assert res["ruleIndex"] == rule_ids.index(res["ruleId"])
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"]
+        assert loc["region"]["startLine"] >= 1
+        assert res["suppressions"][0]["kind"] == "inSource"
+    # An OPEN finding has no suppressions key (SARIF viewers would
+    # otherwise hide it).
+    assert main(
+        ["--root", REPO, "--format", "sarif", "tests/fixtures/lint/exc_bad.py"]
+    ) == 1
+    doc = json_mod.loads(capsys.readouterr().out)
+    (res,) = doc["runs"][0]["results"]
+    assert "suppressions" not in res
 
 
 def test_cli_partial_target_and_typo(capsys):

@@ -1,9 +1,11 @@
 """CLI: ``python -m tools.ksimlint [targets...]`` (see docs/lint.md).
 
 Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage
-error.  ``--json`` emits one machine-readable document (all findings,
-suppressed included) for tooling; the human format prints unsuppressed
-findings as ``path:line: [rule] message``.
+error.  ``--format json`` emits one machine-readable document (all
+findings, suppressed included); ``--format sarif`` emits SARIF 2.1.0
+for code-scanning UIs (suppressed findings carry an in-source
+suppression object, so the upload stays in sync with the inline audit
+trail).  The human format prints ``path:line: [rule] message``.
 """
 
 from __future__ import annotations
@@ -14,6 +16,61 @@ import os
 import sys
 
 from tools.ksimlint.core import DEFAULT_TARGETS, run
+from tools.ksimlint.rules import RULE_DOCS
+
+
+def _sarif(findings) -> dict:
+    """Minimal schema-valid SARIF 2.1.0: one run, one result per
+    finding (suppressed ones carry ``suppressions``), rule metadata
+    from each plugin's docstring."""
+    rule_ids = sorted(RULE_DOCS)
+    index = {r: i for i, r in enumerate(rule_ids)}
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": f.line},
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            result["suppressions"] = [
+                {"kind": "inSource", "justification": "ksimlint: disable"}
+            ]
+        results.append(result)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "ksimlint",
+                        "informationUri": "docs/lint.md",
+                        "rules": [
+                            {
+                                "id": r,
+                                "shortDescription": {"text": RULE_DOCS[r]},
+                            }
+                            for r in rule_ids
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -36,7 +93,22 @@ def main(argv: "list[str] | None" = None) -> int:
         "--rules", help="comma-separated rule subset (default: all rules)"
     )
     parser.add_argument(
-        "--json", action="store_true", help="emit one JSON document instead of lines"
+        "--rule",
+        action="append",
+        default=[],
+        metavar="RULE",
+        help="run one rule (repeatable; combines with --rules)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("human", "json", "sarif"),
+        default="human",
+        help="output format (default: human lines)",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="alias for --format json",
     )
     parser.add_argument(
         "--show-suppressed",
@@ -46,7 +118,11 @@ def main(argv: "list[str] | None" = None) -> int:
     args = parser.parse_args(argv)
 
     targets = tuple(args.targets) or DEFAULT_TARGETS
-    rules = tuple(r for r in args.rules.split(",") if r) if args.rules else None
+    selected = list(args.rule)
+    if args.rules:
+        selected.extend(r for r in args.rules.split(",") if r)
+    rules = tuple(selected) if selected else None
+    fmt = "json" if args.json else args.format
     try:
         findings = run(args.root, targets, rules)
     except (OSError, SyntaxError, ValueError) as e:
@@ -55,7 +131,7 @@ def main(argv: "list[str] | None" = None) -> int:
 
     open_findings = [f for f in findings if not f.suppressed]
     suppressed = len(findings) - len(open_findings)
-    if args.json:
+    if fmt == "json":
         print(
             json.dumps(
                 {
@@ -66,6 +142,8 @@ def main(argv: "list[str] | None" = None) -> int:
                 indent=2,
             )
         )
+    elif fmt == "sarif":
+        print(json.dumps(_sarif(findings), indent=2))
     else:
         shown = findings if args.show_suppressed else open_findings
         for f in shown:

@@ -1,0 +1,966 @@
+"""Interprocedural layer: the project call graph + per-function summaries.
+
+Round 11 built ksimlint as five intra-procedural, annotation-driven
+rules; rounds 12-17 grew a genuinely concurrent system (the job worker
+pool, watchdogged dispatch workers, SSE handler threads, the journal
+compaction path, the process-wide CompileCache) whose cross-lock
+acquisition orders and thread-role boundaries no per-function walk can
+see.  This module is the shared substrate for the three interprocedural
+rules (lock-order, thread-role, exception-flow): a module-qualified
+call graph over the existing ``Project`` ASTs plus, per function,
+
+- lexically-held lock-domain sets at every call and acquisition site
+  (the RacerD-style lock-set summary; Blackshear et al., "RacerD:
+  compositional static race detection", OOPSLA 2018),
+- transitive may-acquire sets (the lock-order graph's edge source;
+  Naik et al., "Effective static deadlock detection", ICSE 2009),
+- raise/Thread-target/role facts for the exception-flow and
+  thread-role rules.
+
+Everything here is stdlib-only and AST-derived (the analyzer's own
+import-boundary contract).  Resolution is deliberately CONSERVATIVE on
+dynamic dispatch: a receiver whose class cannot be pinned through the
+local type environment (parameter annotations, ``x = ClassName(...)``,
+``x: ClassName``, ``self.attr`` types from ``__init__``, typed
+dict-container element access) resolves to NOTHING rather than to
+every same-named method in the tree — a false ``list.append ->
+JobJournal.append`` edge would invent deadlocks, while a missed edge
+is a documented soundness limit (docs/lint.md "Soundness limits").
+
+Lock domains are spelled ``ClassName.attr`` for instance locks
+(``Job._cond``) and ``modulestem.NAME`` for module-global locks
+(``replay._PREWARM_LOCK``); a domain exists where ``threading.Lock /
+RLock / Condition`` is constructed.  ``with x.cm():`` over a project
+``@contextmanager`` acquires whatever that generator lexically holds
+at its ``yield`` (how ``ClusterStore.transaction`` hands its RLock to
+the caller's block).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from tools.ksimlint.core import Project, SourceFile
+
+__all__ = ["CallGraph", "FuncInfo", "ClassInfo"]
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: threading constructors that create a lock domain.
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+
+ROLE_RE = re.compile(r"ksimlint:\s*thread-role\(([a-z-]+)\)")
+WORKER_RE = re.compile(r"ksimlint:\s*worker-thread")
+#: lock-held now also accepts qualified domains (``Class.attr`` /
+#: ``modulestem.NAME``) for callbacks invoked with a FOREIGN lock held
+#: (JobManager._journal_records runs under the journal lock).
+LOCK_HELD_RE = re.compile(r"ksimlint:\s*lock-held\(([A-Za-z_][\w.]*)\)")
+LOCK_ORDER_RE = re.compile(r"ksimlint:\s*lock-order\(([^)]+)\)")
+
+#: Broad handler spellings (shield EVERYTHING, including RunCancelled).
+BROAD = frozenset({"Exception", "BaseException", "*bare*"})
+
+
+def _name_tail(expr: ast.expr) -> "str | None":
+    """``Name`` or dotted-``Attribute`` tail (``errors.RunCancelled`` ->
+    ``RunCancelled``); None for anything else."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _def_directive(sf: SourceFile, fn, pattern: re.Pattern):
+    end = fn.body[0].lineno - 1 if fn.body else fn.lineno
+    return sf.directive_in_range(fn.lineno, max(fn.lineno, end), pattern)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    rel: str
+    node: ast.ClassDef
+    bases: tuple[str, ...] = ()
+    methods: dict = field(default_factory=dict)  # name -> FuncInfo
+    lock_attrs: dict = field(default_factory=dict)  # attr -> Lock|RLock|Condition
+    # attr -> ("cls", class name) | ("map", value class name): the
+    # __init__-derived receiver types (``self._journal = JobJournal(p)``,
+    # ``self._jobs: "OrderedDict[str, Job]"``).
+    attr_types: dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> tuple:
+        return (self.rel, self.name)
+
+
+@dataclass
+class FuncInfo:
+    key: str  # "rel::Qual.Path"
+    sf: SourceFile
+    node: object  # FunctionDef | AsyncFunctionDef
+    cls: "ClassInfo | None"
+    parent: "FuncInfo | None" = None
+    nested: dict = field(default_factory=dict)  # name -> FuncInfo
+    role: "str | None" = None  # thread-role annotation (or worker-thread)
+    is_ctxmanager: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def rel(self) -> str:
+        return self.sf.rel
+
+    def display(self) -> str:
+        return self.key.split("::", 1)[1]
+
+
+@dataclass
+class ModuleInfo:
+    sf: SourceFile
+    stem: str
+    classes: dict = field(default_factory=dict)  # name -> ClassInfo
+    functions: dict = field(default_factory=dict)  # name -> FuncInfo
+    imports: dict = field(default_factory=dict)  # local -> (module rel/dotted, orig|None)
+    global_types: dict = field(default_factory=dict)  # NAME -> class name (TRACE -> TracePlane)
+    global_locks: dict = field(default_factory=dict)  # NAME -> lock kind
+
+
+@dataclass(frozen=True)
+class Acq:
+    """One lock-domain acquisition: ``domain`` acquired at ``line``
+    while ``held`` (possibly empty) was already held."""
+
+    domain: str
+    line: int
+    held: frozenset
+
+
+@dataclass(frozen=True)
+class CallSite:
+    callee: str  # FuncInfo.key
+    line: int
+    end_line: int
+    held: frozenset  # domains lexically held at the call
+    # Innermost-first enclosing-try shields: (id, absorbed-names).  A
+    # name in ``absorbed`` is caught by a handler with NO bare raise.
+    shields: tuple = ()
+    same_receiver: bool = False  # self.m() / nested-def / same-module f()
+
+
+@dataclass(frozen=True)
+class RaiseSite:
+    exc: str  # exception class name tail ("" for bare re-raise)
+    line: int
+    shields: tuple = ()
+
+
+@dataclass(frozen=True)
+class ThreadSite:
+    rel: str
+    line: int
+    target: "str | None"  # FuncInfo.key when resolved
+    expr: str  # source text of the target expression
+    resolved_external: bool  # True when the target is known non-project
+
+
+class CallGraph:
+    """Built once per Project (``core.Project.callgraph()`` memoizes)."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FuncInfo] = {}
+        self.classes: dict[tuple, ClassInfo] = {}
+        self.by_class_name: dict[str, list[ClassInfo]] = {}
+        self.lock_kinds: dict[str, str] = {}  # domain -> Lock|RLock|Condition
+        self.acquires: dict[str, list[Acq]] = {}
+        self.calls: dict[str, list[CallSite]] = {}
+        self.raises: dict[str, list[RaiseSite]] = {}
+        self.thread_sites: list[ThreadSite] = []
+        self.yield_held: dict[str, frozenset] = {}
+        self.annotated_held: dict[str, frozenset] = {}
+        self.may_acquire: dict[str, frozenset] = {}
+        self.blessed_edges: dict[tuple, tuple] = {}  # (A, B) -> (rel, line)
+        self._local_types_cache: dict[str, dict] = {}
+        self._build()
+
+    # -- construction ----------------------------------------------------
+
+    def _build(self) -> None:
+        for sf in self.project.files.values():
+            self._index_module(sf)
+        # Two-phase held walk: pass 1 ignores contextmanager Withs so
+        # yield-held sets exist; pass 2 resolves them.  Pass 1 only
+        # needs to cover @contextmanager generators — they are the only
+        # functions whose yield-held set is ever consulted.
+        for fi in self.functions.values():
+            if fi.is_ctxmanager:
+                self._walk_function(fi, 1)
+        for fi in self.functions.values():
+            self._walk_function(fi, 2)
+        self._fixpoint_may_acquire()
+        self._collect_blessed()
+
+    def _index_module(self, sf: SourceFile) -> None:
+        stem = sf.rel.rsplit("/", 1)[-1][: -len(".py")]
+        mi = ModuleInfo(sf=sf, stem=stem)
+        self.modules[sf.rel] = mi
+        for stmt in sf.tree.body:
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                self._index_import(mi, stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                self._index_class(mi, stmt)
+            elif isinstance(stmt, _FUNC):
+                self._index_func(mi, stmt, cls=None, parent=None)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                self._index_global_assign(mi, stmt)
+
+    def _index_import(self, mi: ModuleInfo, stmt) -> None:
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                local = alias.asname or alias.name.split(".")[0]
+                mi.imports[local] = (alias.name, None)
+        else:
+            if stmt.level:  # relative imports are not used in this tree
+                return
+            mod = stmt.module or ""
+            for alias in stmt.names:
+                local = alias.asname or alias.name
+                mi.imports[local] = (mod, alias.name)
+
+    def _index_class(self, mi: ModuleInfo, node: ast.ClassDef) -> None:
+        ci = ClassInfo(
+            name=node.name,
+            rel=mi.sf.rel,
+            node=node,
+            bases=tuple(ast.unparse(b) for b in node.bases),
+        )
+        mi.classes[node.name] = ci
+        self.classes[ci.key] = ci
+        self.by_class_name.setdefault(node.name, []).append(ci)
+        for stmt in node.body:
+            if isinstance(stmt, _FUNC):
+                fi = self._index_func(mi, stmt, cls=ci, parent=None)
+                ci.methods[stmt.name] = fi
+                if stmt.name == "__init__":
+                    self._index_init(mi, ci, stmt)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                for tgt in self._targets(stmt):
+                    if isinstance(tgt, ast.Name):
+                        kind = self._lock_ctor(stmt)
+                        if kind:
+                            ci.lock_attrs[tgt.id] = kind
+
+    def _index_init(self, mi: ModuleInfo, ci: ClassInfo, fn) -> None:
+        # ``self.store = store`` where the __init__ PARAM is annotated:
+        # the dominant constructor idiom in this tree (ScenarioRunner's
+        # ``store: ClusterStore | None``).
+        param_types: dict[str, tuple] = {}
+        args = fn.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            if a.annotation is not None:
+                typ = self._parse_type_expr(a.annotation)
+                if typ is not None:
+                    param_types[a.arg] = typ
+        for sub in ast.walk(fn):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                continue
+            for tgt in self._targets(sub):
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                kind = self._lock_ctor(sub)
+                if kind:
+                    ci.lock_attrs[tgt.attr] = kind
+                    continue
+                typ = None
+                if isinstance(sub, ast.AnnAssign):
+                    typ = self._parse_type_expr(sub.annotation)
+                value = getattr(sub, "value", None)
+                if typ is None and value is not None:
+                    typ = self._value_type_name(value)
+                if typ is None and isinstance(value, ast.Name):
+                    typ = param_types.get(value.id)
+                if typ is not None:
+                    ci.attr_types.setdefault(tgt.attr, typ)
+
+    def _index_func(self, mi, node, cls, parent) -> FuncInfo:
+        if parent is not None:
+            qual = f"{parent.display()}.{node.name}"
+        elif cls is not None:
+            qual = f"{cls.name}.{node.name}"
+        else:
+            qual = node.name
+        fi = FuncInfo(
+            key=f"{mi.sf.rel}::{qual}", sf=mi.sf, node=node, cls=cls, parent=parent
+        )
+        m = _def_directive(mi.sf, node, ROLE_RE)
+        if m:
+            fi.role = m.group(1)
+        elif _def_directive(mi.sf, node, WORKER_RE):
+            fi.role = "dispatch-worker"
+        fi.is_ctxmanager = any(
+            _name_tail(d) == "contextmanager"
+            for d in node.decorator_list
+            if isinstance(d, (ast.Name, ast.Attribute))
+        )
+        self.functions[fi.key] = fi
+        if cls is None and parent is None:
+            mi.functions[node.name] = fi
+        m = _def_directive(mi.sf, node, LOCK_HELD_RE)
+        if m:
+            self.annotated_held[fi.key] = frozenset(
+                {self._domain_from_annotation(fi, m.group(1))}
+            )
+        for stmt in self._direct_nested(node):
+            fi.nested[stmt.name] = self._index_func(mi, stmt, cls=cls, parent=fi)
+        return fi
+
+    @staticmethod
+    def _direct_nested(node):
+        """DIRECTLY nested defs of ``node`` in one pass: descend child
+        nodes but never INTO a nested def (deeper defs belong to it and
+        index through the recursion in ``_index_func``)."""
+        out = []
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _FUNC):
+                out.append(n)
+                continue
+            if isinstance(n, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+        return sorted(out, key=lambda d: d.lineno)
+
+    def _index_global_assign(self, mi: ModuleInfo, stmt) -> None:
+        kind = self._lock_ctor(stmt)
+        for tgt in self._targets(stmt):
+            if not isinstance(tgt, ast.Name):
+                continue
+            if kind:
+                mi.global_locks[tgt.id] = kind
+                self.lock_kinds[f"{mi.stem}.{tgt.id}"] = kind
+            elif getattr(stmt, "value", None) is not None:
+                typ = self._value_type_name(stmt.value)
+                if typ is not None:
+                    mi.global_types[tgt.id] = typ
+
+    @staticmethod
+    def _targets(stmt):
+        if isinstance(stmt, ast.Assign):
+            return stmt.targets
+        if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            return [stmt.target]
+        return []
+
+    @staticmethod
+    def _lock_ctor(stmt) -> "str | None":
+        value = getattr(stmt, "value", None)
+        if isinstance(value, ast.Call):
+            tail = _name_tail(value.func)
+            return _LOCK_CTORS.get(tail or "")
+        return None
+
+    @staticmethod
+    def _value_type_name(value: ast.expr) -> "str | None":
+        """``X = ClassName(...)`` -> "ClassName" (validated against the
+        project's classes at resolution time, not here)."""
+        if isinstance(value, ast.Call):
+            tail = _name_tail(value.func)
+            if tail and tail[:1].isupper():
+                return ("cls", tail)
+        return None
+
+    def _parse_type_expr(self, ann: ast.expr) -> "tuple | None":
+        """A (possibly string) annotation -> ("cls", Name) for a plain /
+        Optional class, ("map", ValueName) for dict-like containers."""
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            # "JobJournal | None": take the non-None side.
+            for side in (ann.left, ann.right):
+                got = self._parse_type_expr(side)
+                if got is not None:
+                    return got
+            return None
+        if isinstance(ann, ast.Subscript):
+            base = _name_tail(ann.value) or ""
+            if base in ("dict", "Dict", "OrderedDict", "defaultdict"):
+                sl = ann.slice
+                if isinstance(sl, ast.Tuple) and sl.elts:
+                    got = self._parse_type_expr(sl.elts[-1])
+                    if got is not None and got[0] == "cls":
+                        return ("map", got[1])
+            if base in ("Optional",):
+                return self._parse_type_expr(ann.slice)
+            return None
+        tail = _name_tail(ann)
+        if tail and tail[:1].isupper() and tail != "None":
+            return ("cls", tail)
+        return None
+
+    # -- name / receiver resolution --------------------------------------
+
+    def _resolve_class(self, mi: ModuleInfo, name: str) -> "ClassInfo | None":
+        if name in mi.classes:
+            return mi.classes[name]
+        imp = mi.imports.get(name)
+        if imp:
+            mod, orig = imp
+            target = self.modules.get(self._module_rel(mod))
+            if target is not None:
+                return target.classes.get(orig or name)
+        return None
+
+    def _module_rel(self, dotted: str) -> str:
+        rel = dotted.replace(".", "/") + ".py"
+        if rel in self.modules:
+            return rel
+        return dotted.replace(".", "/") + "/__init__.py"
+
+    def _resolve_module_func(self, mi: ModuleInfo, name: str) -> "FuncInfo | None":
+        if name in mi.functions:
+            return mi.functions[name]
+        imp = mi.imports.get(name)
+        if imp:
+            mod, orig = imp
+            target = self.modules.get(self._module_rel(mod))
+            if target is not None:
+                return target.functions.get(orig or name)
+        return None
+
+    def _method_on(self, ci: "ClassInfo | None", name: str) -> "FuncInfo | None":
+        """Method lookup through project base classes."""
+        seen = set()
+        while ci is not None and ci.key not in seen:
+            seen.add(ci.key)
+            if name in ci.methods:
+                return ci.methods[name]
+            nxt = None
+            for base in ci.bases:
+                got = self._resolve_class(self.modules[ci.rel], base.split(".")[-1])
+                if got is not None:
+                    nxt = got
+                    break
+            ci = nxt
+        return None
+
+    def _lock_attr_on(self, ci: "ClassInfo | None", attr: str) -> "str | None":
+        """The (class, kind) domain for ``<ci instance>.<attr>`` when the
+        attr is a lock constructed by ci or a project base."""
+        seen = set()
+        while ci is not None and ci.key not in seen:
+            seen.add(ci.key)
+            if attr in ci.lock_attrs:
+                domain = f"{ci.name}.{attr}"
+                self.lock_kinds.setdefault(domain, ci.lock_attrs[attr])
+                return domain
+            nxt = None
+            for base in ci.bases:
+                got = self._resolve_class(self.modules[ci.rel], base.split(".")[-1])
+                if got is not None:
+                    nxt = got
+                    break
+            ci = nxt
+        return None
+
+    def _attr_type(self, ci: "ClassInfo | None", attr: str) -> "tuple | None":
+        seen = set()
+        while ci is not None and ci.key not in seen:
+            seen.add(ci.key)
+            if attr in ci.attr_types:
+                return ci.attr_types[attr]
+            nxt = None
+            for base in ci.bases:
+                got = self._resolve_class(self.modules[ci.rel], base.split(".")[-1])
+                if got is not None:
+                    nxt = got
+                    break
+            ci = nxt
+        return None
+
+    def _domain_from_annotation(self, fi: FuncInfo, name: str) -> str:
+        """``lock-held(X)``: bare attr names resolve against the
+        enclosing class; qualified ``Class.attr`` / ``modulestem.NAME``
+        pass through as spelled."""
+        if "." in name:
+            return name
+        if fi.cls is not None:
+            domain = self._lock_attr_on(fi.cls, name)
+            if domain:
+                return domain
+            return f"{fi.cls.name}.{name}"
+        mi = self.modules[fi.rel]
+        if name in mi.global_locks:
+            return f"{mi.stem}.{name}"
+        return name
+
+    # -- the per-function walk -------------------------------------------
+
+    def _local_types(self, fi: FuncInfo) -> dict:
+        """Flow-insensitive local name -> type env for one function
+        (memoized: the two walk phases and resolve_call share it)."""
+        cached = self._local_types_cache.get(fi.key)
+        if cached is not None:
+            return cached
+        mi = self.modules[fi.rel]
+        env: dict[str, tuple] = {}
+        args = fi.node.args
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        ):
+            if a.annotation is not None:
+                typ = self._parse_type_expr(a.annotation)
+                if typ is not None:
+                    env.setdefault(a.arg, typ)
+        for sub in ast.walk(fi.node):
+            if isinstance(sub, _FUNC) and sub is not fi.node:
+                continue
+            if isinstance(sub, ast.AnnAssign) and isinstance(sub.target, ast.Name):
+                typ = self._parse_type_expr(sub.annotation)
+                if typ is not None:
+                    env.setdefault(sub.target.id, typ)
+            elif isinstance(sub, ast.Assign):
+                typ = self._expr_type(fi, mi, sub.value, env)
+                if typ is not None:
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            env.setdefault(tgt.id, typ)
+            elif isinstance(sub, ast.For) and isinstance(sub.target, ast.Name):
+                typ = self._expr_type(fi, mi, sub.iter, env)
+                if typ is not None and typ[0] == "iter-cls":
+                    env.setdefault(sub.target.id, ("cls", typ[1]))
+        self._local_types_cache[fi.key] = env
+        return env
+
+    def _expr_type(self, fi, mi, expr, env) -> "tuple | None":
+        """("cls", Name) receiver types, plus ("map"/"iter-cls", Name)
+        intermediates for dict element access."""
+        if isinstance(expr, ast.Name):
+            got = env.get(expr.id)
+            if got is not None:
+                return got
+            g = mi.global_types.get(expr.id)
+            if g is not None:
+                return g
+            imp = mi.imports.get(expr.id)
+            if imp:
+                target = self.modules.get(self._module_rel(imp[0]))
+                if target is not None:
+                    g = target.global_types.get(imp[1] or expr.id)
+                    if g is not None:
+                        return g
+            return None
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                return self._attr_type(fi.cls, expr.attr)
+            return None
+        if isinstance(expr, ast.Subscript):
+            base = self._expr_type(fi, mi, expr.value, env)
+            if base is not None and base[0] == "map":
+                return ("cls", base[1])
+            return None
+        if isinstance(expr, ast.Call):
+            tail = _name_tail(expr.func)
+            if tail is None:
+                return None
+            ci = (
+                self._resolve_class(mi, tail)
+                if isinstance(expr.func, ast.Name)
+                else None
+            )
+            if ci is not None:
+                return ("cls", ci.name)
+            if isinstance(expr.func, ast.Attribute) and tail in ("get", "pop"):
+                base = self._expr_type(fi, mi, expr.func.value, env)
+                if base is not None and base[0] == "map":
+                    return ("cls", base[1])
+            if isinstance(expr.func, ast.Attribute) and tail == "values":
+                base = self._expr_type(fi, mi, expr.func.value, env)
+                if base is not None and base[0] == "map":
+                    return ("iter-cls", base[1])
+        return None
+
+    def resolve_call(self, fi: FuncInfo, call: ast.Call, env=None) -> "FuncInfo | None":
+        """The single project callee of ``call`` inside ``fi``, or None
+        (unresolvable / external — the conservative default)."""
+        mi = self.modules[fi.rel]
+        if env is None:
+            env = self._local_types(fi)
+        f = call.func
+        if isinstance(f, ast.Name):
+            scope = fi
+            while scope is not None:
+                if f.id in scope.nested:
+                    return scope.nested[f.id]
+                scope = scope.parent
+            ci = self._resolve_class(mi, f.id)
+            if ci is not None:
+                return self._method_on(ci, "__init__")
+            return self._resolve_module_func(mi, f.id)
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Name) and recv.id in ("self", "cls") and fi.cls:
+                return self._method_on(fi.cls, f.attr)
+            if isinstance(recv, ast.Name):
+                imp = mi.imports.get(recv.id)
+                if imp and imp[1] is None:
+                    target = self.modules.get(self._module_rel(imp[0]))
+                    if target is not None:
+                        got = target.functions.get(f.attr)
+                        if got is not None:
+                            return got
+                        ci = target.classes.get(f.attr)
+                        if ci is not None:
+                            return self._method_on(ci, "__init__")
+                    return None
+            typ = self._expr_type(fi, mi, recv, env)
+            if typ is not None and typ[0] == "cls":
+                ci = self._resolve_class(mi, typ[1])
+                if ci is None:
+                    for cand in self.by_class_name.get(typ[1], []):
+                        ci = cand
+                        break
+                if ci is not None:
+                    return self._method_on(ci, f.attr)
+        return None
+
+    def _with_domains(self, fi: FuncInfo, item: ast.withitem, env, phase: int):
+        """Domains acquired by one with-item context expression."""
+        expr = item.context_expr
+        mi = self.modules[fi.rel]
+        if isinstance(expr, ast.Name):
+            if expr.id in mi.global_locks:
+                return [f"{mi.stem}.{expr.id}"]
+            imp = mi.imports.get(expr.id)
+            if imp:
+                target = self.modules.get(self._module_rel(imp[0]))
+                if target is not None and (imp[1] or expr.id) in target.global_locks:
+                    return [f"{target.stem}.{imp[1] or expr.id}"]
+            return []
+        if isinstance(expr, ast.Attribute):
+            recv = expr.value
+            if isinstance(recv, ast.Name) and recv.id == "self" and fi.cls:
+                domain = self._lock_attr_on(fi.cls, expr.attr)
+                return [domain] if domain else []
+            typ = self._expr_type(fi, mi, recv, env)
+            if typ is not None and typ[0] == "cls":
+                ci = self._resolve_class(mi, typ[1])
+                if ci is None:
+                    cands = self.by_class_name.get(typ[1], [])
+                    ci = cands[0] if cands else None
+                domain = self._lock_attr_on(ci, expr.attr) if ci else None
+                return [domain] if domain else []
+            return []
+        if phase == 2 and isinstance(expr, ast.Call):
+            callee = self.resolve_call(fi, expr, env)
+            if callee is not None and callee.is_ctxmanager:
+                return sorted(self.yield_held.get(callee.key, frozenset()))
+        return []
+
+    def _walk_function(self, fi: FuncInfo, phase: int) -> None:
+        env = self._local_types(fi)
+        acquires: list[Acq] = []
+        calls: list[CallSite] = []
+        raises: list[RaiseSite] = []
+        yheld: set[str] = set()
+        graph = self
+
+        init_held = self.annotated_held.get(fi.key, frozenset())
+
+        def handler_names(try_node) -> frozenset:
+            absorbed = set()
+            for h in try_node.handlers:
+                reraises = any(
+                    isinstance(s, ast.Raise) and s.exc is None
+                    for s in ast.walk(h)
+                )
+                if reraises:
+                    continue
+                if h.type is None:
+                    absorbed.add("*bare*")
+                elif isinstance(h.type, ast.Tuple):
+                    absorbed.update(
+                        _name_tail(e) or "?" for e in h.type.elts
+                    )
+                else:
+                    absorbed.add(_name_tail(h.type) or "?")
+            return frozenset(absorbed)
+
+        def same_receiver(call: ast.Call) -> bool:
+            f = call.func
+            if isinstance(f, ast.Name):
+                return True  # nested def or same-module function
+            return (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+            )
+
+        def visit(node, held: frozenset, shields: tuple) -> None:
+            if isinstance(node, _FUNC) or isinstance(node, ast.Lambda):
+                return  # nested scopes are separate functions
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired: list[str] = []
+                for item in node.items:
+                    visit_expr(item.context_expr, held, shields)
+                    for domain in graph._with_domains(fi, item, env, phase):
+                        acquired.append(domain)
+                        acquires.append(Acq(domain, item.context_expr.lineno, held))
+                inner = held | frozenset(acquired)
+                for stmt in node.body:
+                    visit(stmt, inner, shields)
+                return
+            if isinstance(node, ast.Try):
+                shield = (id(node), handler_names(node))
+                for stmt in node.body:
+                    visit(stmt, held, (shield,) + shields)
+                for stmt in node.orelse:
+                    visit(stmt, held, shields)
+                for h in node.handlers:
+                    for stmt in h.body:
+                        visit(stmt, held, shields)
+                for stmt in node.finalbody:
+                    visit(stmt, held, shields)
+                return
+            if isinstance(node, ast.Raise):
+                if node.exc is None:
+                    raises.append(RaiseSite("", node.lineno, shields))
+                else:
+                    tail = _name_tail(
+                        node.exc.func if isinstance(node.exc, ast.Call) else node.exc
+                    )
+                    if tail:
+                        raises.append(RaiseSite(tail, node.lineno, shields))
+                    if isinstance(node.exc, ast.Call):
+                        visit_expr(node.exc, held, shields)
+                return
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Yield):
+                yheld.update(held)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    visit_expr(child, held, shields)
+                else:
+                    visit(child, held, shields)
+
+        def visit_expr(expr, held: frozenset, shields: tuple) -> None:
+            for node in ast.walk(expr):
+                if isinstance(node, (ast.Lambda,)) or isinstance(node, _FUNC):
+                    continue
+                if isinstance(node, ast.Yield):
+                    yheld.update(held)
+                if not isinstance(node, ast.Call):
+                    continue
+                graph._note_thread_site(fi, node, env)
+                callee = graph.resolve_call(fi, node, env)
+                if callee is not None:
+                    calls.append(
+                        CallSite(
+                            callee.key,
+                            node.lineno,
+                            getattr(node, "end_lineno", node.lineno),
+                            held,
+                            shields,
+                            same_receiver(node),
+                        )
+                    )
+
+        for stmt in fi.node.body:
+            visit(stmt, init_held, ())
+        if phase == 1:
+            self.yield_held[fi.key] = frozenset(yheld)
+        else:
+            self.acquires[fi.key] = acquires
+            self.calls[fi.key] = calls
+            self.raises[fi.key] = raises
+
+    def _note_thread_site(self, fi: FuncInfo, call: ast.Call, env) -> None:
+        """Record ``threading.Thread(target=X)`` / ``pool.submit(X, ..)``
+        sites (phase-independent; duplicates are deduped at the end)."""
+        tail = _name_tail(call.func)
+        target_expr = None
+        if tail == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+        elif tail == "submit" and isinstance(call.func, ast.Attribute) and call.args:
+            target_expr = call.args[0]
+        if target_expr is None:
+            return
+        resolved: "str | None" = None
+        external = False
+        fake_call = ast.Call(func=target_expr, args=[], keywords=[])
+        ast.copy_location(fake_call, call)
+        callee = None
+        try:
+            callee = self.resolve_call(fi, fake_call, env)
+        except Exception:
+            callee = None
+        if callee is not None:
+            resolved = callee.key
+        else:
+            # self.<m> that did not resolve within project classes is an
+            # inherited external method (serve_forever) — known-external.
+            external = True
+        site = ThreadSite(
+            fi.rel, call.lineno, resolved, ast.unparse(target_expr), external
+        )
+        if site not in self.thread_sites:
+            self.thread_sites.append(site)
+
+    # -- summaries --------------------------------------------------------
+
+    def _fixpoint_may_acquire(self) -> None:
+        may: dict[str, set] = {
+            key: {a.domain for a in acqs} for key, acqs in self.acquires.items()
+        }
+        for key in self.functions:
+            may.setdefault(key, set())
+        changed = True
+        while changed:
+            changed = False
+            for key, sites in self.calls.items():
+                mine = may[key]
+                before = len(mine)
+                for site in sites:
+                    mine |= may.get(site.callee, set())
+                if len(mine) != before:
+                    changed = True
+        self.may_acquire = {k: frozenset(v) for k, v in may.items()}
+
+    def _collect_blessed(self) -> None:
+        """``# ksimlint: lock-order(A<B[<C...])`` declarations anywhere
+        in the tree (chains expand to adjacent pairs)."""
+        for sf in self.project.files.values():
+            for line, comment in sf.comments.items():
+                m = LOCK_ORDER_RE.search(comment)
+                if not m:
+                    continue
+                parts = [p.strip() for p in m.group(1).split("<")]
+                for a, b in zip(parts, parts[1:]):
+                    if a and b:
+                        self.blessed_edges.setdefault((a, b), (sf.rel, line))
+
+    # -- derived facts shared by the rules --------------------------------
+
+    def observed_edges(self) -> dict:
+        """(A, B) -> list of witness (rel, line, description): every
+        second-lock acquisition while a first is held, both direct and
+        through the transitive may-acquire of a callee."""
+        edges: dict[tuple, list] = {}
+
+        def add(a, b, rel, line, desc):
+            if a == b:
+                return
+            edges.setdefault((a, b), []).append((rel, line, desc))
+
+        for key, acqs in self.acquires.items():
+            fi = self.functions[key]
+            for acq in acqs:
+                for a in acq.held:
+                    add(
+                        a,
+                        acq.domain,
+                        fi.rel,
+                        acq.line,
+                        f"{fi.display()} acquires {acq.domain} while holding {a}",
+                    )
+        for key, sites in self.calls.items():
+            fi = self.functions[key]
+            for site in sites:
+                if not site.held:
+                    continue
+                for b in self.may_acquire.get(site.callee, frozenset()):
+                    for a in site.held:
+                        callee = self.functions[site.callee]
+                        add(
+                            a,
+                            b,
+                            fi.rel,
+                            site.line,
+                            f"{fi.display()} calls {callee.display()} "
+                            f"(may acquire {b}) while holding {a}",
+                        )
+        for ws in edges.values():
+            ws.sort(key=lambda w: (w[0], w[1]))
+        return edges
+
+    def reentrant_acquisitions(self) -> list:
+        """Direct nested acquisitions of one NON-reentrant domain — a
+        guaranteed self-deadlock (RLock domains are exempt)."""
+        out = []
+        for key, acqs in self.acquires.items():
+            fi = self.functions[key]
+            for acq in acqs:
+                if (
+                    acq.domain in acq.held
+                    and self.lock_kinds.get(acq.domain) != "RLock"
+                ):
+                    out.append((fi, acq))
+        return out
+
+    def roots_with_role(self, roles: frozenset) -> list:
+        return [fi for fi in self.functions.values() if fi.role in roles]
+
+    def reachable_same_receiver(self, roots) -> dict:
+        """FuncInfo.key -> (root FuncInfo, via FuncInfo) for everything
+        reachable from ``roots`` along same-receiver call edges (the
+        thread-role propagation relation)."""
+        out: dict[str, tuple] = {}
+        stack = [(fi, fi, fi) for fi in roots]
+        while stack:
+            root, via, fi = stack.pop()
+            if fi.key in out:
+                continue
+            out[fi.key] = (root, via)
+            for site in self.calls.get(fi.key, ()):
+                if not site.same_receiver:
+                    continue
+                callee = self.functions.get(site.callee)
+                if callee is not None and callee.key not in out:
+                    stack.append((root, fi, callee))
+        return out
+
+    def may_raise(self, exc_name: str) -> frozenset:
+        """Keys of functions from which ``exc_name`` may ESCAPE: a raise
+        (or a call to an escaping callee) not shielded by an enclosing
+        handler that absorbs it (explicitly by name, or a broad handler
+        — the broad case is exactly what the exception-flow rule then
+        inspects at the absorbing site)."""
+
+        def shielded(shields: tuple) -> bool:
+            for _tid, absorbed in shields:
+                if exc_name in absorbed or absorbed & BROAD:
+                    return True
+            return False
+
+        escaping: set[str] = set()
+        for key, rss in self.raises.items():
+            for rs in rss:
+                if rs.exc == exc_name and not shielded(rs.shields):
+                    escaping.add(key)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for key, sites in self.calls.items():
+                if key in escaping:
+                    continue
+                for site in sites:
+                    if site.callee in escaping and not shielded(site.shields):
+                        escaping.add(key)
+                        changed = True
+                        break
+        return frozenset(escaping)

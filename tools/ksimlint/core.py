@@ -128,6 +128,18 @@ class Project:
         self.root = root
         self.files = files
         self.targets = targets
+        self._callgraph = None
+
+    def callgraph(self):
+        """The interprocedural layer (tools/ksimlint/callgraph.py),
+        built lazily ONCE per Project and shared by every rule that asks
+        — the lock-order, thread-role and exception-flow rules all walk
+        the same call graph instead of re-deriving it per rule."""
+        if self._callgraph is None:
+            from tools.ksimlint.callgraph import CallGraph
+
+            self._callgraph = CallGraph(self)
+        return self._callgraph
 
     @classmethod
     def load(cls, root: str, targets: tuple[str, ...] = DEFAULT_TARGETS) -> "Project":

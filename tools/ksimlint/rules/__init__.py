@@ -2,14 +2,20 @@
 
 from tools.ksimlint.rules import (
     env_contract,
+    exception_flow,
     import_boundary,
     kernel_purity,
     lock_discipline,
+    lock_order,
     registry_literals,
+    thread_role,
 )
 
 _MODULES = (
     lock_discipline,
+    lock_order,
+    thread_role,
+    exception_flow,
     kernel_purity,
     import_boundary,
     registry_literals,
@@ -18,4 +24,9 @@ _MODULES = (
 
 ALL_RULES = {m.RULE: m.check for m in _MODULES}
 
-__all__ = ["ALL_RULES"]
+#: Rule id -> first docstring line (the SARIF shortDescription).
+RULE_DOCS = {
+    m.RULE: (m.__doc__ or "").strip().splitlines()[0] for m in _MODULES
+}
+
+__all__ = ["ALL_RULES", "RULE_DOCS"]

@@ -1,0 +1,193 @@
+"""exception-flow: cancellation, fault and fallback exceptions travel
+only their documented channels.
+
+Three contracts from rounds 13-16, made machine-checked (docs/lint.md
+"Exception flow"):
+
+- **RunCancelled must not be absorbed.**  ``errors.RunCancelled`` is
+  deliberately NOT a SimulatorError: classified fault handlers absorb
+  SimulatorErrors into per-pass fallbacks, and a cancellation must
+  propagate out of the run.  A broad handler (``except Exception`` /
+  ``except BaseException`` / bare) whose try body may raise RunCancelled
+  — computed interprocedurally over the call graph — must re-raise it:
+  an earlier ``except RunCancelled`` arm, a bare ``raise``, re-raising
+  or CAPTURING the bound exception (``box["err"] = e``, the watchdog
+  worker's classified-by-the-caller pattern), or an isinstance re-raise
+  all count.
+- **InjectedFault containment matches the taxonomy.**  Explicitly
+  catching ``InjectedFault`` is the privilege of the documented
+  containment scopes (docs/faults.md): the segment-reconcile rollback
+  in ``scenario/runner.py``.  Anywhere else, chaos must flow through
+  the classified SimulatorError ladders, not be picked off by name.
+- **ReplayFallback rides its constructors.**  ``raise
+  ReplayFallback(...)`` appears nowhere: fallbacks are raised as
+  ``_Unsupported(<reason>)`` (whose static reasons registry-literals
+  pins to FALLBACK_REASONS) or recorded via ``_reject`` — a direct
+  raise would mint an unregistered reason the histogram cannot bucket.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ksimlint.core import Finding, Project
+
+RULE = "exception-flow"
+
+#: Modules whose functions may explicitly catch InjectedFault — the
+#: documented containment scopes (docs/faults.md "containment"): the
+#: all-or-nothing segment-reconcile rollback.
+INJECTED_FAULT_SCOPES = ("ksim_tpu/scenario/runner.py",)
+
+#: Defs allowed to raise ReplayFallback directly (the constructors).
+FALLBACK_RAISERS = ("_Unsupported", "_reject")
+
+_BROAD = {"Exception", "BaseException"}
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _name_tail(expr) -> "str | None":
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _handler_types(handler: ast.ExceptHandler) -> set:
+    if handler.type is None:
+        return {"*bare*"}
+    if isinstance(handler.type, ast.Tuple):
+        return {_name_tail(e) or "?" for e in handler.type.elts}
+    return {_name_tail(handler.type) or "?"}
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """The handler re-raises or captures-for-the-caller: a bare
+    ``raise``, ``raise e`` of the bound name, or ANY use of the bound
+    name beyond logging-free absorption (storing it into a box the
+    caller classifies, wrapping it with ``raise X(...) from e`` —
+    conservative: a bound name that flows anywhere counts)."""
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Raise):
+            if sub.exc is None:
+                return True
+            if (
+                handler.name
+                and isinstance(sub.exc, ast.Name)
+                and sub.exc.id == handler.name
+            ):
+                return True
+    if handler.name:
+        # Capture pattern: the bound exception assigned/stored somewhere
+        # (box["err"] = e) — the caller owns classification, including
+        # the RunCancelled re-raise.
+        for sub in ast.walk(handler):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                value = getattr(sub, "value", None)
+                if isinstance(value, ast.Name) and value.id == handler.name:
+                    return True
+    return False
+
+
+def check(project: Project) -> list[Finding]:
+    graph = project.callgraph()
+    findings: list[Finding] = []
+    may_cancel = graph.may_raise("RunCancelled")
+
+    for fi in graph.functions.values():
+        tries = [sub for sub in ast.walk(fi.node) if isinstance(sub, ast.Try)]
+        if not tries:
+            continue
+        call_sites = graph.calls.get(fi.key, ())
+        raise_sites = graph.raises.get(fi.key, ())
+        for t in tries:
+            broad = [
+                h
+                for h in t.handlers
+                if _handler_types(h) & (_BROAD | {"*bare*"})
+            ]
+            if not broad:
+                continue
+            if any("RunCancelled" in _handler_types(h) for h in t.handlers):
+                # An explicit RunCancelled arm (re-raising or a
+                # DELIBERATE absorb — e.g. the job worker marking the
+                # job cancelled) owns the contract; the broad arm below
+                # it never sees the cancellation.
+                continue
+            if all(_reraises(h) for h in broad):
+                continue
+            # Does anything in THIS try's body (innermost-shield == this
+            # try) raise RunCancelled?
+            tid = id(t)
+            danger = None
+            for site in call_sites:
+                if not site.shields or site.shields[0][0] != tid:
+                    continue
+                if site.callee in may_cancel:
+                    danger = (site.line, graph.functions[site.callee].display())
+                    break
+            if danger is None:
+                for rs in raise_sites:
+                    if (
+                        rs.exc == "RunCancelled"
+                        and rs.shields
+                        and rs.shields[0][0] == tid
+                    ):
+                        danger = (rs.line, "a direct raise")
+                        break
+            if danger is None:
+                continue
+            h = broad[0]
+            findings.append(
+                Finding(
+                    RULE,
+                    fi.rel,
+                    h.lineno,
+                    f"broad except absorbs RunCancelled: the try body "
+                    f"calls {danger[1]} (line {danger[0]}) which may "
+                    "raise it — add `except RunCancelled: raise` above, "
+                    "or re-raise/capture the bound exception "
+                    "(docs/lint.md \"Exception flow\")",
+                )
+            )
+
+    # -- InjectedFault containment scopes --------------------------------
+    for fi in graph.functions.values():
+        if fi.rel in INJECTED_FAULT_SCOPES:
+            continue
+        for sub in ast.walk(fi.node):
+            if isinstance(sub, ast.ExceptHandler) and "InjectedFault" in (
+                _handler_types(sub)
+            ):
+                findings.append(
+                    Finding(
+                        RULE,
+                        fi.rel,
+                        sub.lineno,
+                        "explicit `except InjectedFault` outside the "
+                        "documented containment scopes "
+                        f"({', '.join(INJECTED_FAULT_SCOPES)}) — chaos "
+                        "flows through the classified SimulatorError "
+                        "ladders (docs/faults.md)",
+                    )
+                )
+
+    # -- ReplayFallback raise channel ------------------------------------
+    for fi in graph.functions.values():
+        if fi.name in FALLBACK_RAISERS:
+            continue
+        for rs in graph.raises.get(fi.key, ()):
+            if rs.exc == "ReplayFallback":
+                findings.append(
+                    Finding(
+                        RULE,
+                        fi.rel,
+                        rs.line,
+                        "direct `raise ReplayFallback(...)` — fallbacks "
+                        "are raised as `_Unsupported(<reason>)` or "
+                        "recorded via `_reject` so every reason resolves "
+                        "into FALLBACK_REASONS (registry-literals)",
+                    )
+                )
+    return findings

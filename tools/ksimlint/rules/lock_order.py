@@ -1,0 +1,184 @@
+"""lock-order: the global lock-acquisition-order graph stays acyclic
+and every cross-lock nesting is DECLARED.
+
+The interprocedural deadlock tier (docs/lint.md "Lock order"; Naik et
+al., "Effective static deadlock detection", ICSE 2009): the shared call
+graph (tools/ksimlint/callgraph.py) gives every function its lexically
+held lock-domain set at each acquisition and call site; an edge
+``A -> B`` exists wherever ``B`` is acquired — directly, or anywhere in
+a callee's transitive may-acquire set — while ``A`` is held.
+
+Findings:
+
+- **Undeclared nesting.** An observed edge not covered by a
+  ``# ksimlint: lock-order(A<B)`` declaration (chains ``A<B<C`` expand
+  to adjacent pairs; declarations live next to the docstring that
+  justifies the order).  One finding per EDGE, reported at its first
+  witness site.
+- **Cycle.** Any cycle in observed-union-blessed edges — two blessed
+  edges ``A<B`` and ``B<A`` are exactly a declared deadlock.  An edge
+  whose EVERY witness site carries ``# ksimlint: disable=lock-order``
+  is *waived* — excluded from the cycle graph.  That is the escape
+  hatch for inversions that are unreachable by construction (the
+  JobManager ``_recover`` path runs before any worker thread exists);
+  the per-site suppressions still count in the audited suppression
+  total, so a waiver is never silent.
+- **Reentrant self-deadlock.** Directly re-acquiring a held non-RLock
+  domain (``with self._lock:`` nested inside itself) — guaranteed
+  deadlock, no cycle needed.
+- **Dead declaration.** A blessed edge neither end of which is ever
+  observed (full-tree runs only) — stale declarations would quietly
+  bless future regressions.
+
+Lock domains are ``ClassName.attr`` / ``modulestem.NAME`` where a
+``threading.Lock/RLock/Condition`` is constructed.  Soundness limits
+(dynamic dispatch, ``getattr``, properties, locks handed through
+untyped receivers) are documented in docs/lint.md — a missed edge is
+possible, an invented one is not.
+"""
+
+from __future__ import annotations
+
+from tools.ksimlint.core import Finding, Project
+
+RULE = "lock-order"
+
+
+def _cycles(edges: set) -> list:
+    """Elementary cycles via DFS over the domain graph; each cycle is
+    reported once, rotated to its lexicographically smallest node."""
+    graph: dict[str, list] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    for outs in graph.values():
+        outs.sort()
+
+    seen_cycles = set()
+    cycles = []
+
+    def dfs(start: str, node: str, path: list, on_path: set) -> None:
+        for nxt in graph[node]:
+            if nxt == start:
+                cyc = path[:]
+                pivot = min(range(len(cyc)), key=lambda i: cyc[i])
+                canon = tuple(cyc[pivot:] + cyc[:pivot])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(canon)
+            elif nxt not in on_path and nxt > start:
+                # Only explore nodes > start: each cycle is found from
+                # its smallest node exactly once.
+                on_path.add(nxt)
+                dfs(start, nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def check(project: Project) -> list[Finding]:
+    graph = project.callgraph()
+    findings: list[Finding] = []
+    observed = graph.observed_edges()
+    blessed = graph.blessed_edges
+
+    def _suppressed_at(rel: str, line: int) -> bool:
+        sf = project.files.get(rel)
+        if sf is None:
+            return False
+        return bool({RULE, "all"} & sf.disabled_at(line))
+
+    # -- undeclared nestings --------------------------------------------
+    waived = set()
+    for edge in sorted(observed):
+        witnesses = observed[edge]
+        open_witnesses = [
+            w for w in witnesses if not _suppressed_at(w[0], w[1])
+        ]
+        if not open_witnesses:
+            # Every witness individually suppressed: the edge is waived
+            # out of the cycle graph below.
+            waived.add(edge)
+        if edge in blessed:
+            continue
+        a, b = edge
+        # Report at the first OPEN witness so a suppression on witness
+        # one cannot shadow an unsuppressed witness two; a fully waived
+        # edge reports (suppressed) at its first site for the audit pin.
+        rel, line, desc = (open_witnesses or witnesses)[0]
+        more = (
+            f" (+{len(witnesses) - 1} more site(s))" if len(witnesses) > 1 else ""
+        )
+        findings.append(
+            Finding(
+                RULE,
+                rel,
+                line,
+                f"undeclared lock nesting {a} -> {b}: {desc}{more} — declare "
+                f"`# ksimlint: lock-order({a}<{b})` beside the docstring "
+                "that justifies the order, or restructure to drop the "
+                "first lock",
+            )
+        )
+
+    # -- cycles ----------------------------------------------------------
+    all_edges = (set(observed) - waived) | set(blessed)
+    for cyc in _cycles(all_edges):
+        ring = " -> ".join(cyc + (cyc[0],))
+        # Anchor the finding on a concrete edge of the cycle: the first
+        # observed witness if any, else the first blessed declaration.
+        anchor = None
+        for a, b in zip(cyc, cyc[1:] + (cyc[0],)):
+            ws = observed.get((a, b))
+            if ws:
+                anchor = (ws[0][0], ws[0][1])
+                break
+        if anchor is None:
+            for a, b in zip(cyc, cyc[1:] + (cyc[0],)):
+                if (a, b) in blessed:
+                    anchor = blessed[(a, b)]
+                    break
+        rel, line = anchor
+        findings.append(
+            Finding(
+                RULE,
+                rel,
+                line,
+                f"lock-order cycle {ring}: two threads taking these locks "
+                "in opposite orders deadlock — break the cycle or drop "
+                "the offending lock-order declaration",
+            )
+        )
+
+    # -- reentrant self-deadlocks ---------------------------------------
+    for fi, acq in graph.reentrant_acquisitions():
+        findings.append(
+            Finding(
+                RULE,
+                fi.rel,
+                acq.line,
+                f"{fi.display()} re-acquires non-reentrant {acq.domain} "
+                "while already holding it — guaranteed self-deadlock "
+                "(use the _locked helper convention or an RLock)",
+            )
+        )
+
+    # -- dead declarations (full tree only) ------------------------------
+    if project.covers_default_targets():
+        for edge in sorted(blessed):
+            if edge not in observed:
+                rel, line = blessed[edge]
+                findings.append(
+                    Finding(
+                        RULE,
+                        rel,
+                        line,
+                        f"lock-order({edge[0]}<{edge[1]}) is declared but "
+                        "never observed — stale declarations quietly bless "
+                        "future regressions; delete it or fix the analyzer "
+                        "blind spot it was covering",
+                    )
+                )
+    return findings

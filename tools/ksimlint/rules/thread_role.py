@@ -1,0 +1,189 @@
+"""thread-role: thread entry points carry roles, and worker contracts
+hold for everything REACHABLE from a worker.
+
+Round 8 pinned the dispatch-worker contract on two hand-annotated
+functions; this rule makes the property interprocedural (docs/lint.md
+"Thread roles"):
+
+- **Entry points carry roles.** Every ``threading.Thread(target=X)`` /
+  ``pool.submit(X, ...)`` whose target resolves to a project function
+  must find a ``# ksimlint: thread-role(<role>)`` annotation on that
+  def (legacy ``# ksimlint: worker-thread`` = ``dispatch-worker``).
+  Targets that resolve OUTSIDE the project (``serve_forever``) are
+  skipped — the conservative-dispatch soundness limit.
+- **Role vocabulary** (docs/lint.md): ``main-thread``,
+  ``dispatch-worker``, ``job-worker``, ``sse-handler``, ``compactor``,
+  ``service-loop``.  Anything else is a finding (a typo'd role would
+  silently opt out of every check below).
+- **Dispatch-worker strictness, propagated.**  The round-8 "no store to
+  self" contract applies to every function reachable from a
+  ``dispatch-worker`` root along same-receiver (``self.m()`` / nested
+  def / same-module call) edges — an abandoned watchdog worker must not
+  corrupt the degraded run's accounting through a helper either.
+- **Cross-thread writes, propagated.**  Functions reachable from ANY
+  non-main role root must not WRITE attributes annotated
+  ``# guarded-by: main-thread`` (reads tolerate tearing — evidence
+  snapshots rely on that).
+- **Confinement assertions.**  A function annotated
+  ``thread-role(main-thread)`` reachable from a worker root is a
+  finding — the annotation is a machine-checked "never on a worker".
+
+Reachability is same-receiver only: cross-object calls are covered by
+the callee's own guarded-by discipline (lock-discipline rule), and
+following them through untyped receivers would need the dynamic
+dispatch the analyzer deliberately refuses to guess at.  ``__init__``
+stores are exempt everywhere: a constructor reached from a worker is
+initializing the FRESH instance being built (``ClassName(...)`` always
+allocates), not shared state — the RacerD ownership rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ksimlint.core import Finding, Project
+from tools.ksimlint.rules.lock_discipline import MAIN_THREAD, _class_guards
+
+RULE = "thread-role"
+
+ROLES = frozenset(
+    {
+        "main-thread",
+        "dispatch-worker",
+        "job-worker",
+        "sse-handler",
+        "compactor",
+        "service-loop",
+    }
+)
+
+#: Roles whose reachable set must not store to self AT ALL (round 8).
+STRICT_NO_STORE = frozenset({"dispatch-worker"})
+#: Roles that run off the main thread (main-thread-guarded writes ban).
+OFF_MAIN = ROLES - {"main-thread"}
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _self_stores(fn) -> list:
+    """(attr, line) for every self.<attr> Store/Del/AugAssign in ``fn``
+    EXCLUDING nested defs (those are separate graph nodes)."""
+    out = []
+    skip: set[int] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, _FUNC) and sub is not fn:
+            for inner in ast.walk(sub):
+                skip.add(id(inner))
+    for sub in ast.walk(fn):
+        if id(sub) in skip:
+            continue
+        if (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+            and isinstance(sub.ctx, (ast.Store, ast.Del))
+        ):
+            out.append((sub.attr, sub.lineno))
+    return out
+
+
+def check(project: Project) -> list[Finding]:
+    graph = project.callgraph()
+    findings: list[Finding] = []
+
+    # -- role annotations are well-formed -------------------------------
+    for fi in graph.functions.values():
+        if fi.role is not None and fi.role not in ROLES:
+            findings.append(
+                Finding(
+                    RULE,
+                    fi.rel,
+                    fi.node.lineno,
+                    f"unknown thread-role {fi.role!r} on {fi.display()} "
+                    f"(vocabulary: {', '.join(sorted(ROLES))})",
+                )
+            )
+
+    # -- every resolved Thread/submit target carries a role -------------
+    for site in sorted(graph.thread_sites, key=lambda s: (s.rel, s.line)):
+        if site.target is None:
+            continue  # external / unresolvable target: soundness limit
+        fi = graph.functions[site.target]
+        if fi.role is None:
+            findings.append(
+                Finding(
+                    RULE,
+                    site.rel,
+                    site.line,
+                    f"thread target {site.expr} ({fi.display()}) has no "
+                    "role annotation — add `# ksimlint: thread-role(...)` "
+                    "on its def line (docs/lint.md \"Thread roles\")",
+                )
+            )
+
+    # -- propagation ------------------------------------------------------
+    strict_roots = graph.roots_with_role(STRICT_NO_STORE)
+    worker_roots = graph.roots_with_role(OFF_MAIN)
+    strict_reach = graph.reachable_same_receiver(strict_roots)
+    worker_reach = graph.reachable_same_receiver(worker_roots)
+
+    def via(key: str, reach: dict) -> str:
+        root, through = reach[key]
+        fi = graph.functions[key]
+        if root.key == key:
+            return f"{fi.display()} is a {root.role} root"
+        return (
+            f"{fi.display()} is reachable from {root.role} root "
+            f"{root.display()} (via {through.display()})"
+        )
+
+    # Dispatch-worker strictness: no self stores anywhere reachable.
+    for key in sorted(strict_reach):
+        fi = graph.functions[key]
+        if fi.name == "__init__":
+            continue  # constructor: self is the fresh instance (ownership)
+        for attr, line in _self_stores(fi.node):
+            findings.append(
+                Finding(
+                    RULE,
+                    fi.rel,
+                    line,
+                    f"store to self.{attr} in dispatch-worker-reachable "
+                    f"code: {via(key, strict_reach)} — dispatch workers "
+                    "must be side-effect-free on the instance (apply "
+                    "state on the main thread after join)",
+                )
+            )
+
+    # Off-main reachability: no writes to main-thread-guarded attrs, and
+    # no reaching a function pinned main-thread.
+    for key in sorted(worker_reach):
+        fi = graph.functions[key]
+        if fi.role == "main-thread" and worker_reach[key][0].key != key:
+            findings.append(
+                Finding(
+                    RULE,
+                    fi.rel,
+                    fi.node.lineno,
+                    f"main-thread-pinned function violated: "
+                    f"{via(key, worker_reach)}",
+                )
+            )
+            continue
+        if fi.cls is None or key in strict_reach or fi.name == "__init__":
+            continue  # strict check above already covers every store
+        guards = _class_guards(fi.sf, fi.cls.node)
+        for attr, line in _self_stores(fi.node):
+            if guards.get(attr) == MAIN_THREAD:
+                findings.append(
+                    Finding(
+                        RULE,
+                        fi.rel,
+                        line,
+                        f"write to main-thread-confined self.{attr}: "
+                        f"{via(key, worker_reach)} — main-thread state "
+                        "may only be read off-main (snapshot tearing is "
+                        "tolerated, cross-thread writes are not)",
+                    )
+                )
+    return findings
