@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs restart-check shard-check
+.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs restart-check shard-check mesh-check
 
 test:
 	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
@@ -36,8 +36,20 @@ lock-check: lint
 # for the same reason lock-check is.
 shard-check: lint
 	$(PY) -m pytest tests/test_replay_device.py tests/test_replay_cache.py -q -k "sharded or prewarm"
-	$(PY) -m pytest tests/test_bench.py -q -k "churn_shard"
+	$(PY) -m pytest tests/test_bench.py -q -k "churn_shard and not fleet"
 	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_lock_6k_sharded_tp8 -q -rs -m slow
+
+# The 2-D mesh suite (round 19, docs/scaling.md "2-D mesh"): the tp x dp
+# fleet parity tests + the donated-carry byte-identity test, the
+# churn_fleet_shard bench rung evidence (counts_match, the (2, 4) grid,
+# per-shard bytes, dev_const zero-resharding counters), and the slow
+# tp=4 x dp=2 6k fleet lock leg — every lane 2524/471 stepwise against
+# the solo unsharded run.  Gated on lint like shard-check; the bench
+# children run themselves in tests/helpers.sanitized_cpu_env.
+mesh-check: lint
+	$(PY) -m pytest tests/test_replay_device.py -q -k "tp_dp or donation"
+	$(PY) -m pytest tests/test_bench.py -q -k "churn_fleet_shard"
+	$(PY) -m pytest tests/test_behavior_locks.py::test_churn_fleet_lock_6k_tp4_dp2 -q -rs -m slow
 
 # The fault suite (docs/faults.md) on CPU in the sanitized environment
 # (tests/helpers.sanitized_cpu_env drops the axon sitecustomize that

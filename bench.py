@@ -546,6 +546,128 @@ def child_churn_fleet(seed: int, n_nodes: int, n_events: int, lanes: int) -> dic
     return out
 
 
+def child_churn_fleet_shard(
+    seed: int, n_nodes: int, n_events: int, lanes: int, tp: int
+) -> dict:
+    """2-D mesh fleet rung (round 19): the SAME churn stream on
+    ``lanes`` trajectories laid over the dp axis of a (dp, tp) fleet
+    mesh while every lane's node tensors shard over tp — one vmapped,
+    GSPMD-partitioned dispatch per window — next to the SOLO unsharded
+    device replay of the same stream.  Evidence the record must carry:
+    the solo-vs-fleet walls and aggregate speedup (the cond-gated
+    preemption restructure is what makes vmap >= solo-per-lane
+    possible — docs/scaling.md "2-D mesh (round 19)"), per-lane counts
+    with a ``counts_match`` flag (every lane must land the solo
+    counts), the (dp, tp) grids actually built, the leader's lowered
+    tp widths and per-shard full-record byte budget, and the leader's
+    dev_const hit/miss counters — steady-state segments re-transfer
+    NOTHING when the committed fleet layout is adopted, so misses must
+    flatten after the first dispatch (the zero-resharding claim).  On
+    a host with fewer than lanes*tp devices the fleet leg degrades
+    through the device-error ladder and the record says so — the JSON
+    line exists under any hardware condition."""
+    # The virtual (dp, tp) grid must exist BEFORE jax initializes its
+    # backend — harmless on real multi-device hosts.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import time
+
+    import jax
+
+    from ksim_tpu.scenario import ScenarioRunner, churn_scenario
+
+    _child_setup()
+    jax.config.update("jax_enable_x64", False)
+    # 4-step windows: the zero-resharding claim is about STEADY-STATE
+    # segments, and the dev-const reuse ladder needs three windows to
+    # fully engage (window 1 runs before the backend probe enables
+    # collection, window 2 builds the reuse map, window 3+ hits it) —
+    # the default 16-step window would need a 4800-event stream before
+    # the counters could move at all.
+    kw = dict(
+        max_pods_per_pass=1024,
+        pod_bucket_min=128,
+        device_replay=True,
+        preemption=True,
+        device_segment_steps=4,
+    )
+
+    def stream():
+        return churn_scenario(
+            seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100
+        )
+
+    # Solo leg runs unsharded and un-fleeted; scrub the knobs in case
+    # the orchestrator's env carries them.  One untimed warm-up first —
+    # both timed legs must start equally warm (see child_churn_fleet).
+    os.environ.pop("KSIM_REPLAY_TP", None)
+    os.environ.pop("KSIM_FLEET_DP", None)
+    ScenarioRunner(**kw).run(stream())
+    t0 = time.perf_counter()
+    solo = ScenarioRunner(**kw)
+    rs = solo.run(stream())
+    solo_wall = time.perf_counter() - t0
+
+    os.environ["KSIM_FLEET_DP"] = str(lanes)
+    os.environ["KSIM_REPLAY_TP"] = str(tp)
+    t1 = time.perf_counter()
+    fleet = ScenarioRunner(**kw, fleet=lanes)
+    rf = fleet.run(stream())
+    fleet_wall = time.perf_counter() - t1
+    leader = max(
+        (ln.driver for ln in fleet.fleet_lanes), key=lambda d: len(d.lower_log)
+    )
+    fd = fleet.fleet_driver
+    with fd._mesh_lock:
+        grids = sorted(fd._mesh)
+        mesh_failed = fd._mesh_failed
+    out = {
+        "events": n_events,
+        "nodes": n_nodes,
+        "lanes": lanes,
+        "tp": tp,
+        "solo_wall_s": round(solo_wall, 1),
+        "fleet_wall_s": round(fleet_wall, 1),
+        "aggregate_speedup": (
+            round(lanes * solo_wall / fleet_wall, 2) if fleet_wall else None
+        ),
+        "solo_counts": [rs.pods_scheduled, rs.unschedulable_attempts],
+        "lane_counts": [
+            [r.pods_scheduled, r.unschedulable_attempts] for r in rf.lanes
+        ],
+        "counts_match": all(
+            (r.pods_scheduled, r.unschedulable_attempts)
+            == (rs.pods_scheduled, rs.unschedulable_attempts)
+            for r in rf.lanes
+        ),
+        "mesh_grids": [list(g) for g in grids],
+        "mesh_failed": mesh_failed,
+        "lowered_tps": sorted({e["tp"] for e in leader.lower_log}),
+        "full_bytes_per_shard_max": max(
+            (e["full_bytes_per_shard"] for e in leader.lower_log), default=0
+        ),
+        "fleet": fd.stats(),
+        # Zero-resharding evidence: after the first fleet dispatch
+        # adopts the ("mesh", dp, tp) layout, steady-state windows hit
+        # the id-keyed dev-const reuse map — misses stay flat while
+        # hits grow with the window count.
+        "dev_const": leader.stats()["dev_const"],
+        "platform": jax.devices()[0].platform,
+    }
+    print(
+        f"[churn_fleet_shard {n_events}ev/{n_nodes}n x{lanes} tp={tp}] "
+        f"solo {solo_wall:.1f}s, fleet {fleet_wall:.1f}s "
+        f"({out['aggregate_speedup']}x aggregate, grids {out['mesh_grids']}, "
+        f"counts_match {out['counts_match']})",
+        file=sys.stderr,
+        flush=True,
+    )
+    return out
+
+
 def child_churn_jobs(
     seed: int, n_nodes: int, n_events: int, n_jobs: int, workers: int
 ) -> dict:
@@ -1000,6 +1122,14 @@ def _child_main(args: argparse.Namespace) -> None:
                 args.churn_events,
                 args.fleet_lanes,
             )
+        elif args.child == "churn_fleet_shard":
+            out = child_churn_fleet_shard(
+                args.seed,
+                args.churn_nodes,
+                args.churn_events,
+                args.fleet_lanes,
+                args.shard_tp,
+            )
         elif args.child == "churn_jobs":
             out = child_churn_jobs(
                 args.seed,
@@ -1281,7 +1411,8 @@ def main() -> None:
         "--child",
         choices=[
             "probe", "rung", "churn", "churn_shard", "churn_fleet",
-            "churn_jobs", "churn_trace", "churn_restart", "churn_resume",
+            "churn_fleet_shard", "churn_jobs", "churn_trace",
+            "churn_restart", "churn_resume",
         ],
         default=None,
     )
@@ -1604,6 +1735,30 @@ def main() -> None:
             mode="churn_fleet",
         )
 
+    def run_churn_fleet_shard_stage() -> None:
+        """2-D mesh fleet rung (round 19): 2 lanes over dp composed
+        with tp=4 node sharding — the (2, 4) grid that exactly fills
+        the 8-device floor every host in the ladder can fake — against
+        the solo unsharded device replay of the same 6k prefix.  The
+        record carries the aggregate speedup, per-lane counts_match,
+        the grids built, per-shard bytes and the leader's dev_const
+        counters (the zero-resharding claim).  Always the 6k prefix:
+        the claims are about layout and amortization, not stream
+        length."""
+        run_secondary_churn_rung(
+            "churn_fleet_shard",
+            lambda resized: [
+                "--seed", str(args.seed),
+                "--churn-events", str(min(args.churn_events, 6_000)),
+                "--churn-nodes", str(min(args.churn_nodes, CPU_CHURN_CAP[1])),
+                "--fleet-lanes", "2",
+                "--shard-tp", "4",
+            ],
+            CHURN_TIMEOUT,
+            min_budget=120,
+            mode="churn_fleet_shard",
+        )
+
     def run_churn_jobs_stage() -> None:
         """Job-plane rung (round 13, ksim_tpu/jobs): 8 concurrent 6k
         churn streams as tenant jobs through the bounded queue on a
@@ -1800,6 +1955,7 @@ def main() -> None:
     run_churn_device_full_stage()
     run_churn_shard_stage()
     run_churn_fleet_stage()
+    run_churn_fleet_shard_stage()
     run_churn_jobs_stage()
     run_churn_trace_stage()
     run_churn_restart_stage()

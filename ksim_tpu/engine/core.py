@@ -268,20 +268,45 @@ def _multi_device(a) -> bool:
         return False
 
 
+def _owned_host(a) -> np.ndarray:
+    """Pull ONE device array to host as an OWNED numpy array.
+
+    ``np.asarray`` on a jax.Array is ZERO-COPY on the CPU backend where
+    the layout allows it — single-device outputs view a ``memoryview``
+    of the result buffer, and a replicated multi-device output views
+    shard 0's buffer directly (sharded leaves gather, which copies).  A
+    retained view is a time bomb once the producing buffer's memory can
+    be recycled: with the segment carry DONATED (round 19) XLA reuses
+    execution memory aggressively, and the fleet tp*dp replay was
+    observed to decode garbage through exactly such views — committed
+    counts diverged nondeterministically at 1200-event scale, and any
+    host-sync instrumentation made the race vanish.  One explicit copy
+    per leaf pins the decode to host-owned memory; host numpy inputs
+    pass through untouched."""
+    if isinstance(a, np.ndarray):
+        return a
+    h = np.asarray(a)
+    if isinstance(h, np.ndarray) and not h.flags["OWNDATA"]:
+        h = np.array(h)
+    return h
+
+
 def _pull_tree_to_host(tree):
     """Transfer a pytree of device arrays to host numpy with ONE
     device->host transfer: a jitted program bitcasts every leaf to bytes
     and concatenates them into a single uint8 buffer; the host splits and
     re-views.  The record="full" product path pulls 5 result tensors per
     pod chunk — on the remote-tunnel runtime each pull is a blocking
-    round-trip, so collapsing them is the mirror of the input packing."""
+    round-trip, so collapsing them is the mirror of the input packing.
+    Every returned leaf is host-OWNED (``_owned_host``): zero-copy
+    views of device buffers must never escape the pull boundary."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if len(leaves) < 2 or not all(
         hasattr(a, "dtype") and np.dtype(a.dtype) != object for a in leaves
     ) or any(_multi_device(a) for a in leaves):
         # Mirror _pack_tree_to_device's non-array fallback.
         return jax.tree_util.tree_unflatten(
-            treedef, [np.asarray(a) for a in leaves]
+            treedef, [_owned_host(a) for a in leaves]
         )
     sig = tuple((np.dtype(a.dtype).str, a.shape) for a in leaves)
     fn = _OUTPACK_CACHE.get(sig)
@@ -301,7 +326,9 @@ def _pull_tree_to_host(tree):
 
         fn = jax.jit(pack)
         _OUTPACK_CACHE[sig] = fn
-    buf = np.asarray(fn(*leaves))
+    # _owned_host: the split below RE-VIEWS buf, so buf itself must own
+    # its memory or every decoded leaf aliases the device result buffer.
+    buf = _owned_host(fn(*leaves))
     out = []
     off = 0
     for dtype_str, shape in sig:

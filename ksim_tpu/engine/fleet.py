@@ -145,7 +145,10 @@ class FleetDriver:
         # run behind the watchdog — jax.devices() on a wedged tunnel
         # hangs) and read by later workers, so it takes a real lock.
         self._mesh_lock = threading.Lock()
-        self._mesh = None  # guarded-by: _mesh_lock
+        # (dp, tp) -> Mesh: one entry per node-shard width the cohort's
+        # plans have dispatched with (tp follows plan.statics.tp, round
+        # 19 — the 2-D fleet lays lanes over dp AND node shards over tp).
+        self._mesh: dict = {}  # guarded-by: _mesh_lock
         self._mesh_failed = False  # guarded-by: _mesh_lock
         # Fleet evidence counters (the churn_fleet bench rung and the
         # lock-check's lowered-once guard read them).  All fleet
@@ -443,7 +446,7 @@ class FleetDriver:
             try:
                 if stacked:
                     box["out"] = _fleet_exec(
-                        plan, lanes_state0, self._worker_mesh()
+                        plan, lanes_state0, self._worker_mesh(plan.statics.tp)
                     )
                 else:
                     # Dedupe: the leader's solo segment program (same
@@ -517,12 +520,15 @@ class FleetDriver:
         self.group_dispatches += 1
         return box["out"]
 
-    def _worker_mesh(self):
-        """The KSIM_FLEET_DP lane mesh, built lazily on the DISPATCH
-        WORKER thread (jax.devices() initializes the backend; a wedged
-        tunnel must hang the watchdogged worker, never the main
-        thread).  A mesh build failure degrades to single-device fleet
-        dispatch — once, loudly."""
+    def _worker_mesh(self, tp: int = 1):
+        """The KSIM_FLEET_DP (dp, tp) fleet mesh, built lazily on the
+        DISPATCH WORKER thread (jax.devices() initializes the backend;
+        a wedged tunnel must hang the watchdogged worker, never the
+        main thread).  ``tp`` follows the dispatching plan's node-shard
+        width (plan.statics.tp, round 19) — a cohort whose plans narrow
+        tp across windows gets one memoized mesh per width.  A mesh
+        build failure degrades to single-device fleet dispatch — once,
+        loudly."""
         if self.dp is None:
             return None
         from ksim_tpu.engine.sharding import fleet_mesh
@@ -530,19 +536,21 @@ class FleetDriver:
         with self._mesh_lock:
             if self._mesh_failed:
                 return None
-            if self._mesh is None:
+            mesh = self._mesh.get((self.dp, tp))
+            if mesh is None:
                 try:
                     # Deliberate worker-side store: the mesh is built
                     # lazily ON the dispatch worker so a wedged chip
                     # tunnel hangs the watchdogged worker, never the
                     # main thread; _mesh_lock makes both writes safe.
-                    self._mesh = fleet_mesh(self.dp)  # ksimlint: disable=thread-role
+                    mesh = fleet_mesh(self.dp, tp)  # ksimlint: disable=thread-role
+                    self._mesh[(self.dp, tp)] = mesh  # ksimlint: disable=thread-role
                 except Exception as e:
                     self._mesh_failed = True  # ksimlint: disable=thread-role
                     logger.warning(
-                        "KSIM_FLEET_DP=%d mesh unavailable (%s: %s); fleet "
-                        "dispatch stays single-device",
-                        self.dp, type(e).__name__, e,
+                        "KSIM_FLEET_DP=%d x tp=%d mesh unavailable (%s: %s); "
+                        "fleet dispatch stays single-device",
+                        self.dp, tp, type(e).__name__, e,
                     )
                     return None
-            return self._mesh
+            return mesh

@@ -191,8 +191,21 @@ def _replay_tp() -> int:
 #: Minimum node rows per shard before _lower narrows the mesh width.
 #: Empirical partitioner-hazard floor, NOT tunable: below it the SPMD
 #: preemption scan silently doubled sel/nom values (see the narrowing
-#: comment in _lower and docs/churn_floor.md).
+#: comment in _lower, docs/churn_floor.md, and the standalone
+#: jax-only repro in tools/shard_repro.py).
 _MIN_SHARD_NODES = 4
+
+
+#: ``KSIM_REPLAY_DONATE`` (default on): donate the scan-carried cluster
+#: state (``state0``) to the segment programs.  The carry is transferred
+#: fresh every dispatch and never enters the id-keyed dev-const reuse
+#: map, so XLA may alias its input buffer into the output instead of
+#: holding TWO copies of cluster state per chip for the dispatch's
+#: lifetime — on a fleet mesh that halves the per-chip carry footprint
+#: (docs/scaling.md "2-D mesh (round 19)").  ``0`` is the escape hatch
+#: for backends whose runtime mishandles input-output aliasing.  Read
+#: at import (the jit wrappers are built once, at module load).
+_REPLAY_DONATE = os.environ.get("KSIM_REPLAY_DONATE", "1") != "0"
 
 
 #: Half-open cooldown doubling is bounded here: a backend that stays
@@ -428,6 +441,13 @@ class _SegmentStatics:
     c_max: int = PREEMPT_CANDIDATES  # candidate-node scan bound (per shard)
     v_max: int = PREEMPT_VICTIMS  # victims-per-candidate bound (per shard)
     tp: int = 1  # node-axis mesh width (round 17 sharded replay)
+    # Round 19: the vmap axis name the fleet program maps lanes over, or
+    # None for a solo program.  With it set, the preemption-search gate
+    # reduces its predicate over the lane axis (lax.psum) so the
+    # lax.cond predicate stays UNBATCHED under vmap — the gate lowers
+    # to a real XLA conditional instead of a both-branches select (the
+    # select bomb, docs/scaling.md "2-D mesh (round 19)").
+    lane_axis: "str | None" = None
 
 
 # ---------------------------------------------------------------------------
@@ -468,9 +488,8 @@ def _derive_interpod(loc: dict, ipa: dict, st: _SegmentStatics) -> dict:
     return out
 
 
-@partial(jax.jit, static_argnums=(0, 1))
 @device_kernel(static=("st", "prog"))
-def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
+def _segment_body(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
     """Run K scenario steps on-device.
 
     const: universe-static arrays — node statics (allocatable /
@@ -873,6 +892,38 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
         else:
             live0 = {}
 
+        def _bind_live(live: dict, pb, best) -> dict:
+            """Apply one pod attempt's bind to the live view (a failed
+            attempt — best < 0 — drops every scatter, so this is a
+            no-op for it).  Shared VERBATIM by the bind scan and the
+            gated search scan below: the search phase re-derives the
+            exact live sequence by replaying these binds, so the op
+            order (and with it f32/i32 bit-exactness) must be the one
+            sequence both phases execute."""
+            j = pb.index
+            tgtb = jnp.where(best >= 0, best, N)
+            bj = jnp.where(best >= 0, j, P)
+            live = dict(live)
+            live["requested"] = live["requested"].at[tgtb].add(
+                pb.requests, mode="drop"
+            )
+            live["nonzero_requested"] = live["nonzero_requested"].at[tgtb].add(
+                pb.nonzero_requests, mode="drop"
+            )
+            live["pod_count"] = live["pod_count"].at[tgtb].add(1, mode="drop")
+            live["spread"] = live["spread"].at[tgtb].add(
+                sel_rows[j].astype(live["spread"].dtype), mode="drop"
+            )
+            live["ip_cnt"] = live["ip_cnt"].at[tgtb].add(
+                qm_rows[j].astype(live["ip_cnt"].dtype), mode="drop"
+            )
+            live["ip_eat"] = live["ip_eat"].at[tgtb].add(eat_rows[j], mode="drop")
+            live["ip_vw"] = live["ip_vw"].at[tgtb].add(vw_rows[j], mode="drop")
+            live["bound"] = live["bound"].at[bj].set(best, mode="drop")
+            # The apiserver clears nominations on bind.
+            live["nominated"] = live["nominated"].at[bj].set(False, mode="drop")
+            return live
+
         def pod_body(pcarry, pb):
             nstate, pcarries, live = pcarry
             from ksim_tpu.plugins.base import PodView
@@ -909,61 +960,30 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
                     jnp.stack(_final) if _final else jnp.zeros((0, N), jnp.int32)
                 ).astype(final_dtype)
             if st.preempt:
+                # Phase A (round 19): apply the bind and emit only the
+                # search TRIGGER — the victim search itself moved to a
+                # second, step-level `lax.cond`-gated scan below.  The
+                # trigger is computed against the binds-only live view,
+                # which can only OVER-approximate the exact one: a
+                # search removes victims (alive <- False, bound <- -1),
+                # shrinking `lower`, and never touches anything `best`
+                # depends on (nstate / pcarries are binds-only — see
+                # the live0 comment above).  So exact-pred true implies
+                # pred_hat true, and a step whose every pred_hat is
+                # false provably ran no search — its binds-only live IS
+                # the exact post-step live.
+                live = _bind_live(live, pb, best)
                 j = pb.index
-                tgtb = jnp.where(best >= 0, best, N)
-                bj = jnp.where(best >= 0, j, P)
-                live = dict(live)
-                live["requested"] = live["requested"].at[tgtb].add(
-                    pb.requests, mode="drop"
-                )
-                live["nonzero_requested"] = live["nonzero_requested"].at[tgtb].add(
-                    pb.nonzero_requests, mode="drop"
-                )
-                live["pod_count"] = live["pod_count"].at[tgtb].add(1, mode="drop")
-                live["spread"] = live["spread"].at[tgtb].add(
-                    sel_rows[j].astype(live["spread"].dtype), mode="drop"
-                )
-                live["ip_cnt"] = live["ip_cnt"].at[tgtb].add(
-                    qm_rows[j].astype(live["ip_cnt"].dtype), mode="drop"
-                )
-                live["ip_eat"] = live["ip_eat"].at[tgtb].add(eat_rows[j], mode="drop")
-                live["ip_vw"] = live["ip_vw"].at[tgtb].add(vw_rows[j], mode="drop")
-                live["bound"] = live["bound"].at[bj].set(best, mode="drop")
-                # The apiserver clears nominations on bind.
-                live["nominated"] = live["nominated"].at[bj].set(False, mode="drop")
                 prio_p = prow["priority"][j]
                 lower = (
                     live["alive"] & (live["bound"] >= 0) & (prow["priority"] < prio_p)
                 )
-                pred = (
+                out_pod["pred_hat"] = (
                     pb.valid
                     & (best < 0)
                     & prow["preempt_ok"][j]
                     & jnp.any(lower)
                 )
-                bits_mat = jnp.stack(_bits) if (st.record == "full" and _bits) else None
-
-                def do_search(op):
-                    lv, lw = op
-                    return _preempt_search(
-                        s, lv, pod, bits_mat, ev_k["name_rank"], ev_k["want"], lw
-                    )
-
-                def no_search(op):
-                    lv, _lw = op
-                    return (
-                        lv,
-                        jnp.int32(-1),
-                        jnp.full(v_eff, -1, jnp.int32),
-                        jnp.zeros((), bool),
-                    )
-
-                live, nom, vicr, over = jax.lax.cond(
-                    pred, do_search, no_search, (live, lower)
-                )
-                out_pod["nom"] = nom
-                out_pod["vic"] = vicr
-                out_pod["over"] = over
             return (nstate, pcarries, live), out_pod
 
         (node_state, carries, live), pod_outs = jax.lax.scan(
@@ -972,6 +992,102 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
         sel = pod_outs["best"]
         bound_mask = (idx_q < P) & (sel >= 0)
         fail_mask = (idx_q < P) & (sel < 0)
+        if st.preempt:
+            # Phase B (round 19): the victim search, behind ONE
+            # step-level conditional.  `go` is the disjunction of the
+            # phase-A triggers; in the fleet program (st.lane_axis set)
+            # it is additionally psum-reduced over the vmap lane axis,
+            # which makes the predicate UNBATCHED — the cond lowers to
+            # a real XLA conditional instead of the both-branches
+            # select a batched predicate forces (the select bomb,
+            # docs/scaling.md "2-D mesh (round 19)").  Lane semantics:
+            # if ANY lane wants a search this step, EVERY lane replays
+            # the search scan (lanes without triggers recompute their
+            # binds-only live, byte-identically); steps where no lane
+            # triggers skip the ~c_eff*(v_eff+1) search machinery
+            # entirely.
+            go = jnp.any(pod_outs["pred_hat"])
+            if st.lane_axis is not None:
+                go = jax.lax.psum(go.astype(jnp.int32), st.lane_axis) > 0
+
+            with_bits = st.record == "full" and n_filters > 0
+            search_xs = (pods_q, sel) + (
+                (pod_outs["bits"],) if with_bits else ()
+            )
+
+            def search_pods(_):
+                # Exact replay: rescan the queue from the pre-pass live
+                # snapshot, re-applying each bind via the SAME
+                # _bind_live the bind scan used and running the
+                # original per-pod search cond — the one interleaved
+                # bind/search sequence round 12 shipped, byte for byte.
+                # (`best` comes in from phase A: searches never feed
+                # back into it.)  Stored bits are value-identical to
+                # the raw i32 stack the old in-scan search consumed:
+                # _result_dtypes picks bits_dtype wide enough for every
+                # declared reason bit.
+                def search_body(live, xs):
+                    if with_bits:
+                        pb, best, bits_mat = xs
+                    else:
+                        (pb, best), bits_mat = xs, None
+                    from ksim_tpu.plugins.base import PodView
+
+                    pod = PodView(
+                        requests=pb.requests,
+                        nonzero_requests=pb.nonzero_requests,
+                        tolerates_unschedulable=pb.tolerates_unschedulable,
+                        has_requests=pb.has_requests,
+                        index=pb.index,
+                    )
+                    live = _bind_live(live, pb, best)
+                    j = pb.index
+                    prio_p = prow["priority"][j]
+                    lower = (
+                        live["alive"]
+                        & (live["bound"] >= 0)
+                        & (prow["priority"] < prio_p)
+                    )
+                    pred = (
+                        pb.valid
+                        & (best < 0)
+                        & prow["preempt_ok"][j]
+                        & jnp.any(lower)
+                    )
+
+                    def do_search(op):
+                        lv, lw = op
+                        return _preempt_search(
+                            s, lv, pod, bits_mat, ev_k["name_rank"],
+                            ev_k["want"], lw,
+                        )
+
+                    def no_search(op):
+                        lv, _lw = op
+                        return (
+                            lv,
+                            jnp.int32(-1),
+                            jnp.full(v_eff, -1, jnp.int32),
+                            jnp.zeros((), bool),
+                        )
+
+                    live, nom, vicr, over = jax.lax.cond(
+                        pred, do_search, no_search, (live, lower)
+                    )
+                    return live, {"nom": nom, "vic": vicr, "over": over}
+
+                return jax.lax.scan(
+                    search_body, dict(live0), search_xs, unroll=SCAN_UNROLL
+                )
+
+            def skip_search(_):
+                return dict(live), {
+                    "nom": jnp.full(st.q, -1, jnp.int32),
+                    "vic": jnp.full((st.q, v_eff), -1, jnp.int32),
+                    "over": jnp.zeros(st.q, bool),
+                }
+
+            live, souts = jax.lax.cond(go, search_pods, skip_search, 0)
         if st.preempt:
             # live already holds binds + victim removals: it IS the
             # post-step state.
@@ -1043,9 +1159,9 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
             ).astype(jnp.int32),
         }
         if st.preempt:
-            out["nom"] = pod_outs["nom"]
-            out["vic"] = pod_outs["vic"]
-            out["overflow"] = jnp.any(pod_outs["over"])
+            out["nom"] = souts["nom"]
+            out["vic"] = souts["vic"]
+            out["overflow"] = jnp.any(souts["over"])
         if st.record == "full":
             out["bits"] = pod_outs["bits"]
             out["raw"] = pod_outs["raw"]
@@ -1056,12 +1172,82 @@ def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
     return final_state, outs
 
 
+#: Donation (round 19): argument 4 is the carried cluster state.  Both
+#: executors transfer it FRESH every dispatch — the id-keyed device
+#: reuse map covers the CONST leaves only (_pack_plan_buffers /
+#: _shard_plan_buffers put ``(ev, state0)`` in the per-dispatch batch
+#: unconditionally) — so donating it can never hand XLA a buffer a
+#: later dispatch still needs, and the output carry reuses the input's
+#: allocation instead of holding two copies of ``[N]``/``[N, R]``
+#: cluster state per chip (SNIPPETS.md scan-carry donation idiom; the
+#: fleet's dominant per-lane footprint).  ``KSIM_REPLAY_DONATE=0``
+#: restores the copying program.
+#:
+#: MESH dispatches never donate (the ``_nodonate`` twins below): on the
+#: forced-8-virtual-device CPU backend, donating the carry of a
+#: multi-device (dp, tp) program made replay diverge from the store
+#: NONDETERMINISTICALLY at 1200-event fleet scale (ReplayParityError
+#: with the device view AHEAD of the store, or silently wrong counts)
+#: while any host-sync instrumentation made it pass — a timing race in
+#: input-output aliasing across the virtual devices, not a logic bug:
+#: the same program is byte-stable donation-off (repeated-trial
+#: bisection, round 19) and single-device donation is locked by
+#: tests/test_replay_device.py.  Virtual CPU devices share one host
+#: allocator, so per-device "exclusive" donated buffers can alias in
+#: ways real per-chip HBM cannot; re-evaluate on silicon before
+#: donating mesh carries.
+_DONATE_ARGNUMS = (4,) if _REPLAY_DONATE else ()
+
+
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=_DONATE_ARGNUMS)
+@device_kernel(static=("st", "prog"))
+def _segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
+    """Solo replay program: ``_segment_body`` jitted with the carry
+    donated (see ``_DONATE_ARGNUMS``).  The jit boundary lives on this
+    thin wrapper — not on the body — so the fleet program can vmap the
+    UNJITTED body: donation must be declared on the outermost jit, and
+    a jit-inside-vmap would re-trace per lane."""
+    return _segment_body(st, prog, const, ev, state0)
+
+
 @partial(jax.jit, static_argnums=(0, 1))
+@device_kernel(static=("st", "prog"))
+def _segment_fn_nodonate(
+    st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict
+):
+    """``_segment_fn`` without carry donation — the MESH twin.  Sharded
+    (tp > 1) dispatches route here: see the ``_DONATE_ARGNUMS`` note for
+    the virtual-device aliasing race that forbids donating multi-device
+    carries on this backend."""
+    return _segment_body(st, prog, const, ev, state0)
+
+
+def _fleet_segment_impl(
+    st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict
+):
+    """Shared traced body of the fleet program (see ``_fleet_segment_fn``
+    for the semantics); the donating and non-donating jit twins both
+    wrap this so the vmap/lane-axis structure is written once."""
+    import dataclasses
+
+    import jax
+
+    lane_st = dataclasses.replace(st, lane_axis="lane")
+    return jax.vmap(
+        lambda s: _segment_body(lane_st, prog, const, ev, s),
+        axis_name="lane",
+    )(state0)
+
+
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=_DONATE_ARGNUMS)
 @device_kernel(static=("st", "prog"))
 def _fleet_segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict):
     """Fleet replay: advance S INDEPENDENT trajectories by K steps in one
-    dispatch — ``_segment_fn`` vmapped over a leading lane axis on the
-    carried cluster state (``state0``).
+    dispatch — ``_segment_body`` vmapped over a leading lane axis on the
+    carried cluster state (``state0``), with the lane axis NAMED: the
+    statics gain ``lane_axis="lane"`` so the preemption-search gate can
+    psum its trigger over lanes and keep a lane-uniform (unbatched)
+    ``lax.cond`` predicate — the round-19 select-bomb fix.
 
     ``const`` AND ``ev`` are closed over, i.e. broadcast across lanes:
     the fleet's contract is that every grouped lane shares ONE lowered
@@ -1083,9 +1269,20 @@ def _fleet_segment_fn(st: _SegmentStatics, prog, const: dict, ev: dict, state0: 
     slice of the outputs is byte-identical to its solo ``_segment_fn``
     dispatch — the fleet parity lock (tests/test_replay_device.py,
     `make lock-check`)."""
-    import jax
+    return _fleet_segment_impl(st, prog, const, ev, state0)
 
-    return jax.vmap(lambda s: _segment_fn(st, prog, const, ev, s))(state0)
+
+@partial(jax.jit, static_argnums=(0, 1))
+@device_kernel(static=("st", "prog"))
+def _fleet_segment_fn_nodonate(
+    st: _SegmentStatics, prog, const: dict, ev: dict, state0: dict
+):
+    """``_fleet_segment_fn`` without carry donation — the MESH twin.
+    Fleet dispatches on a (dp, tp) mesh route here: donating a
+    multi-device carry raced on the virtual CPU backend (see the
+    ``_DONATE_ARGNUMS`` note); single-device fleet packs keep the
+    donating twin."""
+    return _fleet_segment_impl(st, prog, const, ev, state0)
 
 
 # ---------------------------------------------------------------------------
@@ -1243,13 +1440,17 @@ class ReplayDriver:
         self._last_plan: "_SegmentPlan | None" = None  # guarded-by: main-thread
         self._dev_consts: dict[int, tuple[Any, Any]] = {}  # guarded-by: main-thread
         self._dev_consts_x64: "bool | None" = None  # guarded-by: main-thread
-        self._dev_consts_tp: "int | None" = None  # guarded-by: main-thread
+        # Layout token the adopted buffers were committed under (round
+        # 19): ("pack",) or ("mesh", dp, tp) — see _SegmentPlan.
+        self._dev_consts_layout: Any = None  # guarded-by: main-thread
         # Sharded replay (round 17): the requested node-mesh width.  An
         # explicit service shard_mesh (validated in service_supported)
-        # wins over the env knob; fleet lanes force tp=1 — their lane
-        # axis already owns the mesh (dp), and a lane's segment scan
-        # must stay whole on its device.
-        self._tp_env = _replay_tp() if lane is None else 1
+        # wins over the env knob.  Fleet lanes honor the knob too since
+        # round 19: the group dispatch lays the lane axis over dp and
+        # the node axis over tp of its own (dp, tp) fleet mesh, so a
+        # lane's tp declaration composes with KSIM_FLEET_DP instead of
+        # being forced to 1 (the round-17 whole-lane-per-device rule).
+        self._tp_env = _replay_tp()
         self._tp_req = self._tp_env  # guarded-by: main-thread
         self._shard_mesh_obj: Any = None  # guarded-by: main-thread
         # Default: ON where re-transfer is the only cost (cpu backend),
@@ -1381,11 +1582,16 @@ class ReplayDriver:
             # the parity contract), and a mesh without a tp axis has
             # nothing to lay the node axis over.  Axis sizes come off
             # the mesh object itself — no backend init on this thread.
+            # A FLEET lane (round 19) takes the mesh as its tp-width
+            # declaration only: the group dispatch lays lanes over its
+            # own (dp, tp) fleet mesh of the same node-shard width
+            # (engine/fleet.py _worker_mesh), while the lane's solo
+            # fallback dispatches honor the declared (1, tp) layout.
             from ksim_tpu.engine.sharding import DP, TP
 
             mesh = svc._shard_mesh
             axes = dict(zip(mesh.axis_names, mesh.devices.shape))
-            if axes.get(DP, 1) != 1 or TP not in axes or self.lane is not None:
+            if axes.get(DP, 1) != 1 or TP not in axes:
                 self._reject("shard_mesh")
                 return False
             self._shard_mesh_obj = mesh
@@ -1793,13 +1999,16 @@ class ReplayDriver:
         if (
             self._dev_cache_on
             and self._dev_consts_x64 == bool(jax.config.jax_enable_x64)
-            and self._dev_consts_tp == plan.statics.tp
         ):
-            # Round 17: the reuse map holds buffers already laid out for
-            # ONE mesh width — a tp change re-shards everything, so only
-            # a same-tp dispatch may hit it (changed host arrays still
-            # miss by id and re-shard individually).
+            # Round 17/19: the reuse map holds buffers committed to ONE
+            # device layout.  The map rides with its layout TOKEN and
+            # the executor compares at use-site (a solo-vs-fleet or
+            # mesh-shape change silently misses and re-transfers;
+            # changed host arrays still miss by id individually) — the
+            # driver can't predict here whether the fleet will dispatch
+            # this plan on its (dp, tp) mesh.
             plan.dev_reuse = self._dev_consts
+            plan.dev_reuse_layout = self._dev_consts_layout
         return plan
 
     def dispatch_segment(self, plan: "_SegmentPlan", batches: list[list[Any]]):
@@ -1857,7 +2066,7 @@ class ReplayDriver:
             # the next one (main thread: _run never mutates the driver).
             self._dev_consts = plan.dev_map_out
             self._dev_consts_x64 = bool(jax.config.jax_enable_x64)
-            self._dev_consts_tp = plan.statics.tp
+            self._dev_consts_layout = plan.dev_layout
             self.dev_const_hits += plan.dev_hits
             self.dev_const_misses += plan.dev_misses
 
@@ -2839,12 +3048,18 @@ class ReplayDriver:
                 plan, (plan.ev, plan.state0), mesh
             )
         else:
+            mesh = None
             const_dev, (ev_dev, state_dev) = _pack_plan_buffers(
                 plan, (plan.ev, plan.state0)
             )
+        # Mesh dispatches take the non-donating twin — donated
+        # multi-device carries race on the virtual-device CPU backend
+        # (the _DONATE_ARGNUMS note); the cache key's mesh component
+        # keeps the two executables distinct.
+        seg_fn = _segment_fn if mesh is None else _segment_fn_nodonate
         final_state, outs = COMPILE_CACHE.run(
-            _compile_cache_key("solo", plan, (const_dev, ev_dev, state_dev)),
-            lambda: _segment_fn(
+            _compile_cache_key("solo", plan, (const_dev, ev_dev, state_dev), mesh=mesh),
+            lambda: seg_fn(
                 plan.statics, plan.prog, const_dev, ev_dev, state_dev
             ),
             owner=TRACE.scope_tags().get("job"),
@@ -3133,7 +3348,7 @@ class ReplayDriver:
             )
 
 
-def _compile_cache_key(kind: str, plan: "_SegmentPlan", dev_tree) -> tuple:
+def _compile_cache_key(kind: str, plan: "_SegmentPlan", dev_tree, mesh=None) -> tuple:
     """The shape-rung identity of one dispatch, for the process-wide
     compile-once gate (engine/compilecache.py): the hashable program
     statics, the profile token (``_Program`` hashes on its plugin
@@ -3141,10 +3356,17 @@ def _compile_cache_key(kind: str, plan: "_SegmentPlan", dev_tree) -> tuple:
     x64 mode, and the dtype/shape signature of every input leaf — the
     bucketed shape ladder makes these collide across same-rung tenants
     by construction.  ``kind`` separates the solo and lane-stacked
-    (fleet) programs, which compile differently for identical inputs."""
+    (fleet) programs, which compile differently for identical inputs;
+    ``mesh`` (round 19) adds the (dp, tp) device-grid shape — a fleet
+    dispatch on a 2-D mesh commits different input shardings than a
+    single-device one of identical avals, so they must not share a
+    rung."""
     leaves = jax.tree_util.tree_leaves(dev_tree)
     sig = tuple((str(a.dtype), tuple(a.shape)) for a in leaves)
-    return (kind, plan.statics, plan.prog, bool(jax.config.jax_enable_x64), sig)
+    grid = tuple(int(d) for d in mesh.devices.shape) if mesh is not None else None
+    return (
+        kind, plan.statics, plan.prog, bool(jax.config.jax_enable_x64), grid, sig,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -3353,6 +3575,46 @@ def _const_dev_dict(cacheable_dev) -> dict:
     return {"node": node_dev, "pods": pods_dev, "aux": aux_dev, **extra_dev}
 
 
+def _reuse_scan(reuse, c_leaves):
+    """Split const leaves into device-buffer reuse hits and transfer
+    misses — the shared first half of both executors' packers.  Two
+    rungs: the id-keyed fast path (the featurizer kept the host array
+    OBJECT alive since last window), then a positional VALUE rung —
+    ``_plan_const_parts`` flattens in canonical order and the reuse
+    map preserves insertion order, so leaf ``i`` aligns with last
+    window's leaf ``i``.  The value rung is what makes steady-state
+    reuse real on churn streams: the featurizer restacks its tensors
+    every lower (fresh array ids even on a lowered-universe cache
+    hit) while the steady-state VALUES are unchanged, so an id-only
+    map misses wholesale forever.  Byte-equality is the full safety
+    condition (the cached device buffer holds exactly the bytes the
+    transfer would produce); positional alignment only affects the
+    hit rate, never correctness.  A changed leaf pays one short-
+    circuiting memcmp before it transfers — cheap against the H2D
+    round trip it replaces."""
+    prev = list(reuse.values()) if reuse else None
+    dev_c: "list[Any]" = [None] * len(c_leaves)
+    miss_idx: "list[int]" = []
+    for i, a in enumerate(c_leaves):
+        ent = reuse.get(id(a)) if reuse else None
+        if ent is not None and ent[0] is a:
+            dev_c[i] = ent[1]
+            continue
+        if prev is not None and i < len(prev):
+            pa, pd = prev[i]
+            if (
+                isinstance(a, np.ndarray)
+                and isinstance(pa, np.ndarray)
+                and pa.shape == a.shape
+                and pa.dtype == a.dtype
+                and np.array_equal(pa, a)
+            ):
+                dev_c[i] = pd
+                continue
+        miss_idx.append(i)
+    return dev_c, miss_idx
+
+
 def _pack_plan_buffers(plan: "_SegmentPlan", transient):
     """ONE transfer protocol for both executors: constant buffers (node
     statics, pod rows, aux tables) that are the SAME host arrays as the
@@ -3363,7 +3625,10 @@ def _pack_plan_buffers(plan: "_SegmentPlan", transient):
     ``transient`` tree: event streams + the solo or lane-stacked carry)
     packs into the usual single byte-buffer transfer.  The id-keyed map
     pins its host arrays, so a recycled id can never alias a fresh
-    array.  Reuse evidence (dev_hits/dev_misses) and the next window's
+    array; identity is the fast path and positional byte-equality the
+    second rung (``_reuse_scan`` — the featurizer restacks tensors
+    every lower, so steady-state reuse is a VALUE property, not an id
+    one).  Reuse evidence (dev_hits/dev_misses) and the next window's
     reuse map (dev_map_out, only when the driver will adopt it — with
     the cache off, retaining it would pin a full segment's constant
     buffers across the next window: the KSIM_H2D_CACHE pinning
@@ -3375,15 +3640,9 @@ def _pack_plan_buffers(plan: "_SegmentPlan", transient):
     cacheable = _plan_const_parts(plan)
     c_leaves, c_def = jax.tree_util.tree_flatten(cacheable)
     t_leaves, t_def = jax.tree_util.tree_flatten(transient)
-    reuse = plan.dev_reuse
-    dev_c: list[Any] = [None] * len(c_leaves)
-    miss_idx: list[int] = []
-    for i, a in enumerate(c_leaves):
-        ent = reuse.get(id(a)) if reuse else None
-        if ent is not None and ent[0] is a:
-            dev_c[i] = ent[1]
-        else:
-            miss_idx.append(i)
+    plan.dev_layout = ("pack",)
+    reuse = plan.dev_reuse if plan.dev_reuse_layout == ("pack",) else None
+    dev_c, miss_idx = _reuse_scan(reuse, c_leaves)
     packed = _pack_tree_to_device([c_leaves[i] for i in miss_idx] + t_leaves)
     for pos, i in enumerate(miss_idx):
         dev_c[i] = packed[pos]
@@ -3499,20 +3758,49 @@ def _plan_shard_specs(plan: "_SegmentPlan", transient, mesh):
     return (node_spec, pods_spec, extra_spec, aux_spec), (ev_spec, state_spec)
 
 
-def _shard_plan_buffers(plan: "_SegmentPlan", transient, mesh):
-    """The tp>1 mirror of ``_pack_plan_buffers``: the same id-keyed
+def _fleet_shard_specs(plan: "_SegmentPlan", transient, mesh):
+    """Spec trees for a FLEET dispatch on a (dp, tp) mesh (round 19):
+    constants and event streams take the solo tp specs — on a 2-D mesh
+    a ``P(TP, ...)`` spec replicates over dp automatically, so every
+    lane's row of chips reads the same node-sharded tables — while the
+    lane-STACKED carry (``transient[1]``, leading axis S) lays lanes
+    over dp and, for the ``_NODE_STATE_KEYS`` tensors, the node axis
+    (axis 1) over tp.  Structure-identical to the transient tree so the
+    flattened leaves zip, like ``_plan_shard_specs``."""
+    from ksim_tpu.engine import sharding
+
+    ev, st_s = transient
+    c_spec, (ev_spec, _solo_state_spec) = _plan_shard_specs(
+        plan, (ev, plan.state0), mesh
+    )
+    state_spec = {
+        k: sharding.lane_node_sharding(mesh, np.ndim(v))
+        if k in _NODE_STATE_KEYS
+        else sharding.lane_sharding(mesh, np.ndim(v))
+        for k, v in st_s.items()
+    }
+    return c_spec, (ev_spec, state_spec)
+
+
+def _shard_plan_buffers(plan: "_SegmentPlan", transient, mesh, *, specs=None):
+    """The mesh mirror of ``_pack_plan_buffers``: the same id-keyed
     constant-buffer reuse protocol, but every transferred leaf goes up
     COMMITTED to its NamedSharding (one batched ``jax.device_put`` over
     the miss + transient leaves — jit then respects the input layouts
     without in_shardings and GSPMD propagates them through the scan).
-    Reuse hits return buffers already laid out for this mesh width: the
-    driver only attaches a reuse map whose recorded tp matches the
-    plan's (prepare_segment), so a tp change re-shards everything while
-    an unchanged-universe redispatch re-shards only changed host arrays.
+    Reuse hits return buffers already laid out for THIS mesh: the
+    layout token (``("mesh", dp, tp)``) rides with the reuse map and a
+    mismatch misses wholesale (a mesh change re-shards everything)
+    while an unchanged-universe redispatch re-shards only changed host
+    arrays.  ``specs`` overrides the solo spec trees — the fleet passes
+    ``_fleet_shard_specs`` so its lane-stacked carry lays lanes over dp
+    and node axes over tp.
 
     Returns ``(const_dev, transient_dev)`` exactly like the packed
     path."""
-    c_spec, t_spec = _plan_shard_specs(plan, transient, mesh)
+    c_spec, t_spec = (
+        specs if specs is not None else _plan_shard_specs(plan, transient, mesh)
+    )
     cacheable = _plan_const_parts(plan)
     c_leaves, c_def = jax.tree_util.tree_flatten(cacheable)
     cs_leaves = jax.tree_util.tree_leaves(c_spec)
@@ -3534,15 +3822,9 @@ def _shard_plan_buffers(plan: "_SegmentPlan", transient, mesh):
             if not x64 and a.dtype.itemsize == 8 and a.dtype.kind in "iuf":
                 a = a.astype(np.dtype(f"{a.dtype.kind}4"))
         return a
-    reuse = plan.dev_reuse
-    dev_c: list[Any] = [None] * len(c_leaves)
-    miss_idx: list[int] = []
-    for i, a in enumerate(c_leaves):
-        ent = reuse.get(id(a)) if reuse else None
-        if ent is not None and ent[0] is a:
-            dev_c[i] = ent[1]
-        else:
-            miss_idx.append(i)
+    plan.dev_layout = ("mesh",) + tuple(int(d) for d in mesh.devices.shape)
+    reuse = plan.dev_reuse if plan.dev_reuse_layout == plan.dev_layout else None
+    dev_c, miss_idx = _reuse_scan(reuse, c_leaves)
     put = jax.device_put(
         [_canon(c_leaves[i]) for i in miss_idx] + [_canon(a) for a in t_leaves],
         [cs_leaves[i] for i in miss_idx] + ts_leaves,
@@ -3572,10 +3854,12 @@ def _fleet_exec(plan: "_SegmentPlan", lanes_state0, mesh=None):
     event streams transfer once and broadcast across lanes
     (``_fleet_segment_fn`` closes over them — see its docstring for why
     broadcasting ``ev`` is load-bearing under vmap).  With ``mesh`` (a
-    ``KSIM_FLEET_DP`` dp-mesh), the lane axis is laid over the mesh's
-    ``dp`` axis instead — lanes spread across devices, constants and
-    events replicated — and the id-keyed device-buffer reuse map is
-    bypassed (it holds single-device buffers).
+    ``(dp, tp)`` fleet mesh), every leaf goes up COMMITTED to its
+    NamedSharding via the sharded packer: lanes lay over ``dp``, node
+    tensors over ``tp`` (round 19 — ``_fleet_shard_specs``), and the
+    id-keyed device-buffer reuse map applies exactly as on the solo
+    path (layout-token gated), so steady-state segments re-transfer
+    only the event streams and the carry.
 
     Returns ``(pulled_state, pulled)`` exactly as a solo dispatch would,
     with a leading lane axis on every leaf; the caller decodes each
@@ -3587,20 +3871,20 @@ def _fleet_exec(plan: "_SegmentPlan", lanes_state0, mesh=None):
     FAULTS.check("replay.dispatch")
     st_s = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *lanes_state0)
     if mesh is not None:
-        from ksim_tpu.engine import sharding
-
-        cacheable = _plan_const_parts(plan)
-        const_dev = _const_dev_dict(sharding.replicate_tree(cacheable, mesh))
-        ev_dev = sharding.replicate_tree(plan.ev, mesh)
-        state_dev = sharding.shard_lane_axis(st_s, mesh)
-        plan.dev_hits = 0
-        plan.dev_misses = len(jax.tree_util.tree_leaves(cacheable))
-        plan.dev_map_out = None
+        const_dev, (ev_dev, state_dev) = _shard_plan_buffers(
+            plan,
+            (plan.ev, st_s),
+            mesh,
+            specs=_fleet_shard_specs(plan, (plan.ev, st_s), mesh),
+        )
     else:
         const_dev, (ev_dev, state_dev) = _pack_plan_buffers(plan, (plan.ev, st_s))
+    # Mesh cohorts take the non-donating twin (_DONATE_ARGNUMS note:
+    # donated multi-device carries race on virtual CPU devices).
+    fleet_fn = _fleet_segment_fn if mesh is None else _fleet_segment_fn_nodonate
     final_state, outs = COMPILE_CACHE.run(
-        _compile_cache_key("fleet", plan, (const_dev, ev_dev, state_dev)),
-        lambda: _fleet_segment_fn(
+        _compile_cache_key("fleet", plan, (const_dev, ev_dev, state_dev), mesh=mesh),
+        lambda: fleet_fn(
             plan.statics, plan.prog, const_dev, ev_dev, state_dev
         ),
         owner=TRACE.scope_tags().get("job"),
@@ -3652,6 +3936,17 @@ class _SegmentPlan:
     dev_map_out: "dict | None" = None
     dev_hits: int = 0
     dev_misses: int = 0
+    # Round 19: device-buffer LAYOUT tokens — ``dev_reuse_layout`` is
+    # the token the attached reuse map's buffers were committed under
+    # (("pack",) for the single-device packed transfer, ("mesh", dp, tp)
+    # for a sharded one); ``dev_layout`` is the token this dispatch's
+    # executor actually used (adopted by note_dispatch_healthy).  The
+    # executors compare tokens at USE-SITE and silently miss on a
+    # mismatch: prepare_segment cannot know whether the plan will be
+    # dispatched solo or on the fleet's (dp, tp) mesh, and reusing a
+    # buffer laid out for a different device set corrupts the program.
+    dev_reuse_layout: Any = None
+    dev_layout: Any = None
     # Round 17: the EXPLICIT service shard_mesh this plan was lowered
     # for (None for env-knob sharding — _device_exec builds that mesh
     # lazily on the worker — and for tp=1 plans).

@@ -67,17 +67,21 @@ def shard_node_state(state: NodeStateView, mesh: Mesh) -> NodeStateView:
     )
 
 
-def fleet_mesh(dp: int) -> Mesh:
-    """A pure data-parallel mesh for fleet replay (``KSIM_FLEET_DP``):
-    the stacked trajectory (lane) axis lays over ``dp`` devices, tp=1 —
-    each lane's segment scan runs whole on one device, GSPMD only splits
-    the lane axis.  Raises if the host has fewer than ``dp`` devices."""
+def fleet_mesh(dp: int, tp: int = 1) -> Mesh:
+    """The fleet replay mesh (``KSIM_FLEET_DP``): the stacked trajectory
+    (lane) axis lays over ``dp`` devices.  With ``tp == 1`` (the round-12
+    fleet) each lane's segment scan runs whole on one device and GSPMD
+    only splits the lane axis; with ``tp > 1`` (the round-19 2-D fleet)
+    each lane's ``[N]``/``[N, R]`` node tensors additionally shard over
+    ``tp`` chips — ``dp * tp`` devices total, lanes on mesh rows, node
+    shards on mesh columns.  Raises if the host has too few devices."""
     devices = jax.devices()
-    if len(devices) < dp:
+    if len(devices) < dp * tp:
         raise ValueError(
-            f"KSIM_FLEET_DP={dp} but only {len(devices)} device(s) present"
+            f"fleet mesh dp={dp} x tp={tp} needs {dp * tp} device(s) "
+            f"but only {len(devices)} present"
         )
-    return Mesh(np.asarray(devices[:dp]).reshape(dp, 1), (DP, TP))
+    return Mesh(np.asarray(devices[: dp * tp]).reshape(dp, tp), (DP, TP))
 
 
 def shard_lane_axis(tree, mesh: Mesh):
@@ -101,6 +105,25 @@ def replicate_tree(tree, mesh: Mesh):
         return jax.device_put(a, NamedSharding(mesh, P(*([None] * a.ndim))))
 
     return jax.tree_util.tree_map(put, tree)
+
+
+def lane_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Sharding for a lane-stacked fleet leaf whose trailing axes stay
+    whole per lane (pod-axis queue state, scalars): leading (lane) axis
+    over ``dp``, the rest replicated."""
+    if ndim == 0:
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(DP, *([None] * (ndim - 1))))
+
+
+def lane_node_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Sharding for a lane-stacked ``[S, N, ...]`` node tensor on a 2-D
+    fleet mesh: lanes over ``dp``, the node axis (axis 1) over ``tp`` —
+    the round-19 composition of the fleet lane split with the round-17
+    node split."""
+    if ndim < 2:
+        return lane_sharding(mesh, ndim)
+    return NamedSharding(mesh, P(DP, TP, *([None] * (ndim - 2))))
 
 
 def node_leading_sharding(mesh: Mesh, ndim: int) -> NamedSharding:

@@ -90,6 +90,7 @@ durability: ``KSIM_JOBS_DIR``, ``KSIM_JOBS_RESUME``,
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -212,6 +213,22 @@ def _tenant_trace_resolver(trace_doc: dict) -> str:
             "reference a trace registered in KSIM_TRACES_DIR by name"
         )
     return default_trace_resolver(trace_doc)
+
+
+def _spec_hash(sim: dict) -> str:
+    """Canonical content hash of a job's simulator spec (round 19; the
+    doc half shipped in round 17 — docs/jobs.md "Resume across a config
+    change").  Checkpoint records carry it so ``_restore_checkpoint``
+    can REFUSE a restore whose spec no longer matches the resubmitted
+    job: the rebuilt SchedulerService would silently diverge from the
+    carries the old config produced.  Sorted-key compact JSON makes the
+    hash independent of dict ordering; the 16-hex truncation (64 bits)
+    is plenty for an equality check that only ever compares a job
+    against its own history."""
+    blob = json.dumps(
+        sim or {}, sort_keys=True, separators=(",", ":"), default=str
+    )
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
 
 
 def _parse_job_spec(doc: Any) -> tuple[list, dict, int, str]:
@@ -1299,6 +1316,10 @@ class JobManager:
                     "seq": seq,
                     "cursor": int(cursor),
                     "segment": int(driver.segment_seq),
+                    # Restore-time identity check (round 19): a resume
+                    # whose simulator spec changed must NOT consume
+                    # this record (see _spec_hash / _restore_checkpoint).
+                    "spec": _spec_hash(job.sim),
                     "store": store.checkpoint(),
                     "service": carries,
                     "result": {
@@ -1358,8 +1379,26 @@ class JobManager:
         from ksim_tpu.scheduler.service import SchedulerService
         from ksim_tpu.state.cluster import ClusterStore
 
+        want = _spec_hash(sim)
         for rec in reversed(job.checkpoints):
             seg = rec.get("segment")
+            got = rec.get("spec")
+            if got is not None and got != want:
+                # Round 19 (the code half of "Resume across a config
+                # change", docs/jobs.md): the checkpoint was cut under a
+                # DIFFERENT simulator spec — restoring its carries into
+                # a service built from the new config would silently
+                # diverge, so the record is refused (counted, loud) and
+                # the scan falls through to older records; when every
+                # checkpoint predates the change the job replays from
+                # scratch — the correct-but-slow outcome the doc
+                # promises.  Records without a "spec" field (pre-round-
+                # 19 journals) restore as before.
+                TRACE.event(
+                    "jobs.checkpoint_restore", job=job.id, restored=False,
+                    segment=seg, reason="spec_hash",
+                )
+                continue
             try:
                 with TRACE.span(
                     "jobs.checkpoint_restore", job=job.id, segment=seg

@@ -130,7 +130,12 @@ class SchedulerService:
         # (make_mesh(n, dp=1)) for the scheduling path.  The device
         # churn replay honors the same mesh (round 17): a dp=1 mesh
         # with a tp axis shards the segment scan's node tensors; any
-        # other shape is a "shard_mesh" per-pass fallback.
+        # other shape is a "shard_mesh" per-pass fallback.  On a fleet
+        # lane (round 19) the mesh declares the node-shard WIDTH only:
+        # the group dispatch composes that tp with KSIM_FLEET_DP on its
+        # own (dp, tp) fleet mesh — lanes over dp, node shards over tp
+        # (engine/replay.py service_supported, engine/fleet.py
+        # _worker_mesh).
         self._shard_mesh = shard_mesh
         # builderImport in runtime-applied configs (HTTP / snapshot load)
         # executes arbitrary imports; off unless the operator opts in.

@@ -380,6 +380,70 @@ def test_churn_lock_6k_sharded_tp8(monkeypatch):
     assert all("full_bytes_per_shard" in e for e in d.lower_log)
 
 
+# ---------------------------------------------------------------------------
+# Round 19: the locked counts through the 2-D (tp x dp) fleet mesh
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_churn_fleet_lock_6k_tp4_dp2(monkeypatch):
+    """The flagship locked prefix through the round-19 2-D fleet mesh
+    (`make mesh-check`): 2 lanes laid over dp composed with tp=4 node
+    sharding on the 8 virtual devices — every lane 2524/471 stepwise-
+    identical to the SOLO unsharded device run, the whole fleet
+    on-device, the (2, 4) grid built, and every fleet segment lowered
+    at the declared width.  This is the composition claim: GSPMD
+    value-preservation (round 17) and lane-independence (round 12)
+    hold SIMULTANEOUSLY, with the cond-gated preemption search in the
+    lowered program.  (Mesh dispatches run the NON-donating twin —
+    donated multi-device carries race on the virtual-device CPU
+    backend; see replay.py's _DONATE_ARGNUMS note.)"""
+    jax.config.update("jax_enable_x64", False)
+    kw = dict(max_pods_per_pass=1024, pod_bucket_min=128, preemption=True)
+
+    def stream():
+        return churn_scenario(0, n_nodes=2000, n_events=6000, ops_per_step=100)
+
+    monkeypatch.delenv("KSIM_REPLAY_TP", raising=False)
+    monkeypatch.delenv("KSIM_FLEET_DP", raising=False)
+    solo_r = ScenarioRunner(device_replay=True, **kw)
+    solo = solo_r.run(stream())
+    assert (solo.pods_scheduled, solo.unschedulable_attempts) == (
+        LOCK_SCHEDULED,
+        LOCK_UNSCHEDULABLE,
+    )
+    solo_sig = [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in solo.steps
+    ]
+    monkeypatch.setenv("KSIM_FLEET_DP", "2")
+    monkeypatch.setenv("KSIM_REPLAY_TP", "4")
+    fleet_r = ScenarioRunner(device_replay=True, fleet=2, **kw)
+    agg = fleet_r.run(stream())
+    assert agg.pods_scheduled == 2 * LOCK_SCHEDULED
+    assert agg.unschedulable_attempts == 2 * LOCK_UNSCHEDULABLE
+    for ln in fleet_r.fleet_lanes:
+        r = ln.result
+        assert (r.pods_scheduled, r.unschedulable_attempts) == (
+            LOCK_SCHEDULED,
+            LOCK_UNSCHEDULABLE,
+        ), f"lane {ln.idx}"
+        sig = [
+            (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in r.steps
+        ]
+        assert sig == solo_sig, f"lane {ln.idx} stepwise divergence"
+        assert ln.convergent
+        assert ln.driver.fallback_steps == 0, ln.driver.unsupported
+    fd = fleet_r.fleet_driver
+    stats = fd.stats()
+    assert stats["cohort_mode"] == "vmap"
+    assert stats["lanes_on_device"] == 1.0, stats
+    with fd._mesh_lock:
+        assert not fd._mesh_failed
+        assert (2, 4) in fd._mesh, fd._mesh
+    tps = sorted({e["tp"] for ln in fleet_r.fleet_lanes for e in ln.driver.lower_log})
+    assert tps == [4], tps
+
+
 @pytest.mark.slow
 def test_churn_lock_50k_stepwise_sharded_tp8(monkeypatch):
     """The FULL 50k flagship stream under the tp=8 mesh: 52781/42829,

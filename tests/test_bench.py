@@ -564,3 +564,74 @@ def test_bench_churn_shard_child_survives_dead_device(tmp_path):
     for mode in rec["modes"].values():
         assert mode["device_steps"] == 0
         assert mode["unsupported"].get("device_error", 0) >= 1
+
+
+def test_bench_churn_fleet_shard_child_records_mesh_evidence(tmp_path):
+    """Round 19: the churn_fleet_shard child runs the solo device
+    replay and the 2-lane tp=4 fleet of the SAME stream in one process
+    and its record carries the 2-D mesh acceptance evidence — per-lane
+    counts matching solo, the (2, 4) grid actually built, every fleet
+    segment lowered at the declared width, the per-shard byte budget,
+    and the leader's dev_const counters with hits (the committed fleet
+    layout was adopted and steady-state windows re-transferred
+    nothing)."""
+    out = tmp_path / "fleet_shard.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_fleet_shard", "--out", str(out),
+            "--seed", "0", "--churn-events", "1200", "--churn-nodes", "64",
+            "--fleet-lanes", "2", "--shard-tp", "4",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=sanitized_cpu_env(),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["lanes"] == 2 and rec["tp"] == 4
+    assert rec["counts_match"] is True
+    assert rec["mesh_failed"] is False
+    assert rec["mesh_grids"] == [[2, 4]]
+    assert rec["lowered_tps"] == [4]
+    assert rec["full_bytes_per_shard_max"] > 0
+    assert rec["aggregate_speedup"] > 0
+    assert rec["fleet"]["lanes_on_device"] == 1.0
+    assert rec["fleet"]["group_dispatches"] >= 1
+    # Zero-resharding engagement: at least one steady-state window hit
+    # the id-keyed reuse map under the ("mesh", 2, 4) layout token.
+    assert rec["dev_const"]["hits"] > 0, rec["dev_const"]
+
+
+@pytest.mark.slow
+def test_bench_churn_fleet_shard_child_survives_dead_device(tmp_path):
+    """One-JSON-line-under-any-hardware, 2-D mesh edition: with every
+    dispatch failing, both legs degrade to the per-pass host path, the
+    lane counts still match solo, and the record still exists."""
+    out = tmp_path / "fleet_shard_dead.json"
+    env = sanitized_cpu_env(
+        {
+            "KSIM_FAULTS": "replay.dispatch=always@device",
+            "KSIM_REPLAY_BREAKER_N": "2",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_fleet_shard", "--out", str(out),
+            "--seed", "0", "--churn-events", "300", "--churn-nodes", "64",
+            "--fleet-lanes", "2", "--shard-tp", "4",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["counts_match"] is True  # the host path carried all lanes
+    assert rec["fleet"]["lanes_on_device"] == 0.0
+    assert all(s == 0 for s in rec["fleet"]["lane_device_steps"])

@@ -688,6 +688,43 @@ def test_restore_fault_falls_back_to_scratch(tmp_path):
     jm.shutdown()
 
 
+def test_resume_across_spec_change_refuses_checkpoints(tmp_path):
+    """The code half of "Resume across a config change" (docs/jobs.md;
+    the doc half shipped in round 17): every checkpoint record carries
+    the simulator-spec hash, and a resumed job whose spec CHANGED
+    refuses the mismatched records — replaying from scratch under the
+    new config instead of silently installing carries the old config
+    produced.  The refusal must be total (resumed_from None) even
+    though structurally valid checkpoints sit right there in the
+    journal."""
+    jid, full = _run_checkpointed(tmp_path, churn_device_doc())
+    recs = JobJournal(os.path.join(str(tmp_path), JOURNAL_NAME)).replay()
+    cks = [r for r in recs if r["t"] == "checkpoint"]
+    assert cks and all(r.get("spec") for r in cks)
+    assert len({r["spec"] for r in cks}) == 1  # one spec, one hash
+    last_ck = max(i for i, r in enumerate(recs) if r["t"] == "checkpoint")
+    keep = recs[: last_ck + 1]
+    for i, r in enumerate(keep):
+        if r["t"] == "submit":
+            doc = json.loads(json.dumps(r["doc"]))
+            # The config change: a knob that reshapes the pod batching
+            # but not the locked counts.
+            doc["spec"]["simulator"]["podBucketMin"] = 128
+            keep[i] = dict(r, doc=doc)
+    _rewrite_journal(tmp_path, keep)
+    jm = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        resume=True, checkpoint_every=0,
+    )
+    job = jm.get(jid)
+    final = _wait(job, {"succeeded", "failed", "interrupted"}, 300.0)
+    assert final["state"] == "succeeded", final
+    _, res, _ = job.result_view()
+    assert "resume" not in res and final["resumed_from"] is None
+    assert _locked_counts(res) == _locked_counts(full)
+    jm.shutdown()
+
+
 def test_checkpoint_append_fault_never_fails_the_job(tmp_path):
     """The best-effort contract: an armed jobs.checkpoint_append (or
     any snapshot failure) skips checkpoints with a counted event; the

@@ -420,9 +420,10 @@ def test_full_tree_has_zero_unsuppressed_findings():
     # The suppressions that exist are the documented, justified ones;
     # a new suppression should be a conscious reviewable event, so pin
     # the count: two round-11 lock-discipline snapshots, the fleet
-    # driver's two deliberate on-worker mesh stores, and the waived
-    # construction-time JobManager._recover journal edge.
-    assert len(findings) - len(open_) == 5, [f.format() for f in findings if f.suppressed]
+    # driver's deliberate on-worker mesh-failure store (round 19's
+    # _mesh_lock rework left one flagged write where round 18 had two),
+    # and the waived construction-time JobManager._recover journal edge.
+    assert len(findings) - len(open_) == 4, [f.format() for f in findings if f.suppressed]
 
 
 def test_cli_human_and_json(tmp_path, capsys):

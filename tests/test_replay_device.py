@@ -628,7 +628,7 @@ def test_fleet_dp_mesh_lanes_match_single_device(monkeypatch):
     fd = fleet_r.fleet_driver
     assert fd.dp == 2
     with fd._mesh_lock:
-        assert fd._mesh is not None and not fd._mesh_failed
+        assert fd._mesh and not fd._mesh_failed  # (dp, tp)-keyed, round 19
     assert fd.stats()["lanes_on_device"] == 1.0
     for ln in fleet_r.fleet_lanes:
         assert _steps_sig(ln.result) == _steps_sig(solo), f"lane {ln.idx}"
@@ -664,6 +664,130 @@ def test_fleet_vmap_cohort_tiny_stream(monkeypatch):
     assert fleet_r.fleet_driver.stats()["lanes_on_device"] == 1.0
     for ln in fleet_r.fleet_lanes:
         assert _steps_sig(ln.result) == _steps_sig(solo), f"lane {ln.idx}"
+
+
+# ---------------------------------------------------------------------------
+# Round 19: 2-D (tp x dp) fleet mesh + donated scan carries
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_tp_dp_mesh_lanes_match_single_device(monkeypatch):
+    """KSIM_FLEET_DP=2 composed with KSIM_REPLAY_TP=4 over the
+    conftest's 8 virtual devices (the round-19 2-D fleet): lanes lay
+    over dp, every lane's [N]/[N, R] node tensors shard over tp, and
+    each lane's outcome stays byte-identical to the solo unsharded
+    single-device run.  16 nodes keeps every shard at the
+    _MIN_SHARD_NODES floor (16 // 4 = 4) so the width is honored, not
+    narrowed."""
+
+    def stream():
+        for i in range(16):
+            yield Operation(
+                step=0, op="create", kind="nodes",
+                obj=make_node(f"n-{i}", cpu="4", memory="8Gi"),
+            )
+        for step in range(1, 9):
+            yield Operation(
+                step=step, op="create", kind="pods",
+                obj=make_pod(f"p-{step}", cpu="500m", memory="512Mi"),
+            )
+
+    jax.config.update("jax_enable_x64", False)
+    monkeypatch.delenv("KSIM_REPLAY_TP", raising=False)
+    solo_r = ScenarioRunner(device_replay=True, device_segment_steps=4)
+    solo = solo_r.run(stream())
+    assert solo_r.replay_driver.device_steps == 9
+    monkeypatch.setenv("KSIM_FLEET_DP", "2")
+    monkeypatch.setenv("KSIM_REPLAY_TP", "4")
+    fleet_r = ScenarioRunner(device_replay=True, device_segment_steps=4, fleet=2)
+    fleet_r.run(stream())
+    fd = fleet_r.fleet_driver
+    assert fd.stats()["cohort_mode"] == "vmap"
+    assert fd.stats()["lanes_on_device"] == 1.0
+    with fd._mesh_lock:
+        assert not fd._mesh_failed
+        assert (2, 4) in fd._mesh, fd._mesh  # the (dp, tp) grid was built
+    for ln in fleet_r.fleet_lanes:
+        assert _steps_sig(ln.result) == _steps_sig(solo), f"lane {ln.idx}"
+    # The cohort leader lowers each window once for every lane; all of
+    # its segment programs must carry the declared tp=4 node width.
+    tps = sorted({e["tp"] for ln in fleet_r.fleet_lanes for e in ln.driver.lower_log})
+    assert tps == [4], tps
+
+
+def test_replay_donation_engages_and_stays_byte_identical():
+    """The segment programs donate the scan carry (KSIM_REPLAY_DONATE
+    default-on, engine/replay.py _DONATE_ARGNUMS): a donated dispatch
+    must raise no jax donation warnings on CPU — XLA either consumed
+    the buffers or would warn "Some donated buffers were not usable" —
+    and the donated path's per-step outcomes stay byte-identical to
+    the per-pass oracle on a preemption-bearing churn stream (the 6k
+    lock's in-suite prefix runs through this same donated program;
+    tests/test_behavior_locks.py pins its counts)."""
+    import warnings
+
+    from ksim_tpu.engine import replay as rmod
+
+    assert rmod._REPLAY_DONATE and rmod._DONATE_ARGNUMS == (4,)
+    jax.config.update("jax_enable_x64", False)
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "error", message=".*[Dd]onated buffers.*"
+        )
+        base = ScenarioRunner().run(
+            churn_scenario(0, n_nodes=48, n_events=200, ops_per_step=20)
+        )
+        dev_r = ScenarioRunner(device_replay=True, device_segment_steps=8)
+        dev = dev_r.run(
+            churn_scenario(0, n_nodes=48, n_events=200, ops_per_step=20)
+        )
+    assert dev_r.replay_driver.device_steps > 0
+    assert _steps_sig(dev) == _steps_sig(base)
+
+
+def test_pull_tree_to_host_returns_owned_arrays():
+    """Every leaf leaving _pull_tree_to_host must OWN its memory.
+    np.asarray of a CPU-backend jax result is zero-copy where the
+    layout allows (single-device outputs view the result buffer; a
+    replicated multi-device output views shard 0), and with the carry
+    donated (round 19) XLA recycles execution memory — a retained view
+    decodes garbage once the buffer is reused.  The fleet tp*dp replay
+    diverged nondeterministically through exactly this hole; this pins
+    the _owned_host contract on both pull branches."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ksim_tpu.engine.core import _pull_tree_to_host
+    from ksim_tpu.engine.sharding import make_mesh
+
+    jax.config.update("jax_enable_x64", False)
+
+    def owned(h):
+        return isinstance(h, np.ndarray) and (
+            h.flags["OWNDATA"] or isinstance(h.base, np.ndarray)
+            and h.base.flags["OWNDATA"]
+        )
+
+    # Packed branch: >= 2 single-device array leaves.
+    f = jax.jit(lambda t: jax.tree_util.tree_map(lambda x: x * 2, t))
+    tree = f({"a": jnp.arange(64, dtype=jnp.float32),
+              "b": jnp.ones((8, 8), jnp.int32)})
+    out = _pull_tree_to_host(tree)
+    for k, h in out.items():
+        assert owned(h), f"packed-branch leaf {k} is a device view"
+    # Fallback branch: multi-device leaves (replicated is the zero-copy
+    # trap; sharded gathers).  Needs the 8 virtual CPU devices conftest
+    # forces.
+    mesh = make_mesh(4, dp=2)
+    repl = jax.device_put(np.arange(16, dtype=np.float32),
+                          NamedSharding(mesh, P()))
+    shrd = jax.device_put(np.arange(16, dtype=np.float32),
+                          NamedSharding(mesh, P("tp")))
+    out2 = _pull_tree_to_host({"r": repl, "s": shrd})
+    for k, h in out2.items():
+        assert owned(h), f"fallback-branch leaf {k} is a device view"
 
 
 # ---------------------------------------------------------------------------

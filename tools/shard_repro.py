@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Standalone repro for the sharded preemption-scan partial-sum
+miscompile behind ``_MIN_SHARD_NODES`` (ksim_tpu/engine/replay.py).
+
+Pure jax + numpy — NO ksim imports — so the program can be filed
+upstream as-is.  It distills the segment kernel's victim-search scan
+to its partitioner-relevant skeleton:
+
+- a dict carry of ``[N]`` node tensors laid over a 1-D ``tp`` mesh
+  axis via committed ``NamedSharding`` inputs (no ``in_shardings``),
+- a ``lax.scan`` over pods whose step runs scatter-counted candidate
+  discovery, ``top_k`` over node rank keys, and a ``fori_loop``
+  lexicographic-min cascade over candidates,
+- per-step ``nom``/``sel`` index outputs the scan stacks to ``[q]``
+  and ``[q, K]``.
+
+Observed failure mode (docs/churn_floor.md "Sharded replay"): at
+shard width ``N // tp < 4`` the partitioner propagates a
+``P(None, 'tp')`` sharding onto the POD axis of the stacked outputs
+and emits them as per-replica partial sums that no all-reduce folds —
+every index value comes back exactly DOUBLED (-1 as -2, node 2 as 4).
+N=16 is clean at every width; isolated ``top_k``/``argmin`` never
+reproduce it — the scan + scatter + committed-input combination is
+load-bearing.
+
+Usage::
+
+    python tools/shard_repro.py               # N=8 tp=4: the hazard
+    python tools/shard_repro.py --nodes 16    # control: clean
+    python tools/shard_repro.py --matrix      # documented sweep
+
+Exit status: 0 when sharded == solo (no bug on this jax build),
+2 on mismatch (bug reproduced) — so CI can pin either expectation.
+
+Status: on CPU jax 0.4.37 (the lock platform) the distilled skeleton
+is CLEAN at every width — the doubling was observed through the full
+segment kernel, so the trigger involves program scale the skeleton
+does not reach.  That is exactly why ``_MIN_SHARD_NODES`` stays an
+empirical floor pinned by the in-kernel observation rather than a
+bound derived from this repro; when filing upstream, attach this
+script (the structural skeleton reviewers can read) PLUS the HLO dump
+of an affected full-kernel lower (``XLA_FLAGS=--xla_dump_to=...``
+around a width-2 run with the floor guard lifted).
+"""
+
+import argparse
+import os
+import sys
+
+# The repro needs `tp` XLA devices; on a CPU-only host fake them the
+# same way the ksim test suite does, BEFORE jax initializes.
+_WANT_DEVS = 8
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_WANT_DEVS}"
+    ).strip()
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+I32_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+def build_problem(n_nodes, n_pods, seed):
+    """Deterministic host-side fixture: pods bound to nodes with mixed
+    priorities, plus per-node rank keys (the live name order)."""
+    rng = np.random.RandomState(seed)
+    return {
+        "valid": np.ones(n_nodes, bool),
+        "rank": rng.permutation(n_nodes).astype(np.int32),
+        "requested": rng.uniform(1.0, 4.0, n_nodes).astype(np.float32),
+        "bound": rng.randint(0, n_nodes, n_pods).astype(np.int32),
+        "alive": np.ones(n_pods, bool),
+        "prio": rng.randint(0, 5, n_pods).astype(np.int32),
+        "imp_rank": rng.permutation(n_pods).astype(np.int32),
+        "req": rng.uniform(0.1, 1.0, n_pods).astype(np.float32),
+    }
+
+
+def segment(node, pod, c_max, v_max):
+    """The scan: each pod searches for a preemption target against the
+    LIVE carry, binds there, and reports (nom, sel victim rows)."""
+    N = node["valid"].shape[0]
+    Pn = pod["bound"].shape[0]
+
+    def step(live, j):
+        prio_j = pod["prio"][j]
+        lower = live["alive"] & (live["bound"] >= 0) & (pod["prio"] < prio_j)
+        tgtn = jnp.where(lower, live["bound"], N)
+        vcnt = jnp.zeros(N, jnp.int32).at[tgtn].add(1, mode="drop")
+        examine = (vcnt > 0) & node["valid"]
+        keyed = jnp.where(examine, node["rank"], I32_MAX)
+        negk, cand = jax.lax.top_k(-keyed, c_max)
+        cand_act = negk > -I32_MAX
+
+        def cand_body(i, acc):
+            best_key, best_node, best_vic = acc
+            n_i = cand[i]
+            on_n = lower & (live["bound"] == n_i)
+            kv = jnp.where(on_n, pod["imp_rank"], I32_MAX)
+            negv, vrows = jax.lax.top_k(-kv, v_max)
+            vact = negv > -I32_MAX
+            vprio = jnp.where(vact, pod["prio"][vrows], -1)
+            key = (
+                jnp.max(vprio) * 10000
+                + jnp.sum(jnp.where(vact, pod["prio"][vrows], 0)) * 100
+                + jnp.sum(vact.astype(jnp.int32))
+            )
+            better = cand_act[i] & (key < best_key)
+            return (
+                jnp.where(better, key, best_key),
+                jnp.where(better, n_i, best_node),
+                jnp.where(better[None], jnp.where(vact, vrows, -1), best_vic),
+            )
+
+        best_key, best_node, best_vic = jax.lax.fori_loop(
+            0,
+            c_max,
+            cand_body,
+            (jnp.int32(I32_MAX), jnp.int32(-1), jnp.full(v_max, -1, jnp.int32)),
+        )
+        hit = best_node >= 0
+        evict = hit & (live["bound"] == best_node) & lower
+        live = {
+            "alive": live["alive"] & ~evict,
+            "bound": jnp.where(evict, -1, live["bound"]).at[j].set(
+                jnp.where(hit, best_node, live["bound"][j])
+            ),
+            "requested": live["requested"].at[
+                jnp.where(hit, best_node, N)
+            ].add(pod["req"][j], mode="drop"),
+        }
+        return live, {"nom": best_node, "sel": best_vic}
+
+    live0 = {
+        "alive": pod["alive"],
+        "bound": pod["bound"],
+        "requested": node["requested"],
+    }
+    _live, outs = jax.lax.scan(step, live0, jnp.arange(Pn))
+    return outs
+
+
+def run(n_nodes, n_pods, tp, seed, c_max=4, v_max=4):
+    """Run solo and tp-sharded; return (nom/sel pairs, match)."""
+    prob = build_problem(n_nodes, n_pods, seed)
+    node = {k: prob[k] for k in ("valid", "rank", "requested")}
+    pod = {k: prob[k] for k in ("bound", "alive", "prio", "imp_rank", "req")}
+    fn = jax.jit(segment, static_argnums=(2, 3))
+
+    solo = jax.tree_util.tree_map(
+        np.asarray, fn(node, pod, c_max, v_max)
+    )
+
+    devs = jax.devices()
+    if len(devs) < tp:
+        raise SystemExit(f"need {tp} devices, have {len(devs)}")
+    mesh = Mesh(np.asarray(devs[:tp]), ("tp",))
+    node_s = {
+        k: jax.device_put(v, NamedSharding(mesh, P("tp")))
+        for k, v in node.items()
+    }
+    pod_s = {
+        k: jax.device_put(v, NamedSharding(mesh, P()))
+        for k, v in pod.items()
+    }
+    shard = jax.tree_util.tree_map(
+        np.asarray, fn(node_s, pod_s, c_max, v_max)
+    )
+    match = all(
+        np.array_equal(solo[k], shard[k]) for k in ("nom", "sel")
+    )
+    return solo, shard, match
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nodes", type=int, default=8)
+    ap.add_argument("--pods", type=int, default=12)
+    ap.add_argument("--tp", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--matrix",
+        action="store_true",
+        help="run the documented sweep (N=8 tp=2/4/8, N=16 tp=4/8)",
+    )
+    args = ap.parse_args(argv)
+
+    print(f"jax {jax.__version__} backend={jax.default_backend()}")
+    configs = (
+        [(8, 2), (8, 4), (8, 8), (16, 4), (16, 8)]
+        if args.matrix
+        else [(args.nodes, args.tp)]
+    )
+    bad = False
+    for n, tp in configs:
+        solo, shard, ok = run(n, args.pods, tp, args.seed)
+        width = n // tp
+        print(
+            f"N={n:3d} tp={tp} width={width}: "
+            + ("MATCH" if ok else "MISMATCH (bug reproduced)")
+        )
+        if not ok:
+            bad = True
+            print(f"  solo  nom: {solo['nom']}")
+            print(f"  shard nom: {shard['nom']}")
+            print(f"  solo  sel[0]: {solo['sel'][0]}")
+            print(f"  shard sel[0]: {shard['sel'][0]}")
+    return 2 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
