@@ -83,10 +83,13 @@ jobs:
 # on restart, the SSE aborted-reader leak regression, the round-16
 # incremental-resume matrix (crash after EVERY checkpoint boundary,
 # torn/corrupt checkpoint fallback, append-fault containment, gap-free
-# recovered SSE backlogs), and the two slow SIGKILL-then-restart
-# end-to-ends — interrupted-marking and checkpoint-resume on the locked
-# 6k stream (-m '' includes them).  Runs in the sanitized CPU env so it
-# works under ANY hardware condition.
+# recovered SSE backlogs), the round-20 fleet matrix (lease claim
+# races, takeover epochs, release tombstones, shared-journal
+# interleaved appenders + cross-process compaction), and the slow
+# SIGKILL end-to-ends — interrupted-marking, checkpoint-resume, and
+# the kill-a-worker fleet fail-over, all on the locked 6k stream
+# (-m '' includes them).  Runs in the sanitized CPU env so it works
+# under ANY hardware condition.
 restart-check: lint
 	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
 	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \

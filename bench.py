@@ -785,6 +785,209 @@ def child_churn_jobs(
     return out
 
 
+def child_churn_workers(
+    seed: int, n_nodes: int, n_events: int, n_jobs: int, fleet_n: int
+) -> dict:
+    """Fleet scale-out rung (round 20, ksim_tpu/jobs/fleet.py): the
+    same multi-tenant storm — ``n_jobs`` copies of the churn stream
+    submitted through a frontdoor-role manager, tenants rotating — run
+    twice, once against ONE worker process and once against
+    ``fleet_n``, every worker a real subprocess claiming jobs by lease
+    from the shared jobs dir (``python -m ksim_tpu.jobs``).
+    Evidence the record must carry: per-leg aggregate jobs/min and
+    per-job ``runner.step`` p99 under the storm, the fleet-vs-solo
+    wall speedup, per-job counts with a ``jobs_match_solo`` flag
+    against an in-process solo replay, and the per-worker lease
+    counters (zero takeovers — nothing dies here; the kill-a-worker
+    chaos leg lives in ``make restart-check``).  Workers run on the
+    CPU backend regardless of the probe: N processes cannot share one
+    chip, and the scale-out claim is about horizontal fan-out, not
+    accelerator placement.  Each leg shares one ``KSIM_AOT_CACHE`` dir
+    across its workers with the speculative rescan armed
+    (``KSIM_AOT_PREWARM=2``), so one worker's compile is every
+    worker's warm start — the round-20 AOT story under load."""
+    import shutil
+    import subprocess
+    import tempfile
+    import time
+
+    import jax
+
+    from ksim_tpu.jobs import JobManager
+    from ksim_tpu.scenario import (
+        ScenarioRunner,
+        churn_scenario,
+        spec_from_operations,
+    )
+    from tests.helpers import sanitized_cpu_env
+
+    _child_setup()
+    jax.config.update("jax_enable_x64", False)
+    terminal = {"succeeded", "failed", "cancelled", "interrupted"}
+
+    def stream():
+        return churn_scenario(
+            seed, n_nodes=n_nodes, n_events=n_events, ops_per_step=100
+        )
+
+    doc = {
+        "spec": {
+            "simulator": {
+                "preemption": True,
+                "maxPodsPerPass": 1024,
+                "podBucketMin": 128,
+                "deviceReplay": True,
+            },
+            "scenario": spec_from_operations(list(stream())),
+        }
+    }
+    leg_deadline = max((CHURN_TIMEOUT - 180) / 2, 120)
+
+    def leg(nw: int) -> dict:
+        d = tempfile.mkdtemp(prefix=f"bench_workers_{nw}_")
+        wenv = sanitized_cpu_env({
+            "KSIM_WORKERS_POLL_S": "0.1",
+            "KSIM_WORKERS_LEASE_S": "8",
+            # Small local queues spread the storm across the fleet
+            # (a worker at capacity skips claiming — backpressure).
+            "KSIM_JOBS_QUEUE": "2",
+            "KSIM_JOBS_CHECKPOINT_EVERY": "0",
+            # One worker's compile = every worker's warm start: shared
+            # per-leg XLA disk cache + speculative AOT rescan.  Per-leg
+            # (not per-child) so the 1-worker and fleet legs stay
+            # hermetic from each other and the machine-wide cache.
+            "KSIM_COMPILE_CACHE": os.path.join(d, "xla"),
+            "KSIM_AOT_CACHE": os.path.join(d, "aot"),
+            "KSIM_AOT_PREWARM": "2",
+            "KSIM_AOT_PREWARM_RESCAN_S": "2",
+        })
+        procs: list = []
+        jm = None
+        try:
+            for i in range(nw):
+                procs.append(subprocess.Popen(
+                    [
+                        sys.executable, "-m", "ksim_tpu.jobs",
+                        "--dir", d, "--worker-id", f"w{i}", "--workers", "1",
+                    ],
+                    env=wenv, cwd=_REPO, stdout=subprocess.PIPE, text=True,
+                ))
+            for p in procs:
+                line = p.stdout.readline()
+                if not line.startswith("READY"):
+                    raise RuntimeError(f"fleet worker died at startup: {line!r}")
+            jm = JobManager(
+                workers=0, queue_limit=n_jobs + 2, jobs_dir=d,
+                role="frontdoor", worker_id="fd", lease_s=8.0, poll_s=0.1,
+            )
+            t0 = time.perf_counter()
+            jobs = [jm.submit(doc, tenant=f"t{i % 4}") for i in range(n_jobs)]
+            end = time.monotonic() + leg_deadline
+            while time.monotonic() < end:
+                if all(j.status()["state"] in terminal for j in jobs):
+                    break
+                time.sleep(0.2)
+            wall = time.perf_counter() - t0
+            per_job = []
+            job_counts = []
+            finished = 0
+            for j in jobs:
+                state, result, err = j.result_view()
+                counts = None
+                p99 = None
+                if result:
+                    counts = [
+                        result["result"]["podsScheduled"],
+                        result["result"]["unschedulableAttempts"],
+                    ]
+                    lat = result.get("latency", {})
+                    # Device-replay jobs time per-segment dispatches,
+                    # per-pass jobs time runner.step — either way it is
+                    # the per-step latency under the storm.
+                    p99 = (
+                        lat.get("replay.dispatch")
+                        or lat.get("runner.step")
+                        or {}
+                    ).get("p99_seconds")
+                if state == "succeeded":
+                    finished += 1
+                job_counts.append(counts)
+                per_job.append({
+                    "id": j.id, "state": state, "error": err,
+                    "owner": j.status()["owner"], "counts": counts,
+                    "step_p99_s": p99,
+                })
+            p99s = [pj["step_p99_s"] for pj in per_job if pj["step_p99_s"]]
+            counters = jm.snapshot().get("fleet", {}).get("workers", {})
+            return {
+                "workers": nw,
+                "finished": finished,
+                "wall_s": round(wall, 1),
+                "jobs_per_min": (
+                    round(finished / wall * 60, 2) if wall and finished else None
+                ),
+                "step_p99_max_s": max(p99s) if p99s else None,
+                "job_counts": job_counts,
+                "per_job": per_job,
+                "lease_counters": counters,
+                "takeovers": sum(
+                    c.get("takeovers", 0) for c in counters.values()
+                ),
+            }
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+            if jm is not None:
+                jm.shutdown()
+            shutil.rmtree(d, ignore_errors=True)
+
+    solo_leg = leg(1)
+    fleet_leg = leg(fleet_n)
+    # Solo baseline for the counts lock, in-process (the legs' counts
+    # must all match it regardless of which worker ran which job).
+    solo = ScenarioRunner(
+        max_pods_per_pass=1024,
+        pod_bucket_min=128,
+        device_replay=True,
+        preemption=True,
+    )
+    rs = solo.run(stream())
+    solo_counts = [rs.pods_scheduled, rs.unschedulable_attempts]
+    all_counts = solo_leg["job_counts"] + fleet_leg["job_counts"]
+    speedup = None
+    if solo_leg["wall_s"] and fleet_leg["wall_s"]:
+        if solo_leg["finished"] == fleet_leg["finished"] == n_jobs:
+            speedup = round(solo_leg["wall_s"] / fleet_leg["wall_s"], 2)
+    out = {
+        "events": n_events,
+        "nodes": n_nodes,
+        "jobs": n_jobs,
+        "fleet": fleet_n,
+        "legs": {"one_worker": solo_leg, "fleet": fleet_leg},
+        "fleet_speedup": speedup,
+        "solo_counts": solo_counts,
+        "jobs_match_solo": bool(all_counts) and all(
+            c == solo_counts for c in all_counts
+        ),
+        "platform": jax.devices()[0].platform,
+    }
+    print(
+        f"[churn_workers {n_events}ev/{n_nodes}n x{n_jobs} jobs] "
+        f"1w {solo_leg['wall_s']}s vs {fleet_n}w {fleet_leg['wall_s']}s "
+        f"(speedup {speedup}, match_solo={out['jobs_match_solo']}, "
+        f"takeovers={fleet_leg['takeovers']})",
+        file=sys.stderr,
+        flush=True,
+    )
+    return out
+
+
 def child_churn_restart(seed: int, n_nodes: int, n_events: int) -> dict:
     """Warm-restart rung (round 15, engine/compilecache.py disk layer):
     one device churn replay in THIS fresh process, with
@@ -1138,6 +1341,14 @@ def _child_main(args: argparse.Namespace) -> None:
                 args.jobs_count,
                 args.jobs_workers,
             )
+        elif args.child == "churn_workers":
+            out = child_churn_workers(
+                args.seed,
+                args.churn_nodes,
+                args.churn_events,
+                args.jobs_count,
+                args.workers_fleet,
+            )
         elif args.child == "churn_restart":
             out = child_churn_restart(
                 args.seed,
@@ -1375,6 +1586,9 @@ def main() -> None:
     # the child reads no environment for them).
     ap.add_argument("--jobs-count", type=int, default=8)
     ap.add_argument("--jobs-workers", type=int, default=4)
+    # Fleet scale-out rung: worker PROCESS count for the multi-process
+    # leg (the other leg is always one process).
+    ap.add_argument("--workers-fleet", type=int, default=4)
     # Trace-rung shape (stdlib parent forwards; the bundled hand-checked
     # fixture is the default — the locked trace workload family).
     ap.add_argument(
@@ -1411,8 +1625,8 @@ def main() -> None:
         "--child",
         choices=[
             "probe", "rung", "churn", "churn_shard", "churn_fleet",
-            "churn_fleet_shard", "churn_jobs", "churn_trace",
-            "churn_restart", "churn_resume",
+            "churn_fleet_shard", "churn_jobs", "churn_workers",
+            "churn_trace", "churn_restart", "churn_resume",
         ],
         default=None,
     )
@@ -1782,6 +1996,29 @@ def main() -> None:
             mode="churn_jobs",
         )
 
+    def run_churn_workers_stage() -> None:
+        """Fleet scale-out rung (round 20, ksim_tpu/jobs/fleet.py): a
+        4-job multi-tenant storm against 1 vs N lease-claiming worker
+        PROCESSES over one shared jobs dir behind a frontdoor-role
+        manager — aggregate jobs/min and per-job step p99 per leg, the
+        fleet speedup, jobs_match_solo, and the per-worker lease
+        counters.  Always the 6k prefix and a 4-job storm: the claim
+        is about horizontal process fan-out, not stream length, and
+        the rung already runs 2x the storm plus a solo baseline."""
+        run_secondary_churn_rung(
+            "churn_workers",
+            lambda resized: [
+                "--seed", str(args.seed),
+                "--churn-events", str(min(args.churn_events, 6_000)),
+                "--churn-nodes", str(min(args.churn_nodes, CPU_CHURN_CAP[1])),
+                "--jobs-count", str(min(args.jobs_count, 4)),
+                "--workers-fleet", str(args.workers_fleet),
+            ],
+            CHURN_TIMEOUT,
+            min_budget=180,
+            mode="churn_workers",
+        )
+
     def run_churn_trace_stage() -> None:
         """Trace-ingestion rung (round 14, ksim_tpu/traces): the bundled
         hand-checked Borg fixture compiled to a churn stream, replayed
@@ -1957,6 +2194,7 @@ def main() -> None:
     run_churn_fleet_stage()
     run_churn_fleet_shard_stage()
     run_churn_jobs_stage()
+    run_churn_workers_stage()
     run_churn_trace_stage()
     run_churn_restart_stage()
     run_churn_resume_stage()

@@ -106,18 +106,25 @@ def start_simulator(argv: list[str] | None = None) -> int:
                 "not one-shot import or a snapshot file"
             )
 
-    if os.environ.get("KSIM_AOT_PREWARM") == "1":
+    prewarm_mode = os.environ.get("KSIM_AOT_PREWARM")
+    if prewarm_mode in ("1", "2"):
         # Load-only AOT warm start: deserialize the shape-ladder rungs
         # already on disk so the first tenant dispatch of each skips
         # the deserialize round (engine/replay.py prewarm_aot_cache —
         # it never cold-compiles; the persistent XLA compilation cache
-        # enabled above covers the compile half).  Daemon thread: a
-        # wedged chip tunnel inside jax device init must never block
-        # server startup — the dispatch-path watchdog owns that risk.
-        from ksim_tpu.engine.replay import prewarm_aot_cache
+        # enabled above covers the compile half).  Mode 2 keeps
+        # rescanning (prewarm_rescan_loop) so executables OTHER fleet
+        # workers store after our startup — including ladder rungs this
+        # process never dispatched — load speculatively too.  Daemon
+        # thread: a wedged chip tunnel inside jax device init must
+        # never block server startup — the dispatch-path watchdog owns
+        # that risk.
+        from ksim_tpu.engine.replay import prewarm_aot_cache, prewarm_rescan_loop
 
         threading.Thread(
-            target=prewarm_aot_cache, name="aot-prewarm", daemon=True
+            target=prewarm_rescan_loop if prewarm_mode == "2" else prewarm_aot_cache,
+            name="aot-prewarm",
+            daemon=True,
         ).start()
 
     if args.profile_dir:

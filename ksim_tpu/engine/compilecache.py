@@ -95,6 +95,7 @@ class CompileCache:
         self.disk_stores = 0  # guarded-by: _lock (fresh executables persisted)
         self.disk_evictions = 0  # guarded-by: _lock (corrupt/mismatched unlinks)
         self.disk_prewarmed = 0  # guarded-by: _lock (startup-deserialized entries)
+        self.disk_speculative = 0  # guarded-by: _lock (rescan-loaded peers' entries)
 
     def run(
         self,
@@ -191,6 +192,14 @@ class CompileCache:
         — evidence only; the entries themselves live with the caller."""
         with self._lock:
             self.disk_prewarmed += n
+
+    def note_speculative(self, n: int) -> None:
+        """Count ``n`` entries the ``KSIM_AOT_PREWARM=2`` rescan loop
+        loaded AFTER startup — executables another fleet worker stored
+        (possibly for rungs this process never dispatched), now warm
+        here too.  Same evidence-only contract as ``note_prewarmed``."""
+        with self._lock:
+            self.disk_speculative += n
 
     @staticmethod
     def read_disk_entry(path: str) -> "tuple[str, bytes] | None":
@@ -324,6 +333,7 @@ class CompileCache:
                 "disk_stores": self.disk_stores,
                 "disk_evictions": self.disk_evictions,
                 "disk_prewarmed": self.disk_prewarmed,
+                "disk_speculative": self.disk_speculative,
                 "rungs": rungs,
                 "shared_rungs": shared,
                 "shared_single_compile_rungs": shared_hot,
@@ -345,6 +355,7 @@ class CompileCache:
             self.disk_stores = 0
             self.disk_evictions = 0
             self.disk_prewarmed = 0
+            self.disk_speculative = 0
 
 
 #: The process-wide cache every segment dispatch consults — one compile

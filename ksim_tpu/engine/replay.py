@@ -88,6 +88,7 @@ import numpy as np
 from ksim_tpu.errors import (
     DeviceUnavailableError,
     ReplayFallback,
+    RunCancelled,
     SimulatorError,
 )
 from ksim_tpu.engine.compilecache import COMPILE_CACHE
@@ -3518,7 +3519,7 @@ _PREWARM_LOCK = threading.Lock()
 _PREWARMED: dict = {}  # guarded-by: _PREWARM_LOCK
 
 
-def prewarm_aot_cache() -> int:  # ksimlint: thread-role(service-loop)
+def prewarm_aot_cache(*, speculative: bool = False) -> int:  # ksimlint: thread-role(service-loop)
     """``KSIM_AOT_PREWARM=1`` (cmd/simulator.py): walk the on-disk AOT
     directory at server startup and deserialize every entry whose token
     matches THIS process's jax version / backend / device count —
@@ -3526,7 +3527,15 @@ def prewarm_aot_cache() -> int:  # ksimlint: thread-role(service-loop)
     foreign-topology entry is SKIPPED, not evicted: eviction authority
     stays with the dispatch path's token check, where the exact rung
     identity is known.  Returns the number prewarmed; the process-wide
-    ``compile_cache`` counters carry it as ``disk_prewarmed``."""
+    ``compile_cache`` counters carry it as ``disk_prewarmed``.
+
+    ``speculative=True`` is the rescan-loop variant (AOT cache round 2,
+    ``prewarm_rescan_loop``): only entries NOT already in the prewarm
+    registry load — on-disk executables that appeared after startup are
+    another fleet worker's compiles, including ladder rungs this
+    process never dispatched, and loading them makes one worker's
+    compile every worker's warm start.  Counted separately as
+    ``disk_speculative``."""
     base = _aot_cache_dir()
     if base is None or not os.path.isdir(base):
         return 0
@@ -3538,6 +3547,10 @@ def prewarm_aot_cache() -> int:  # ksimlint: thread-role(service-loop)
         if not fname.endswith(".aot"):
             continue
         path = os.path.join(base, fname)
+        if speculative:
+            with _PREWARM_LOCK:
+                if path in _PREWARMED:
+                    continue
         ent = COMPILE_CACHE.read_disk_entry(path)
         if ent is None:
             continue
@@ -3553,8 +3566,42 @@ def prewarm_aot_cache() -> int:  # ksimlint: thread-role(service-loop)
             _PREWARMED[path] = (zlib.crc32(blob) & 0xFFFFFFFF, call)
         n += 1
     if n:
-        COMPILE_CACHE.note_prewarmed(n)
+        if speculative:
+            COMPILE_CACHE.note_speculative(n)
+        else:
+            COMPILE_CACHE.note_prewarmed(n)
     return n
+
+
+def prewarm_rescan_loop(
+    stop: "threading.Event | None" = None,
+    interval_s: "float | None" = None,
+) -> None:  # ksimlint: thread-role(service-loop)
+    """``KSIM_AOT_PREWARM=2`` (cmd/simulator.py): the startup prewarm
+    pass, then a speculative rescan every ``KSIM_AOT_PREWARM_RESCAN_S``
+    seconds (default 30) picking up executables OTHER fleet workers
+    stored since the last scan.  Runs forever on its daemon thread;
+    ``stop`` is the tests' exit handle."""
+    if interval_s is None:
+        interval_s = float(os.environ.get("KSIM_AOT_PREWARM_RESCAN_S", "30"))
+    interval_s = max(float(interval_s), 0.05)
+    if stop is None:
+        stop = threading.Event()
+    try:
+        prewarm_aot_cache()
+    except RunCancelled:
+        raise
+    except Exception:
+        logger.exception("aot prewarm startup pass failed")
+    while not stop.wait(interval_s):
+        try:
+            prewarm_aot_cache(speculative=True)
+        except RunCancelled:
+            raise
+        except Exception:
+            # One failed rescan (e.g. the cache dir vanished mid-walk)
+            # must not kill the loop — the next tick retries.
+            logger.exception("aot speculative rescan failed")
 
 
 def _plan_const_parts(plan: "_SegmentPlan"):

@@ -24,6 +24,12 @@ Sites currently wired (see docs/faults.md for the full table):
 - ``jobs.run``          a tenant job starting on a job-plane worker
                         (ksim_tpu/jobs/manager.py; a fault here fails
                         that one job, never the worker pool)
+- ``jobs.lease_claim``  a fleet member claiming a job lease
+                        (ksim_tpu/jobs/fleet.py; a fault here skips ONE
+                        claim attempt — another member, or the next
+                        poll, picks the job up)
+- ``jobs.lease_renew``  a fleet worker's heartbeat renewal batch (a
+                        fault here is survivable until lease expiry)
 
 Schedules are deterministic by construction — "fail call N" and "fail
 the first K calls" count per-site calls, "hang" sleeps (simulating a
@@ -88,6 +94,8 @@ SITES: tuple[str, ...] = (
     "jobs.journal_replay",
     "jobs.checkpoint_append",
     "jobs.checkpoint_restore",
+    "jobs.lease_claim",
+    "jobs.lease_renew",
 )
 
 

@@ -1,0 +1,17 @@
+"""``python -m ksim_tpu.jobs`` — run a fleet worker process.
+
+Thin launcher around :func:`ksim_tpu.jobs.fleet.main`.  Spawning the
+worker as the *package* (not ``-m ksim_tpu.jobs.fleet``) avoids the
+runpy double-import warning: ``ksim_tpu.jobs.__init__`` imports
+``fleet``, so running the submodule as ``__main__`` would execute it a
+second time under a different name.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ksim_tpu.jobs.fleet import main
+
+if __name__ == "__main__":
+    sys.exit(main())

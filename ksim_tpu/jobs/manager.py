@@ -376,6 +376,13 @@ class Job:
         self.checkpoint_segment: "int | None" = None  # guarded-by: _cond
         self.resumed_from: "int | None" = None  # guarded-by: _cond
         self._resume_info: "dict | None" = None  # worker-thread only
+        # Fleet ownership (docs/jobs.md "Multi-worker fleet"): which
+        # worker process holds the job's lease, folded from the lease
+        # file by the front door's poller (or set locally on adoption).
+        # None outside fleet mode — status() serves the keys either way.
+        self.owner: "str | None" = None  # guarded-by: _cond
+        self.lease_epoch: "int | None" = None  # guarded-by: _cond
+        self.lease_ts: "float | None" = None  # guarded-by: _cond
 
     # -- event log (the SSE source) --------------------------------------
 
@@ -486,6 +493,49 @@ class Job:
                 ev["error"] = error
             self._emit_locked(ev, True)
 
+    def _set_lease(self, lease: dict) -> None:
+        """Fleet: install the folded lease view (front door) or the
+        just-claimed lease (worker adoption) for status()."""
+        with self._cond:
+            self.owner = lease.get("worker")
+            self.lease_epoch = lease.get("epoch")
+            ts = lease.get("ts")
+            self.lease_ts = float(ts) if ts else None
+
+    def _mirror_state(
+        self,
+        state: str,
+        *,
+        error: "str | None" = None,
+        result: "dict | None" = None,
+        started: "float | None" = None,
+        finished: "float | None" = None,
+        segment: "int | None" = None,
+    ) -> None:
+        """Fleet front door only: install a worker-journaled transition
+        into this MIRROR job without emitting events — the per-job
+        event file is the event authority (FleetMember forwards it into
+        the ring), the shared journal the state authority.  A terminal
+        mirror never regresses: a duplicate terminal record from the
+        cancel race (front door finalized queued, worker journaled
+        cancelled) folds to the same state."""
+        with self._cond:
+            if self.state in TERMINAL_STATES:
+                return
+            self.state = state
+            if error is not None:
+                self.error = error
+            if result is not None:
+                self.result = result
+            if started:
+                self.started = float(started)
+            if state in TERMINAL_STATES:
+                self.finished = float(finished) if finished else time.time()
+                self.checkpoint_segment = None  # terminal: not carried
+            else:
+                self.checkpoint_segment = segment
+            self._cond.notify_all()
+
     def request_cancel(self) -> str:
         """Set the cancel flag; a QUEUED job finalizes immediately, a
         RUNNING one stops at the runner's next checkpoint (rolling back
@@ -532,6 +582,17 @@ class Job:
                 "checkpoint_segment": self.checkpoint_segment,
                 "resumed_from": self.resumed_from,
                 "error": self.error,
+                "owner": self.owner,
+                "lease": (
+                    {
+                        "epoch": self.lease_epoch,
+                        "age": round(time.time() - self.lease_ts, 3)
+                        if self.lease_ts
+                        else None,
+                    }
+                    if self.owner is not None
+                    else None
+                ),
             }
 
     def result_view(self) -> tuple[str, "dict | None", "str | None"]:
@@ -613,8 +674,37 @@ class JobManager:
         checkpoint_max_bytes: "int | None" = None,
         tenant_max_active: "int | None" = None,
         tenant_rate: "float | None" = None,
+        role: "str | None" = None,
+        worker_id: "str | None" = None,
+        lease_s: "float | None" = None,
+        heartbeat_s: "float | None" = None,
+        poll_s: "float | None" = None,
     ) -> None:
         env = os.environ
+        # Fleet role (docs/jobs.md "Multi-worker fleet"): None is the
+        # solo manager, byte-identical to every pre-fleet round;
+        # "frontdoor" serves HTTP over a mirror registry (zero local
+        # workers); "worker" claims jobs by lease from the shared dir.
+        if role is None:
+            role = env.get("KSIM_WORKERS_ROLE", "") or None
+        if role not in (None, "frontdoor", "worker"):
+            raise ValueError(
+                f"KSIM_WORKERS_ROLE must be 'frontdoor' or 'worker', "
+                f"got {role!r}"
+            )
+        self.role = role
+        if worker_id is None:
+            worker_id = env.get("KSIM_WORKER_ID", "") or f"w{os.getpid()}"
+        self.worker_id = str(worker_id)
+        if lease_s is None:
+            lease_s = float(env.get("KSIM_WORKERS_LEASE_S", "10"))
+        if heartbeat_s is None:
+            raw = env.get("KSIM_WORKERS_HEARTBEAT_S", "")
+            heartbeat_s = float(raw) if raw else None
+        if poll_s is None:
+            poll_s = float(env.get("KSIM_WORKERS_POLL_S", "0.5"))
+        if role == "frontdoor":
+            workers = 0  # the front door never runs jobs locally
         if workers is None:
             workers = int(env.get("KSIM_JOBS_WORKERS", "2"))
         if queue_limit is None:
@@ -677,8 +767,19 @@ class JobManager:
             self._journal = JobJournal(
                 os.path.join(jobs_dir, JOURNAL_NAME),
                 max_bytes=journal_max_bytes,
+                # Fleet mode: other PROCESSES hold this journal open —
+                # appends/compactions take the flock sidecar.
+                shared=role is not None,
             )
-            self._recover(bool(resume))
+            # Worker role NEVER replays at startup: the journal's
+            # non-terminal jobs belong to whichever member holds their
+            # lease (marking them interrupted here would sabotage a
+            # live peer) — a worker's registry fills by adoption only.
+            # The front door replays into MIRRORS: live states restore
+            # verbatim, nothing is flagged interrupted, nothing is
+            # re-enqueued locally.
+            if role != "worker":
+                self._recover(bool(resume) if role is None else False)
         self._threads: list[threading.Thread] = []
         for i in range(max(int(workers), 0)):
             t = threading.Thread(
@@ -686,6 +787,17 @@ class JobManager:
             )
             t.start()
             self._threads.append(t)
+        # The fleet poller starts LAST: adoption may enqueue onto the
+        # local pool, so the workers must already be draining.
+        self._fleet = None
+        if role is not None and jobs_dir:
+            from ksim_tpu.jobs.fleet import FleetMember
+
+            self._fleet = FleetMember(
+                self, jobs_dir, role=role, worker_id=self.worker_id,
+                lease_s=lease_s, heartbeat_s=heartbeat_s, poll_s=poll_s,
+            )
+            self._fleet.start()
 
     # -- durability ------------------------------------------------------
 
@@ -868,7 +980,15 @@ class JobManager:
         """One journal-reconstructed job: terminal states restore
         verbatim (the result document serves byte-identically); a job
         last seen queued/running died with the old process and is
-        flagged ``interrupted``."""
+        flagged ``interrupted``.
+
+        Fleet front door EXCEPTION: a restarting front door's
+        non-terminal jobs are (probably) still running on a live worker
+        — they restore as LIVE mirrors with the journaled state
+        verbatim, no interrupted flag, no interrupted record (which a
+        worker would read as terminal and skip the job forever).  If
+        the owner really is dead, lease expiry hands the job to a
+        survivor and the mirror catches up."""
         job = Job(
             jid, ordinal, [], {}, priority,
             ring_cap=self._ring_cap, max_events=self._max_events, faults=None,
@@ -884,6 +1004,30 @@ class JobManager:
                 created=sub.get("created"), started=ent["started"],
                 finished=ent["finished"], cancelled=ent["cancel"],
             )
+        elif self.role == "frontdoor":
+            if ent["cancel"]:
+                job.cancel.set()
+            with job._cond:
+                job.state = state or "queued"
+                if sub.get("created"):
+                    job.created = float(sub["created"])
+                job.started = (
+                    float(ent["started"]) if ent["started"] else None
+                )
+            # Gap-free SSE across the front-door restart: replay the
+            # journaled lifecycle into the fresh mirror ring first; the
+            # event-file tailer appends the live tail on top.
+            for h in ent.get("history", ()):
+                ev = {"event": "state", "state": h["state"],
+                      "recovered": True}
+                if h.get("error"):
+                    ev["error"] = h["error"]
+                job.emit(ev, vital=True)
+            if ent["checkpoints"]:
+                with job._cond:
+                    job.checkpoint_segment = (
+                        ent["checkpoints"][-1].get("segment")
+                    )
         else:
             job.restore(
                 "interrupted",
@@ -943,6 +1087,81 @@ class JobManager:
         except Exception:
             logger.exception("job %s could not be resumed", jid)
             return None
+
+    # -- fleet adoption --------------------------------------------------
+
+    def adopt(self, jid: str, ent: dict,
+              lease: "dict | None" = None) -> "Job | None":
+        """Fleet worker: take ownership of a journal-folded job this
+        process just LEASED (FleetMember's poller, after a winning
+        ``LeasePlane.claim``) — the cross-process twin of
+        ``_resume_job``.  Re-parses the journaled spec, replays the
+        journaled lifecycle into the event log (tagged ``recovered``),
+        carries the folded checkpoints for the round-16 incremental
+        restore, and enqueues onto the LOCAL pool under the original
+        id/ordinal.  ``JobQueueFull`` propagates — local backpressure
+        is retryable, the caller keeps the lease and tries again.  A
+        spec that no longer parses journals a terminal ``failed``
+        record (so the front door mirrors the refusal) and returns
+        None."""
+        sub = ent.get("submit") or {}
+        ordinal = int(sub.get("ordinal", 0))
+        priority = int(sub.get("priority", 0))
+        existing = self.get(jid)
+        if existing is not None:
+            return existing
+        try:
+            ops, sim, _, fault_spec = _parse_job_spec(sub.get("doc"))
+            entries = list(self._fault_specs.get(ordinal, ()))
+            if fault_spec:
+                entries.append(fault_spec)
+            faults: "FaultPlane | None" = None
+            if entries and not sim.get("fleet"):
+                faults = FaultPlane()
+                for entry in entries:
+                    faults.configure(entry)
+        except Exception as e:
+            error = f"adopted spec no longer parses: {type(e).__name__}: {e}"
+            logger.exception("job %s could not be adopted", jid)
+            self._journal_append({
+                "t": "state", "id": jid, "state": "failed", "error": error,
+                "ts": round(time.time(), 3),
+            })
+            return None
+        job = Job(
+            jid, ordinal, ops, sim, priority,
+            ring_cap=self._ring_cap, max_events=self._max_events,
+            faults=faults, tenant=str(sub.get("tenant") or "default"),
+        )
+        job.doc = sub.get("doc")
+        if sub.get("created"):
+            job.created = float(sub["created"])
+        for h in ent.get("history", ()):
+            ev = {"event": "state", "state": h["state"], "recovered": True}
+            if h.get("error"):
+                ev["error"] = h["error"]
+            job.emit(ev, vital=True)
+        job.checkpoints = list(ent.get("checkpoints", ()))
+        if job.checkpoints:
+            last = job.checkpoints[-1]
+            with job._cond:
+                job._last_checkpoint = last
+                job.checkpoint_segment = last.get("segment")
+        if ent.get("cancel"):
+            job.cancel.set()
+        job._set_lease(lease or {"worker": self.worker_id,
+                                 "ts": time.time()})
+        job.emit({"event": "state", "state": "queued", "resumed": True},
+                 vital=True)
+        # JobQueueFull propagates with no registry residue.
+        self.queue.put(job, priority=priority, cost=len(ops))
+        with self._lock:
+            self._seq = max(self._seq, ordinal + 1)
+            self._jobs[jid] = job
+            self._prune_locked()
+        TRACE.event("jobs.enqueue", job=jid, priority=priority,
+                    depth=self.queue.depth())
+        return job
 
     # -- submission ------------------------------------------------------
 
@@ -1050,9 +1269,14 @@ class JobManager:
             job.emit({"event": "state", "state": "queued"}, vital=True)
             # Cost-aware admission: the spec's event count is the cost
             # estimate (shortest-job-first within the priority band).
-            self.queue.put(
-                job, priority=priority, cost=len(ops)
-            )  # JobQueueFull -> no ordinal
+            # The fleet FRONT DOOR never enqueues locally — its journal
+            # submit record IS the hand-off, and a worker process
+            # claims it by lease; backpressure there is per-tenant
+            # admission plus the workers' own queue capacity.
+            if self.role != "frontdoor":
+                self.queue.put(
+                    job, priority=priority, cost=len(ops)
+                )  # JobQueueFull -> no ordinal
             self._seq += 1
             self._jobs[job.id] = job
             self._prune_locked()
@@ -1559,12 +1783,16 @@ class JobManager:
         }
         if self._journal is not None:
             doc["journal"] = self._journal.snapshot()
+        if self._fleet is not None:
+            doc["fleet"] = self._fleet.snapshot()
         return doc
 
     def shutdown(self, timeout: "float | None" = 5.0) -> None:
         """Stop accepting work, cancel everything live, and join the
         workers (daemon threads — a stuck dispatch cannot block process
-        exit, it is simply abandoned like the replay watchdog's)."""
+        exit, it is simply abandoned like the replay watchdog's).  The
+        fleet poller stops LAST so its final drain forwards the jobs'
+        terminal events and releases the now-terminal leases."""
         self.queue.close()
         for job in self.jobs():
             job.request_cancel()
@@ -1572,3 +1800,5 @@ class JobManager:
         for t in self._threads:
             remaining = None if deadline is None else max(deadline - time.monotonic(), 0.1)
             t.join(remaining)
+        if self._fleet is not None:
+            self._fleet.stop()

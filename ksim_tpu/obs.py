@@ -113,6 +113,13 @@ SPAN_NAMES: tuple[str, ...] = (
     #                             checkpoint: store + service carries
     #                             reconstructed on the worker thread
     #                             before the suffix replay
+    "jobs.lease_claim",  # one fleet claim attempt: fold the lease file
+    #                      under the exclusive flock, decide, append
+    #                      (ksim_tpu/jobs/fleet.py; refusals return
+    #                      inside the span without a claim record)
+    "jobs.lease_renew",  # one heartbeat batch renewing this worker's
+    #                      live leases (args.n — a missed batch is
+    #                      survivable until lease expiry)
 )
 
 #: Instant event names.
@@ -164,6 +171,13 @@ EVENT_NAMES: tuple[str, ...] = (
     #                             (args.restored True/False; a failed
     #                             attempt falls back to the previous
     #                             checkpoint, then to scratch)
+    "jobs.fleet_claim",  # a fleet member won a job lease (args: job /
+    #                      worker / epoch / takeover — takeover=True is
+    #                      the fail-over path re-claiming an expired
+    #                      lease; ksim_tpu/jobs/fleet.py)
+    "jobs.lease_expired",  # a lease aged out un-renewed and a survivor
+    #                        took the job over (args: job / worker — the
+    #                        DEAD owner being charged — / epoch)
 )
 
 _KNOWN_NAMES = frozenset(SPAN_NAMES) | frozenset(EVENT_NAMES)

@@ -635,3 +635,87 @@ def test_bench_churn_fleet_shard_child_survives_dead_device(tmp_path):
     assert rec["counts_match"] is True  # the host path carried all lanes
     assert rec["fleet"]["lanes_on_device"] == 0.0
     assert all(s == 0 for s in rec["fleet"]["lane_device_steps"])
+
+
+@pytest.mark.slow
+def test_bench_churn_workers_child_records_fleet_scaleout_evidence(tmp_path):
+    """Round 20: the churn_workers child's record carries the
+    horizontal-scale-out evidence — a 1-worker leg and an N-worker
+    subprocess fleet leg over the same multi-tenant storm, every job's
+    counts byte-identical to the in-process solo baseline, lease
+    counters showing the fleet actually spread the claims, and zero
+    takeovers (nobody died, nobody was deposed)."""
+    out = tmp_path / "workers.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_workers", "--out", str(out),
+            "--seed", "0", "--churn-events", "300", "--churn-nodes", "64",
+            "--jobs-count", "2", "--workers-fleet", "2",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=sanitized_cpu_env(),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["jobs"] == 2 and rec["fleet"] == 2
+    assert rec["jobs_match_solo"] is True
+    legs = rec["legs"]
+    assert legs["one_worker"]["workers"] == 1
+    assert legs["fleet"]["workers"] == 2
+    for leg in legs.values():
+        assert leg["finished"] == 2
+        assert leg["jobs_per_min"] > 0
+        assert leg["takeovers"] == 0
+        assert leg["step_p99_max_s"] > 0
+        for pj in leg["per_job"]:
+            assert pj["state"] == "succeeded"
+            assert pj["counts"] == rec["solo_counts"]
+    # The 1-worker leg funnels every claim through one worker; in the
+    # fleet leg every claim is accounted to some worker and nothing
+    # expired.  (Claim SPREAD is racy at this tiny shape — a fast
+    # worker may legally adopt both jobs in one poll — so only the
+    # conservation law is asserted.)
+    solo_counters = legs["one_worker"]["lease_counters"]
+    assert len(solo_counters) == 1
+    assert sum(c["claims"] for c in solo_counters.values()) == 2
+    fleet_counters = legs["fleet"]["lease_counters"]
+    assert sum(c["claims"] for c in fleet_counters.values()) == 2
+    assert all(c["expired"] == 0 for c in fleet_counters.values())
+
+
+def test_bench_churn_workers_child_survives_dead_device(tmp_path):
+    """One-JSON-line-under-any-hardware, scale-out edition: the fault
+    plane rides the environment into every fleet worker subprocess
+    (sanitized_cpu_env copies the parent env), every dispatch fails,
+    each worker degrades to the host path — and the counts still match
+    the (equally degraded) in-child solo baseline."""
+    out = tmp_path / "workers_dead.json"
+    env = sanitized_cpu_env(
+        {
+            "KSIM_FAULTS": "replay.dispatch=always@device",
+            "KSIM_REPLAY_BREAKER_N": "2",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_workers", "--out", str(out),
+            "--seed", "0", "--churn-events", "200", "--churn-nodes", "64",
+            "--jobs-count", "1", "--workers-fleet", "2",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["jobs_match_solo"] is True
+    for leg in rec["legs"].values():
+        assert leg["finished"] == 1
+        assert all(pj["state"] == "succeeded" for pj in leg["per_job"])

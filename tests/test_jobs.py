@@ -109,6 +109,9 @@ def test_job_lifecycle_over_http(server):
     final = _wait_state(server, jid, {"succeeded", "failed"})
     assert final["state"] == "succeeded", final
     assert final["progress"]["steps_done"] == final["progress"]["steps_total"] == 4
+    # Fleet fields ride along even solo: no owner, no lease (the keys
+    # are always present so clients need no feature detection).
+    assert final["owner"] is None and final["lease"] is None
 
     status, res = _req(server, "GET", f"/api/v1/jobs/{jid}/result")
     assert status == 200
@@ -213,9 +216,53 @@ def test_metrics_jobs_section_shape(server):
     assert jm_entry["state"] == "succeeded"
     # The per-job plane snapshot rides along: private histograms.
     assert jm_entry["trace"]["histograms"]["runner.step"]["count"] == 4
-    # compile_cache is a first-class provider section (process-wide).
+    # compile_cache is a first-class provider section (process-wide),
+    # including the AOT prewarm counters (startup + speculative rescan).
     assert "compile_cache" in m
-    assert set(m["compile_cache"]) >= {"hits", "misses", "shared_rungs"}
+    assert set(m["compile_cache"]) >= {
+        "hits", "misses", "shared_rungs", "disk_prewarmed",
+        "disk_speculative",
+    }
+    # Solo manager: no fleet section (it appears only under a role).
+    assert "fleet" not in m["jobs"]
+
+
+def test_fleet_status_and_metrics_over_http(tmp_path, monkeypatch):
+    """Satellite: /api/v1/jobs/<id> carries the owner worker id and the
+    lease age, and /api/v1/metrics the per-worker fleet counters, when
+    the server runs as the fleet's front door."""
+    monkeypatch.setenv("KSIM_JOBS_DIR", str(tmp_path))
+    monkeypatch.setenv("KSIM_WORKERS_ROLE", "frontdoor")
+    monkeypatch.setenv("KSIM_WORKER_ID", "fd")
+    monkeypatch.setenv("KSIM_WORKERS_POLL_S", "0.1")
+    di = DIContainer()
+    srv = SimulatorServer(di, port=0).start()
+    wk = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        role="worker", worker_id="w1", lease_s=3.0, poll_s=0.1,
+    )
+    try:
+        status, job = _req(srv, "POST", "/api/v1/jobs", tiny_spec())
+        assert status == 202
+        final = _wait_state(srv, job["id"], {"succeeded", "failed"})
+        assert final["state"] == "succeeded", final
+        assert final["owner"] == "w1"
+        assert set(final["lease"]) == {"epoch", "age"}
+        assert final["lease"]["epoch"] == 1
+        assert final["lease"]["age"] >= 0
+        status, m = _req(srv, "GET", "/api/v1/metrics")
+        assert status == 200
+        fleet = m["jobs"]["fleet"]
+        assert fleet["role"] == "frontdoor" and fleet["worker_id"] == "fd"
+        assert set(fleet["workers"]["w1"]) == {
+            "claims", "takeovers", "renews", "expired",
+        }
+        assert fleet["workers"]["w1"]["claims"] == 1
+        assert fleet["workers"]["w1"]["takeovers"] == 0
+    finally:
+        wk.shutdown()
+        srv.shutdown_server()
+        di.shutdown()
 
 
 # ---------------------------------------------------------------------------

@@ -17,6 +17,7 @@ import os
 import socket
 import subprocess
 import sys
+import threading
 import time
 import zlib
 
@@ -24,8 +25,8 @@ import pytest
 
 from ksim_tpu.engine.compilecache import CompileCache
 from ksim_tpu.faults import FAULTS, InjectedFault
-from ksim_tpu.jobs import JobJournal, JobManager
-from ksim_tpu.jobs.journal import JOURNAL_NAME
+from ksim_tpu.jobs import JobJournal, JobManager, LeasePlane
+from ksim_tpu.jobs.journal import JOURNAL_NAME, _decode_line
 from ksim_tpu.server import DIContainer, SimulatorServer
 from tests.helpers import make_node, make_pod, sanitized_cpu_env
 
@@ -937,3 +938,412 @@ def test_sse_aborted_reader_releases_listener(monkeypatch):
     finally:
         srv.shutdown_server()
         di.shutdown()
+
+# ---------------------------------------------------------------------------
+# Multi-worker fleet (round 20): the lease plane, the shared journal,
+# and kill-a-worker fail-over (docs/jobs.md "Multi-worker fleet")
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    """Injectable clock for the lease protocol tests — expiry windows
+    advance exactly when the test says so."""
+
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _planes(tmp_path, clock, *workers, lease_s=10.0):
+    return [
+        LeasePlane(str(tmp_path), worker=w, lease_s=lease_s, clock=clock)
+        for w in workers
+    ]
+
+
+def test_lease_claim_race_exactly_one_winner(tmp_path):
+    """Two members claiming the same job simultaneously serialize on
+    the exclusive flock and exactly one wins (flock is per-open-
+    description, so two planes in one process exclude each other)."""
+    a, b = _planes(tmp_path, _FakeClock(), "wA", "wB")
+    barrier = threading.Barrier(2)
+    results: dict[str, "dict | None"] = {}
+
+    def race(name, plane):
+        barrier.wait()
+        results[name] = plane.claim("job-0")
+
+    threads = [
+        threading.Thread(target=race, args=p) for p in (("wA", a), ("wB", b))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    winners = [n for n, r in results.items() if r is not None]
+    assert len(winners) == 1, results
+    lease = a.leases()["job-0"]
+    assert lease["worker"] == winners[0] and lease["epoch"] == 1
+    counters = a.counters()
+    assert counters[winners[0]]["claims"] == 1
+    assert sum(c["claims"] for c in counters.values()) == 1
+
+
+def test_lease_double_claim_refused_and_own_reclaim_idempotent(tmp_path):
+    clock = _FakeClock()
+    a, b = _planes(tmp_path, clock, "wA", "wB")
+    first = a.claim("job-0")
+    assert first is not None and first["epoch"] == 1
+    assert b.claim("job-0") is None  # live lease, someone else's
+    again = a.claim("job-0")  # the owner re-claiming is a no-op
+    assert again is not None and again["epoch"] == 1
+    assert a.counters()["wA"]["claims"] == 1  # ... and appended nothing
+
+
+def test_lease_renew_extends_expiry_and_skips_not_ours(tmp_path):
+    clock = _FakeClock()
+    (a,) = _planes(tmp_path, clock, "wA")
+    a.claim("job-0")
+    before = a.leases()["job-0"]["expires"]
+    clock.t += 5.0
+    assert a.renew(["job-0", "job-ghost"]) == 1  # the ghost is skipped
+    assert a.leases()["job-0"]["expires"] == before + 5.0
+    assert a.counters()["wA"]["renews"] == 1
+
+
+def test_expired_lease_takeover_bumps_epoch_and_counters(tmp_path):
+    """The fail-over path: a lease whose owner stopped renewing ages
+    out, the next claimer wins with a bumped epoch, the takeover is
+    charged to the claimer and the expiry to the worker that lost."""
+    clock = _FakeClock()
+    a, b = _planes(tmp_path, clock, "wA", "wB")
+    a.claim("job-0")
+    clock.t += 5.0
+    assert b.claim("job-0") is None  # still live: refused
+    clock.t += 6.0  # past the 10s lease: the fail-over window
+    won = b.claim("job-0")
+    assert won is not None and won["epoch"] == 2
+    counters = b.counters()
+    assert counters["wB"]["claims"] == 1 and counters["wB"]["takeovers"] == 1
+    assert counters["wA"]["expired"] == 1
+    # The deposed owner cannot renew its way back in.
+    assert a.renew(["job-0"]) == 0
+
+
+def test_released_lease_is_never_reclaimable(tmp_path):
+    """released == finished (releases happen only after the terminal
+    record is durable), so no amount of clock is ever enough."""
+    clock = _FakeClock()
+    a, b = _planes(tmp_path, clock, "wA", "wB")
+    a.claim("job-0")
+    a.release("job-0")
+    clock.t += 10_000.0
+    assert b.claim("job-0") is None
+    assert a.claim("job-0") is None  # not even the old owner
+
+
+def test_lease_compaction_preserves_leases_and_counters(tmp_path):
+    """Compaction rewrites newest-record-per-id + a trailing counters
+    snapshot; the fold over the compacted file must be identical —
+    including the released tombstones the claim protocol depends on."""
+    clock = _FakeClock()
+    a, b = _planes(tmp_path, clock, "wA", "wB")
+    a.claim("job-0")
+    b.claim("job-1")
+    for _ in range(50):
+        clock.t += 1.0
+        a.renew(["job-0"])
+        b.renew(["job-1"])
+    b.release("job-1")
+    before_leases, before_counters = a.leases(), a.counters()
+    size = os.path.getsize(a.path)
+    assert a.maybe_compact(max_bytes=1) is True
+    assert os.path.getsize(a.path) < size
+    assert a.leases() == before_leases
+    assert a.counters() == before_counters
+    # A brand-new member folds the compacted file to the same view,
+    # and the released job stays unclaimable.
+    (c,) = _planes(tmp_path, clock, "wC")
+    assert c.leases() == before_leases
+    assert c.claim("job-1") is None
+
+
+# -- the shared journal: satellite regression (multi-appender safety) -------
+
+
+def test_shared_journal_interleaved_appenders_record_atomic(tmp_path):
+    """Two handles interleaving appends — including a
+    multi-hundred-KB checkpoint-sized record — leave a file where every
+    line decodes independently: the single-``os.write``-per-record rule
+    means appenders interleave only at record granularity.  Checked on
+    the raw BYTES, not through replay."""
+    path = os.path.join(str(tmp_path), JOURNAL_NAME)
+    j1 = JobJournal(path, shared=True)
+    j2 = JobJournal(path, shared=True)
+    big = {
+        "t": "checkpoint", "id": "job-0", "segment": 1, "cursor": 16,
+        "store": {"blob": "x" * 300_000},
+    }
+    j1.append({"t": "submit", "id": "job-0", "ordinal": 0, "doc": {}})
+    j2.append({"t": "state", "id": "job-0", "state": "running"})
+    j1.append(big)
+    j2.append({"t": "state", "id": "job-0", "state": "succeeded"})
+    j1.append({"t": "result", "id": "job-0", "result": {"ok": 1}})
+    with open(path, "r", encoding="utf-8", newline="") as f:
+        lines = f.readlines()
+    assert len(lines) == 5
+    recs = [_decode_line(ln) for ln in lines]
+    assert all(r is not None for r in recs)
+    assert [r["t"] for r in recs] == [
+        "submit", "state", "checkpoint", "state", "result",
+    ]
+    assert recs[2]["store"]["blob"] == big["store"]["blob"]
+    # A third handle replays the merged stream intact.
+    assert len(JobJournal(path, shared=True).replay()) == 5
+
+
+def test_shared_journal_concurrent_append_stress(tmp_path):
+    """The actual race: two handles appending concurrently from two
+    threads (flock is per-open-description, so this exercises the real
+    cross-process exclusion).  Nothing torn, nothing lost."""
+    path = os.path.join(str(tmp_path), JOURNAL_NAME)
+    j1 = JobJournal(path, shared=True)
+    j2 = JobJournal(path, shared=True)
+
+    def pump(j, tag):
+        for i in range(100):
+            j.append({
+                "t": "state", "id": f"{tag}-{i}", "state": "running",
+                "pad": "y" * (4096 if i % 7 == 0 else 8),
+            })
+
+    threads = [
+        threading.Thread(target=pump, args=p)
+        for p in ((j1, "one"), (j2, "two"))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    recs = JobJournal(path, shared=True).replay()
+    assert len(recs) == 200
+    assert {r["id"] for r in recs} == {
+        f"{tag}-{i}" for tag in ("one", "two") for i in range(100)
+    }
+
+
+def test_shared_compaction_folds_other_appenders_records(tmp_path):
+    """The satellite regression: pre-round-20 compaction rewrote the
+    journal from the LOCAL registry snapshot, silently dropping records
+    a second process appended.  Shared compaction folds the file's own
+    records — keeping the other appender's newest state/checkpoint, the
+    record types it does not understand, and never stranding the other
+    appender on the replaced inode."""
+    path = os.path.join(str(tmp_path), JOURNAL_NAME)
+    j1 = JobJournal(path, shared=True, max_bytes=256)
+    j2 = JobJournal(path, shared=True)
+    j1.append({"t": "submit", "id": "job-0", "ordinal": 0, "doc": {"spec": {}}})
+    for i in range(20):
+        j2.append({"t": "state", "id": "job-0", "state": "running", "ts": i})
+    j2.append({"t": "checkpoint", "id": "job-0", "segment": 3, "cursor": 48})
+    j2.append({"t": "checkpoint", "id": "job-0", "segment": 7, "cursor": 112})
+    j2.append({"t": "fleet-extension", "custom": True})  # unknown type
+    assert j1.maybe_compact(lambda: []) is True  # snapshot_fn IGNORED
+    # The second appender keeps appending: per-record re-open lands the
+    # write on the NEW inode, not the compacted-away one.
+    j2.append({"t": "state", "id": "job-0", "state": "succeeded", "ts": 99})
+    recs = JobJournal(path, shared=True).replay()
+    assert [r["t"] for r in recs] == [
+        "submit", "state", "checkpoint", "fleet-extension", "state",
+    ]
+    assert recs[1]["ts"] == 19  # newest pre-compaction state won
+    assert recs[2]["segment"] == 7  # newest checkpoint won, older shed
+    assert recs[4]["ts"] == 99
+
+
+# -- the fleet loop in-process: frontdoor mirror + worker adoption ----------
+
+
+def test_fleet_frontdoor_worker_lifecycle_in_process(tmp_path):
+    """One frontdoor + one worker manager over a shared dir: the
+    frontdoor journals the submit, the worker claims/runs/releases, and
+    the frontdoor mirror folds state, result, events, owner and lease
+    back for status/result/SSE."""
+    fd = JobManager(
+        workers=0, queue_limit=8, jobs_dir=str(tmp_path),
+        role="frontdoor", worker_id="fd", lease_s=3.0, poll_s=0.1,
+    )
+    wk = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        role="worker", worker_id="w1", lease_s=3.0, poll_s=0.1,
+    )
+    try:
+        job = fd.submit(tiny_doc())
+        final = _wait(job, {"succeeded", "failed"}, 120.0)
+        assert final["state"] == "succeeded", final
+        assert final["owner"] == "w1"
+        assert final["lease"]["epoch"] == 1
+        state, res, _ = job.result_view()
+        assert state == "succeeded"
+        assert res["result"]["podsScheduled"] == 3  # ran on the worker
+        # The mirrored SSE ring: state + progress events crossed the
+        # manager boundary via the per-job event file.
+        deadline = time.monotonic() + 15
+        while True:
+            evs, _, done = job.events_since(0, 0)
+            kinds = [e["event"] for e in evs]
+            if done and "state" in kinds and "progress" in kinds:
+                break
+            assert time.monotonic() < deadline, kinds
+            time.sleep(0.05)
+        flt = fd.snapshot()["fleet"]
+        assert flt["role"] == "frontdoor" and flt["worker_id"] == "fd"
+        assert flt["workers"]["w1"]["claims"] == 1
+        wflt = wk.snapshot()["fleet"]
+        assert wflt["role"] == "worker"
+        assert wflt["owned"] == []  # released after the terminal record
+    finally:
+        wk.shutdown()
+        fd.shutdown()
+
+
+def test_fleet_cancel_routes_to_owning_worker(tmp_path):
+    """A cancel submitted at the front door reaches the owning worker
+    through the journal's cancel record and stops the run mid-flight."""
+    ops = [
+        {"step": 0, "createOperation": {"object": make_node(f"n{i}", cpu="32")}}
+        for i in range(2)
+    ]
+    ops += [
+        {"step": i + 1, "createOperation": {"object": make_pod(f"p{i}", cpu="100m")}}
+        for i in range(400)
+    ]
+    doc = {"spec": {"scenario": {"operations": ops}}}
+    fd = JobManager(
+        workers=0, queue_limit=8, jobs_dir=str(tmp_path),
+        role="frontdoor", worker_id="fd", lease_s=3.0, poll_s=0.05,
+    )
+    wk = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        role="worker", worker_id="w1", lease_s=3.0, poll_s=0.05,
+    )
+    try:
+        job = fd.submit(doc)
+        running = _wait(job, {"running", "succeeded", "failed"}, 60.0)
+        assert running["state"] == "running", running
+        fd.cancel(job.id)
+        final = _wait(job, {"cancelled", "succeeded", "failed"}, 60.0)
+        assert final["state"] == "cancelled", final
+        assert final["owner"] == "w1"
+    finally:
+        wk.shutdown()
+        fd.shutdown()
+
+
+# -- kill-a-worker chaos: the acceptance scenario ---------------------------
+
+
+_SIX_K_DOC_SRC = """
+from ksim_tpu.scenario import churn_scenario, spec_from_operations
+
+ops = list(churn_scenario(0, n_nodes=2000, n_events=6000, ops_per_step=100))
+doc = {"spec": {
+    "simulator": {
+        "deviceReplay": True, "maxPodsPerPass": 1024, "podBucketMin": 128,
+    },
+    "scenario": spec_from_operations(ops),
+}}
+"""
+
+
+def _six_k_doc() -> dict:
+    ns: dict = {}
+    exec(_SIX_K_DOC_SRC, ns)
+    return ns["doc"]
+
+
+def _spawn_fleet_worker(tmp_path, worker_id: str) -> subprocess.Popen:
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ksim_tpu.jobs",
+            "--dir", str(tmp_path), "--worker-id", worker_id,
+            "--workers", "1",
+        ],
+        env=sanitized_cpu_env({
+            "KSIM_WORKERS_LEASE_S": "4",
+            "KSIM_WORKERS_HEARTBEAT_S": "1",
+            "KSIM_WORKERS_POLL_S": "0.2",
+            "KSIM_JOBS_CHECKPOINT_EVERY": "1",
+        }),
+        cwd="/root/repo",
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    line = proc.stdout.readline()
+    assert line.strip() == f"READY {worker_id}", line
+    return proc
+
+
+@pytest.mark.slow
+def test_fleet_sigkill_owner_fails_over_with_locked_counts(tmp_path):
+    """The round-20 acceptance scenario (`make restart-check`): a fleet
+    of two worker PROCESSES behind an in-process front door; SIGKILL
+    the worker that owns the locked 6k churn job after its first
+    durable checkpoint.  The survivor's claim succeeds once the lease
+    expires (takeover, epoch 2), it adopts the job from the journal
+    fold and resumes from the newest checkpoint — landing 2524/471
+    byte-identically with strictly fewer events replayed, exactly one
+    result record, and the takeover/expiry charged to the right
+    workers."""
+    procs = {
+        "wA": _spawn_fleet_worker(tmp_path, "wA"),
+        "wB": _spawn_fleet_worker(tmp_path, "wB"),
+    }
+    fd = JobManager(
+        workers=0, queue_limit=8, jobs_dir=str(tmp_path),
+        role="frontdoor", worker_id="fd", lease_s=4.0, poll_s=0.2,
+    )
+    try:
+        job = fd.submit(_six_k_doc())
+        # Wait for an owner AND its first durable checkpoint (both
+        # mirrored into frontdoor status) — the kill window where
+        # fail-over must resume, not restart.
+        deadline = time.monotonic() + 300
+        while True:
+            st = job.status()
+            assert st["state"] not in ("succeeded", "failed"), st
+            if st["owner"] in procs and st["checkpoint_segment"] is not None:
+                break
+            assert time.monotonic() < deadline, st
+            time.sleep(0.1)
+        owner, survivor = st["owner"], ("wA" if st["owner"] == "wB" else "wB")
+        procs[owner].kill()  # SIGKILL: no atexit, no flush, no goodbye
+        procs[owner].wait()
+
+        final = _wait(job, {"succeeded", "failed", "interrupted"}, 600.0)
+        assert final["state"] == "succeeded", final
+        assert final["owner"] == survivor
+        assert final["lease"]["epoch"] >= 2
+        _, res, _ = job.result_view()
+        assert res["result"]["eventsApplied"] == 6430
+        assert (
+            res["result"]["podsScheduled"],
+            res["result"]["unschedulableAttempts"],
+        ) == (2524, 471)
+        assert 0 < res["resume"]["eventsReplayed"] < 6430
+        # Zero lost, zero duplicated: exactly one result record made it
+        # into the shared journal.
+        recs = JobJournal(
+            os.path.join(str(tmp_path), JOURNAL_NAME), shared=True
+        ).replay()
+        assert sum(1 for r in recs if r["t"] == "result") == 1
+        counters = fd.snapshot()["fleet"]["workers"]
+        assert counters[survivor]["takeovers"] == 1
+        assert counters[owner]["expired"] == 1
+    finally:
+        for proc in procs.values():
+            proc.kill()
+            proc.wait()
+        fd.shutdown()

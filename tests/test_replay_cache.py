@@ -531,3 +531,76 @@ def test_aot_prewarm_skips_foreign_entries_without_evicting(
     assert sorted(os.listdir(tmp_path)) == [
         "corrupt.aot", "foreign.aot", "garbage.aot", "undeser.aot",
     ]
+
+
+def test_aot_speculative_rescan_picks_up_new_entries(
+    tmp_path, monkeypatch, _clean_aot_plane
+):
+    """Round 20 (fleet prewarm): a speculative pass re-reads the shared
+    disk plane and warms ONLY entries it has never seen — the mechanism
+    that turns one fleet worker's compile into every peer's warm start.
+    Counted under disk_speculative (not disk_prewarmed), idempotent
+    when nothing new landed, and the background rescan loop drives the
+    same pass on its interval."""
+    import os
+    import shutil
+    import threading
+    import time
+
+    import ksim_tpu.engine.replay as R
+    from ksim_tpu.engine.compilecache import COMPILE_CACHE
+
+    monkeypatch.setenv("KSIM_AOT_CACHE", str(tmp_path))
+    runner = ScenarioRunner(device_replay=True, device_segment_steps=4)
+    runner.run(_prewarm_stream())
+    stored = sorted(f for f in os.listdir(tmp_path) if f.endswith(".aot"))
+    assert stored, "seeding run persisted no AOT entries"
+
+    COMPILE_CACHE.reset()
+    n = R.prewarm_aot_cache()
+    assert n == len(stored)
+    base = COMPILE_CACHE.snapshot()
+    assert base["disk_prewarmed"] == n
+    assert base["disk_speculative"] == 0
+
+    # A peer worker lands a new entry in the shared plane (stand-in: a
+    # copy of an existing entry under a fresh name — the registry is
+    # keyed by path, so this is "a file we have never deserialized").
+    shutil.copyfile(tmp_path / stored[0], tmp_path / "peer-0.aot")
+    assert R.prewarm_aot_cache(speculative=True) == 1
+    snap = COMPILE_CACHE.snapshot()
+    assert snap["disk_speculative"] == 1
+    assert snap["disk_prewarmed"] == n  # startup counter untouched
+    # Nothing new on disk: the speculative pass is a no-op, not a
+    # re-count.
+    assert R.prewarm_aot_cache(speculative=True) == 0
+    assert COMPILE_CACHE.snapshot()["disk_speculative"] == 1
+
+    # The background loop: a full startup pass, then speculative
+    # rescans on the interval.  Wait for the startup pass (it bumps
+    # disk_prewarmed), THEN land a new peer entry and watch the rescan
+    # pick it up as speculative.
+    prewarmed_before = COMPILE_CACHE.snapshot()["disk_prewarmed"]
+    stop = threading.Event()
+    t = threading.Thread(
+        target=R.prewarm_rescan_loop,
+        kwargs={"stop": stop, "interval_s": 0.05},
+        daemon=True,
+    )
+    t.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if COMPILE_CACHE.snapshot()["disk_prewarmed"] > prewarmed_before:
+                break
+            time.sleep(0.02)
+        shutil.copyfile(tmp_path / stored[0], tmp_path / "peer-1.aot")
+        while time.monotonic() < deadline:
+            if COMPILE_CACHE.snapshot()["disk_speculative"] >= 2:
+                break
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert not t.is_alive()
+    assert COMPILE_CACHE.snapshot()["disk_speculative"] == 2

@@ -13,8 +13,8 @@ functions; this rule makes the property interprocedural (docs/lint.md
   skipped — the conservative-dispatch soundness limit.
 - **Role vocabulary** (docs/lint.md): ``main-thread``,
   ``dispatch-worker``, ``job-worker``, ``sse-handler``, ``compactor``,
-  ``service-loop``.  Anything else is a finding (a typo'd role would
-  silently opt out of every check below).
+  ``service-loop``, ``fleet-poller``.  Anything else is a finding (a
+  typo'd role would silently opt out of every check below).
 - **Dispatch-worker strictness, propagated.**  The round-8 "no store to
   self" contract applies to every function reachable from a
   ``dispatch-worker`` root along same-receiver (``self.m()`` / nested
@@ -54,6 +54,7 @@ ROLES = frozenset(
         "sse-handler",
         "compactor",
         "service-loop",
+        "fleet-poller",
     }
 )
 
