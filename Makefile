@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs restart-check shard-check mesh-check
+.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs restart-check shard-check mesh-check obs-check
 
 test:
 	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
@@ -99,9 +99,25 @@ restart-check: lint
 # through the device path with KSIM_TRACE_OUT set, in the sanitized CPU
 # env — asserts the counts hold under tracing and the emitted Chrome
 # trace parses with every expected phase span, then an armed-fault run
-# asserting the fault/fallback timeline events.  Stdlib-only parent.
+# asserting the fault/fallback timeline events, and (run 5) a 2-worker
+# fleet leg whose SIGTERM-published trace exports must merge into one
+# Chrome trace with a lane per worker and a complete submit->claim->run
+# flow triple per job.  Stdlib-only parent.
 trace:
 	$(PY) tools/trace_check.py
+
+# Fleet observability verification (docs/observability.md "Fleet
+# observability"): the histogram bucket-merge property test, the
+# Prometheus exposition golden + round-trip parser tests, crash-atomic
+# publish, staleness flagging, the merged-trace lane/flow tests, and
+# the slow 2-process fleet scrape end-to-end (-m '' includes it).
+# Sanitized CPU env, so it runs under ANY hardware condition; gated on
+# lint because METRIC_NAMES/registry drift is exactly what the
+# analyzer catches in seconds.
+obs-check: lint
+	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
+	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
+	'tests/test_obs_fleet.py', '-q', '-m', ''], env=sanitized_cpu_env()))"
 
 test-tpu:
 	$(PY) -m pytest tests/test_tpu_parity.py -q -rs

@@ -797,9 +797,14 @@ def child_churn_workers(
     Evidence the record must carry: per-leg aggregate jobs/min and
     per-job ``runner.step`` p99 under the storm, the fleet-vs-solo
     wall speedup, per-job counts with a ``jobs_match_solo`` flag
-    against an in-process solo replay, and the per-worker lease
+    against an in-process solo replay, the per-worker lease
     counters (zero takeovers — nothing dies here; the kill-a-worker
-    chaos leg lives in ``make restart-check``).  Workers run on the
+    chaos leg lives in ``make restart-check``), and a timed
+    fleet-scope observability scrape per leg (workers publish
+    snapshots at ``KSIM_OBS_PUBLISH_S=1``; the leg merges them,
+    renders Prometheus text, and round-trips the parser — recording
+    ``scrape_ms`` and the aggregate dispatch p99 under the storm,
+    docs/observability.md "Fleet observability").  Workers run on the
     CPU backend regardless of the probe: N processes cannot share one
     chip, and the scale-out claim is about horizontal fan-out, not
     accelerator placement.  Each leg shares one ``KSIM_AOT_CACHE`` dir
@@ -813,6 +818,7 @@ def child_churn_workers(
 
     import jax
 
+    from ksim_tpu import obs
     from ksim_tpu.jobs import JobManager
     from ksim_tpu.scenario import (
         ScenarioRunner,
@@ -848,6 +854,9 @@ def child_churn_workers(
         wenv = sanitized_cpu_env({
             "KSIM_WORKERS_POLL_S": "0.1",
             "KSIM_WORKERS_LEASE_S": "8",
+            # Workers publish telemetry snapshots every second so the
+            # leg's fleet-scope scrape below sees live worker rows.
+            "KSIM_OBS_PUBLISH_S": "1",
             # Small local queues spread the storm across the fleet
             # (a worker at capacity skips claiming — backpressure).
             "KSIM_JOBS_QUEUE": "2",
@@ -919,6 +928,21 @@ def child_churn_workers(
                 })
             p99s = [pj["step_p99_s"] for pj in per_job if pj["step_p99_s"]]
             counters = jm.snapshot().get("fleet", {}).get("workers", {})
+            # Fleet-scope scrape while the workers are still up: merge
+            # the published snapshots, render + round-trip the
+            # Prometheus exposition, and time the whole pull — the
+            # scrape cost a fleet operator pays per poll interval.
+            t_scrape = time.perf_counter()
+            fleet_doc = obs.merge_fleet_docs(obs.read_fleet_snapshots(d))
+            expo = obs.render_prometheus(fleet_doc)
+            obs.parse_prometheus(expo)
+            scrape_ms = round((time.perf_counter() - t_scrape) * 1e3, 2)
+            timings = fleet_doc.get("timings", {})
+            agg = (
+                timings.get("replay.dispatch")
+                or timings.get("runner.step")
+                or {}
+            )
             return {
                 "workers": nw,
                 "finished": finished,
@@ -933,6 +957,14 @@ def child_churn_workers(
                 "takeovers": sum(
                     c.get("takeovers", 0) for c in counters.values()
                 ),
+                "obs_scrape": {
+                    "scrape_ms": scrape_ms,
+                    "workers_published": sorted(
+                        fleet_doc.get("workers", {})
+                    ),
+                    "dispatch_p99_s": agg.get("p99_seconds"),
+                    "exposition_bytes": len(expo),
+                },
             }
         finally:
             for p in procs:

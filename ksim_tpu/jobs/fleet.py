@@ -67,7 +67,15 @@ import time
 from ksim_tpu.errors import RunCancelled
 from ksim_tpu.faults import FAULTS
 from ksim_tpu.jobs.journal import JOURNAL_NAME, _decode_line, _line
-from ksim_tpu.obs import TRACE
+from ksim_tpu.obs import (
+    TRACE,
+    merge_chrome_traces,
+    merge_latency_snapshots,
+    next_publish_seq,
+    process_identity,
+    provider_snapshots,
+    publish_snapshot,
+)
 
 __all__ = [
     "EVENTS_DIR",
@@ -507,6 +515,7 @@ class FleetMember:
         lease_s: float = 10.0,
         heartbeat_s: "float | None" = None,
         poll_s: float = 0.5,
+        publish_s: "float | None" = None,
     ) -> None:
         if role not in ("frontdoor", "worker"):
             raise ValueError(f"unknown fleet role {role!r}")
@@ -521,6 +530,17 @@ class FleetMember:
             else self.lease_s / 3.0
         )
         self.poll_s = max(float(poll_s), 0.02)
+        # Telemetry publish cadence (docs/observability.md "Fleet
+        # observability"): KSIM_OBS_PUBLISH_S seconds between snapshot
+        # publishes, default 10; 0 disables the publisher thread
+        # entirely (and the obs/ directory is never created).
+        if publish_s is None:
+            raw = os.environ.get("KSIM_OBS_PUBLISH_S", "")
+            try:
+                publish_s = float(raw) if raw else 10.0
+            except ValueError:
+                publish_s = 10.0
+        self.publish_s = max(float(publish_s), 0.0)
         self.plane = LeasePlane(jobs_dir, worker=worker_id, lease_s=lease_s)
         self._tailer = JournalTailer(os.path.join(jobs_dir, JOURNAL_NAME))
         self._events_dir = os.path.join(jobs_dir, EVENTS_DIR)
@@ -537,6 +557,7 @@ class FleetMember:
         self._polls = 0  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
+        self._publish_thread: "threading.Thread | None" = None
 
     # -- lifecycle -------------------------------------------------------
 
@@ -548,20 +569,39 @@ class FleetMember:
         )  # ksimlint: thread-role(fleet-poller)
         t.start()
         self._thread = t
+        if self.publish_s > 0:
+            p = threading.Thread(
+                target=self._publish_loop,
+                name=f"obs-publish-{self.worker_id}",
+                daemon=True,
+            )  # ksimlint: thread-role(obs-publisher)
+            p.start()
+            self._publish_thread = p
 
     def stop(self, timeout: "float | None" = 5.0) -> None:
         """Stop the poller, then run ONE final poll inline to drain any
         remaining owned-job events and release leases of jobs that
         reached a terminal state during shutdown (a lease left behind
-        simply expires — correctness never depends on this drain)."""
+        simply expires — correctness never depends on this drain).
+        With publishing on, one final snapshot publishes AFTER the
+        drain, so the on-disk telemetry reflects this member's terminal
+        truth."""
         self._stop.set()
         t = self._thread
         if t is not None:
             t.join(timeout)
+        p = self._publish_thread
+        if p is not None:
+            p.join(timeout)
         try:
             self._poll_once()
         except Exception:
             logger.exception("fleet final drain failed")
+        if self.publish_s > 0:
+            try:
+                self.publish_once()
+            except Exception:
+                logger.exception("final obs publish failed")
 
     # -- the poller ------------------------------------------------------
 
@@ -788,6 +828,119 @@ class FleetMember:
                     started=ent["started"], finished=ent["finished"],
                     segment=ent["checkpoint_segment"],
                 )
+
+    # -- telemetry publishing (docs/observability.md) --------------------
+
+    def _publish_loop(self) -> None:  # ksimlint: thread-role(obs-publisher)
+        while not self._stop.wait(self.publish_s):
+            try:
+                self.publish_once()
+            except RunCancelled:
+                raise
+            except Exception:
+                # Containment: telemetry is evidence, never load-bearing
+                # — a failed publish leaves the previous snapshot
+                # standing and the next tick retries.
+                logger.exception(
+                    "obs publish failed (role=%s worker=%s)",
+                    self.role, self.worker_id,
+                )
+
+    def _obs_document(self) -> "tuple[dict, dict]":
+        """(snapshot document, merged Chrome trace document) for this
+        member.  Job spans (``jobs.run``, ``replay.dispatch``, ...)
+        land on each job's PRIVATE plane via the worker's scoped
+        override, so the global ``TRACE`` alone under-reports a worker:
+        both documents merge the global plane with every registered
+        job's plane — histograms bucket-wise exactly (fixed edges),
+        rings as one process lane."""
+        now = time.time()
+        ident = process_identity(role=self.role, worker_id=self.worker_id)
+        ident["seq"] = next_publish_seq()
+        ident["published_at"] = round(now, 3)
+        ident["publish_s"] = self.publish_s
+        jobs = self._manager.jobs()
+        sections = [TRACE.snapshot()]
+        traces = {self.worker_id: TRACE.export_chrome()}
+        for job in jobs:
+            plane = getattr(job, "trace", None)
+            if plane is None:
+                continue
+            sections.append(plane.snapshot())
+            traces[f"{self.worker_id}:{job.id}"] = plane.export_chrome()
+        events: dict[str, int] = {}
+        hist_snaps: dict[str, list] = {}
+        for sec in sections:
+            for name, v in (sec.get("events") or {}).items():
+                events[name] = events.get(name, 0) + int(v)
+            for name, snap in (sec.get("histograms") or {}).items():
+                hist_snaps.setdefault(name, []).append(snap)
+        histograms = {
+            n: merge_latency_snapshots(snaps)
+            for n, snaps in sorted(hist_snaps.items())
+        }
+        trace_sec = {
+            "enabled": sections[0].get("enabled", False),
+            "ring": sections[0].get("ring") or {},
+            "histograms": histograms,
+            "events": dict(sorted(events.items())),
+        }
+        try:
+            mine = self.plane.counters().get(self.worker_id) or {}
+        except Exception:
+            mine = {}
+        doc: dict = {
+            "process": ident,
+            # This member's own lease-protocol counters — numeric, so
+            # the fleet merge's counter SUM is meaningful across
+            # workers (each publishes only its own row).
+            "counters": {f"fleet_{k}": v for k, v in sorted(mine.items())},
+            "timings": {},
+            "trace": trace_sec,
+            "phase_totals": {
+                n: [s["total_seconds"], s["count"]]
+                for n, s in histograms.items()
+                if s.get("count")
+            },
+            "faults": FAULTS.snapshot(),
+            "jobs": self._manager.snapshot(),
+        }
+        for name, snap in provider_snapshots().items():
+            doc.setdefault(name, snap)
+        trace_doc = merge_chrome_traces(traces)
+        # Pin this process's lane name to the WORKER id.  The merge
+        # names a lane after the first keyed export that contributed an
+        # event on that pid; if the global ring happens to be empty at
+        # publish time a per-job key ("w1:job-0001") would win — or no
+        # lane would exist at all — and the fleet-level merge downstream
+        # would lose the one-lane-per-worker invariant trace_check
+        # run 5 asserts.
+        pid = os.getpid()
+        for ev in trace_doc["traceEvents"]:
+            if (
+                ev.get("ph") == "M"
+                and ev.get("name") == "process_name"
+                and ev.get("pid") == pid
+            ):
+                ev["args"] = {"name": self.worker_id}
+                break
+        else:
+            trace_doc["traceEvents"].insert(0, {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": self.worker_id},
+            })
+        return doc, trace_doc
+
+    def publish_once(self) -> str:
+        """Build and crash-atomically publish this member's telemetry
+        snapshot + merged trace export to ``<jobs_dir>/obs/``."""
+        doc, trace_doc = self._obs_document()
+        return publish_snapshot(
+            self._dir, doc, worker_id=self.worker_id, trace_doc=trace_doc
+        )
 
     # -- evidence --------------------------------------------------------
 

@@ -726,6 +726,10 @@ class JobManager:
             sjf_bypass = int(raw) if raw else None
         if jobs_dir is None:
             jobs_dir = env.get("KSIM_JOBS_DIR", "")
+        # Exposed for the fleet observability plane: the HTTP layer
+        # resolves KSIM_JOBS_DIR/obs/ (published worker snapshots)
+        # through the manager it already has.
+        self.jobs_dir = jobs_dir or None
         if resume is None:
             resume = env.get("KSIM_JOBS_RESUME", "") == "1"
         if checkpoint_every is None:

@@ -73,7 +73,16 @@ from urllib.parse import parse_qs, urlparse
 
 from ksim_tpu.engine.compilecache import COMPILE_CACHE
 from ksim_tpu.faults import FAULTS
-from ksim_tpu.obs import TRACE, provider_snapshots
+from ksim_tpu.obs import (
+    TRACE,
+    merge_chrome_traces,
+    merge_fleet_docs,
+    process_identity,
+    provider_snapshots,
+    read_fleet_snapshots,
+    read_fleet_traces,
+    render_prometheus,
+)
 from ksim_tpu.server.di import DIContainer
 
 logger = logging.getLogger(__name__)
@@ -222,13 +231,31 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/api/v1/export":
             self._json(200, self.server.di.snapshot_service.snap())
         elif url.path == "/api/v1/metrics":
-            self._json(200, self._merged_metrics())
+            # ?scope=fleet folds every published worker snapshot (plus
+            # this process's live document) into one fleet document —
+            # counters sum, histograms merge bucket-wise exactly, dead
+            # workers surface flagged (docs/observability.md "Fleet
+            # observability").
+            if (parse_qs(url.query).get("scope") or [""])[0] == "fleet":
+                self._json(200, self._fleet_metrics())
+            else:
+                self._json(200, self._merged_metrics())
+        elif url.path == "/metrics":
+            # Prometheus/OpenMetrics text exposition of the same
+            # evidence (solo by default, ?scope=fleet for the merge).
+            self._prometheus(parse_qs(url.query))
         elif url.path == "/api/v1/trace":
             # The live event ring as Chrome trace-event JSON — load the
             # response body straight into Perfetto (ui.perfetto.dev) or
             # chrome://tracing.  Empty unless the trace plane's ring is
             # on (KSIM_TRACE_OUT / KSIM_TRACE=1 / TRACE.enable()).
-            self._json(200, TRACE.export_chrome())
+            # ?scope=fleet merges the frontdoor ring with every
+            # published worker trace export: one process lane per
+            # worker, flow arrows stitching submit -> claim -> run.
+            if (parse_qs(url.query).get("scope") or [""])[0] == "fleet":
+                self._json(200, self._fleet_trace())
+            else:
+                self._json(200, TRACE.export_chrome())
         elif url.path == "/api/v1/traces":
             # The named-trace registry (ksim_tpu/traces/registry.py):
             # names only — resolution and parsing stay server-side.
@@ -363,7 +390,72 @@ class _Handler(BaseHTTPRequestHandler):
                 "jobs": {},
             }
         )
+        # The process-identity block (role, worker_id, pid, started_at,
+        # uptime_s) — unconditional: the fleet aggregator attributes
+        # every snapshot to its producer through it.  Set LAST so no
+        # provider can shadow it.
+        doc["process"] = process_identity(
+            role=jm.role if jm is not None else None,
+            worker_id=jm.worker_id if jm is not None else None,
+        )
         return doc
+
+    def _fleet_metrics(self) -> dict:
+        """``GET /api/v1/metrics?scope=fleet`` — every published worker
+        snapshot under ``KSIM_JOBS_DIR/obs/`` plus THIS process's live
+        document, folded by ``obs.merge_fleet_docs`` (the live document
+        replaces this process's own published file, so the serving
+        process is never reported stale to itself)."""
+        jm = self.server.di.job_manager_if_built
+        jobs_dir = getattr(jm, "jobs_dir", None)
+        docs = read_fleet_snapshots(jobs_dir) if jobs_dir else {}
+        live = self._merged_metrics()
+        ident = live["process"]
+        ident["published_at"] = round(time.time(), 3)
+        docs[ident["worker_id"]] = live
+        return merge_fleet_docs(docs)
+
+    def _fleet_trace(self) -> dict:
+        """``GET /api/v1/trace?scope=fleet`` — this process's ring (and
+        its jobs' private rings) merged with every published worker
+        trace export: one process lane per worker, submit->claim->run
+        flow arrows across lanes (``obs.merge_chrome_traces``)."""
+        jm = self.server.di.job_manager_if_built
+        jobs_dir = getattr(jm, "jobs_dir", None)
+        docs = read_fleet_traces(jobs_dir) if jobs_dir else {}
+        wid = jm.worker_id if jm is not None else f"w{os.getpid()}"
+        local = {wid: TRACE.export_chrome()}
+        if jm is not None:
+            for job in jm.jobs():
+                plane = getattr(job, "trace", None)
+                if plane is not None:
+                    local[f"{wid}:{job.id}"] = plane.export_chrome()
+        docs[wid] = (
+            merge_chrome_traces(local) if len(local) > 1 else local[wid]
+        )
+        return merge_chrome_traces(docs, flows=True)
+
+    def _prometheus(self, query: dict) -> None:
+        """``GET /metrics`` — the evidence document as Prometheus text
+        exposition (``?scope=fleet`` for the merged fleet document);
+        every family name lives in the lint-enforced ``METRIC_NAMES``
+        registry and the output round-trips through the in-repo
+        ``obs.parse_prometheus`` validator in-suite."""
+        scope = (query.get("scope") or [""])[0]
+        doc = (
+            self._fleet_metrics()
+            if scope == "fleet"
+            else self._merged_metrics()
+        )
+        body = render_prometheus(doc).encode()
+        self.send_response(200)
+        self._cors()
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     # -- the job plane ------------------------------------------------------
 

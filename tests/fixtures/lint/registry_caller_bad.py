@@ -18,8 +18,16 @@ FAULTS = _Stub()
 TRACE = _Stub()
 
 
+def _expo_family(name, kind, help_):
+    return {}
+
+
+_ROGUE = _expo_family("rogue_metric", "counter", "x")  # finding: not in METRIC_NAMES
+
+
 def run(name):
     FAULTS.check("rogue.site")  # finding: not in SITES
     TRACE.span("rogue.span")  # finding: not in SPAN_NAMES
     TRACE.event("rogue.event")  # finding: not in EVENT_NAMES
     TRACE.event(name)  # finding: non-literal name
+    _expo_family(name, "counter", "x")  # finding: non-literal family

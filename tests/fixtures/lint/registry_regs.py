@@ -1,8 +1,20 @@
 """Mini registries for the registry-literals fixture tests: stands in
-for faults.py (SITES) and obs.py (SPAN_NAMES / EVENT_NAMES)."""
+for faults.py (SITES) and obs.py (SPAN_NAMES / EVENT_NAMES /
+METRIC_NAMES).  The wired exposition family is declared here too —
+mirroring the real obs.py, where the ``_expo_family`` calls live in
+the registry module itself."""
 
 SITES: tuple = ("wired.site",)
 
 SPAN_NAMES: tuple = ("wired.site", "other.span")
 
 EVENT_NAMES: tuple = ("fault.fired", "replay.fallback", "other.event")
+
+METRIC_NAMES: tuple = ("ksim_wired_total",)
+
+
+def _expo_family(name, kind, help_):
+    return {"name": name, "kind": kind, "help": help_}
+
+
+_FAMILIES = (_expo_family("ksim_wired_total", "counter", "wired family"),)

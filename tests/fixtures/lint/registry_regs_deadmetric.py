@@ -1,0 +1,17 @@
+"""registry_regs.py with one METRIC_NAMES entry that has no
+``_expo_family`` declaration — the dead-registry-entry direction."""
+
+SITES: tuple = ("wired.site",)
+
+SPAN_NAMES: tuple = ("wired.site", "other.span")
+
+EVENT_NAMES: tuple = ("fault.fired", "replay.fallback", "other.event")
+
+METRIC_NAMES: tuple = ("ksim_wired_total", "ksim_dead_total")
+
+
+def _expo_family(name, kind, help_):
+    return {"name": name, "kind": kind, "help": help_}
+
+
+_FAMILIES = (_expo_family("ksim_wired_total", "counter", "wired family"),)

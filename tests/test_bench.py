@@ -685,6 +685,17 @@ def test_bench_churn_workers_child_records_fleet_scaleout_evidence(tmp_path):
     fleet_counters = legs["fleet"]["lease_counters"]
     assert sum(c["claims"] for c in fleet_counters.values()) == 2
     assert all(c["expired"] == 0 for c in fleet_counters.values())
+    # Round 21: each leg records a timed fleet-scope observability
+    # scrape (workers publish at KSIM_OBS_PUBLISH_S=1; the leg merges
+    # the snapshots and round-trips the Prometheus exposition).
+    for leg in legs.values():
+        scrape = leg["obs_scrape"]
+        assert scrape["scrape_ms"] >= 0
+        assert scrape["exposition_bytes"] > 0
+        # Jobs run for multiple publish intervals, so every worker of
+        # the leg has published at least one snapshot by scrape time.
+        assert len(scrape["workers_published"]) >= leg["workers"]
+        assert scrape["dispatch_p99_s"] is None or scrape["dispatch_p99_s"] > 0
 
 
 def test_bench_churn_workers_child_survives_dead_device(tmp_path):
@@ -719,3 +730,8 @@ def test_bench_churn_workers_child_survives_dead_device(tmp_path):
     for leg in rec["legs"].values():
         assert leg["finished"] == 1
         assert all(pj["state"] == "succeeded" for pj in leg["per_job"])
+        # The fleet-scope scrape must survive the dead device too — the
+        # observability plane is pure host-side I/O, so a wedged chip
+        # can degrade the jobs but never the telemetry pull.
+        assert leg["obs_scrape"]["scrape_ms"] >= 0
+        assert leg["obs_scrape"]["exposition_bytes"] > 0

@@ -205,7 +205,8 @@ def test_metrics_jobs_section_shape(server):
     ever used, populated after."""
     status, m = _req(server, "GET", "/api/v1/metrics")
     assert status == 200
-    assert set(m) >= {"counters", "timings", "trace", "faults", "jobs"}
+    assert set(m) >= {"counters", "timings", "trace", "faults", "jobs", "process"}
+    assert set(m["process"]) >= {"role", "worker_id", "pid", "started_at", "uptime_s"}
     assert m["jobs"]["workers"]["pool"] == 0 and m["jobs"]["jobs"] == {}
     status, job = _req(server, "POST", "/api/v1/jobs", tiny_spec())
     assert status == 202

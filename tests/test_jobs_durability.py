@@ -1203,7 +1203,13 @@ def test_fleet_frontdoor_worker_lifecycle_in_process(tmp_path):
         assert flt["workers"]["w1"]["claims"] == 1
         wflt = wk.snapshot()["fleet"]
         assert wflt["role"] == "worker"
-        assert wflt["owned"] == []  # released after the terminal record
+        # Released after the terminal record — on the worker's NEXT poll
+        # tick, which the mirrored SSE completion above does not order
+        # against, so wait for it rather than racing it.
+        deadline = time.monotonic() + 15
+        while wk.snapshot()["fleet"]["owned"]:
+            assert time.monotonic() < deadline, wk.snapshot()["fleet"]
+            time.sleep(0.05)
     finally:
         wk.shutdown()
         fd.shutdown()

@@ -178,6 +178,8 @@ def test_registry_literals_fires_on_seeded_violations():
     assert "'rogue.span' is not in obs.SPAN_NAMES" in joined
     assert "'rogue.event' is not in obs.EVENT_NAMES" in joined
     assert "non-literal name" in joined
+    assert "'rogue_metric' is not in obs.METRIC_NAMES" in joined
+    assert "non-literal family name" in joined
     assert "'rogue_reason' not in FALLBACK_REASONS" in joined
     assert "'host_hook:' not covered by FALLBACK_REASON_PREFIXES" in joined
     assert "'dead_entry' appears nowhere" in joined
@@ -203,6 +205,26 @@ def test_registry_literals_suppression_and_clean():
         registry_literals.check, project, cfg=_registry_cfg("registry_replay_clean.py")
     )
     assert not open_ and not suppressed
+
+
+def test_registry_literals_dead_metric_entry_fires():
+    """A METRIC_NAMES entry with no _expo_family declaration is a dead
+    registry entry — a family dashboards would scrape for in vain."""
+    project = _project(
+        "registry_regs_deadmetric.py",
+        "registry_replay_clean.py",
+        "registry_caller_clean.py",
+    )
+    cfg = registry_literals.RegistryConfig(
+        faults_module="registry_regs_deadmetric.py",
+        obs_module="registry_regs_deadmetric.py",
+        replay_module="registry_replay_clean.py",
+    )
+    open_, suppressed = _run_rule(registry_literals.check, project, cfg=cfg)
+    assert not suppressed
+    assert len(open_) == 1, [f.message for f in open_]
+    assert "'ksim_dead_total'" in open_[0].message
+    assert "dead registry entry" in open_[0].message
 
 
 # ---------------------------------------------------------------------------

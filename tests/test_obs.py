@@ -335,6 +335,24 @@ def test_trace_literals_match_taxonomy():
     )
 
 
+def test_metric_names_match_exposition_literals():
+    """The Prometheus exposition surface is machine-checked the same
+    way SITES/SPAN_NAMES are: the analyzer's AST view of METRIC_NAMES
+    equals the imported runtime tuple, and every `_expo_family("...")`
+    declaration resolves into the registry with no dead entries."""
+    from tools.ksimlint.rules import registry_literals as rl
+
+    project = _lint_project()
+    regs = rl.load_registries(project)
+    assert regs.metric_names == obs.METRIC_NAMES
+
+    scan = rl.scan_metric_literals(project)
+    assert not scan.dynamic, f"non-literal exposition families: {scan.dynamic}"
+    assert set(scan.literals) == set(obs.METRIC_NAMES)
+    # The runtime family table renders exactly the registry, in order.
+    assert tuple(f["name"] for f in obs._EXPO_FAMILIES) == obs.METRIC_NAMES
+
+
 def test_fallback_reasons_match_replay_source():
     """Every statically spelled fallback reason in engine/replay.py is
     registered in FALLBACK_REASONS (so it reaches the trace taxonomy),

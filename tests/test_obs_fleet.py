@@ -1,0 +1,512 @@
+"""Fleet observability plane (round 21, ksim_tpu/obs.py fleet section
++ jobs/fleet.py publisher): exact bucket-wise histogram merging, the
+Prometheus exposition renderer/parser pair, crash-atomic per-worker
+snapshot publishing, frontdoor aggregation with staleness flags, and
+merged Chrome traces with cross-process flow events.
+
+The 2-process fleet smoke (slow-marked) is the `make obs-check` leg:
+counter sums across the merged document equal the per-worker sums, and
+a SIGKILLed worker surfaces as ``stale_s > 0`` — never silently
+dropped (docs/observability.md "Fleet observability")."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ksim_tpu import obs
+from ksim_tpu.obs import (
+    LatencyHistogram,
+    merge_chrome_traces,
+    merge_fleet_docs,
+    merge_latency_snapshots,
+    parse_prometheus,
+    publish_snapshot,
+    render_prometheus,
+)
+from tests.helpers import make_node, make_pod, sanitized_cpu_env
+
+
+# ---------------------------------------------------------------------------
+# Histogram merging: exact by construction (fixed edges)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_merge_property():
+    """Bucket-wise merge of K snapshots == the histogram of the
+    concatenated observations — exact because every LatencyHistogram
+    shares the same 33 fixed log-spaced edges.  Randomized but seeded:
+    observations span below-first-edge, mid-range and overflow."""
+    rng = random.Random(1234)
+    for _ in range(20):
+        k = rng.randint(1, 6)
+        parts, union = [], LatencyHistogram()
+        for _ in range(k):
+            h = LatencyHistogram()
+            for _ in range(rng.randint(0, 40)):
+                v = 10 ** rng.uniform(-7.5, 2.5)  # spans edges + overflow
+                h.observe(v)
+                union.observe(v)
+            parts.append(h.snapshot())
+        merged = merge_latency_snapshots(parts)
+        want = union.snapshot()
+        assert merged["count"] == want["count"]
+        assert merged["buckets"] == want["buckets"]
+        assert merged["total_seconds"] == pytest.approx(want["total_seconds"])
+        if want["count"]:
+            assert merged["min_seconds"] == want["min_seconds"]
+            assert merged["max_seconds"] == want["max_seconds"]
+            assert merged["p50_seconds"] == want["p50_seconds"]
+            assert merged["p99_seconds"] == want["p99_seconds"]
+
+
+def test_histogram_merge_rejects_foreign_edges():
+    """A snapshot whose bucket edges are not the fixed ones cannot be
+    merged exactly — refusing is the honest move."""
+    h = LatencyHistogram()
+    h.observe(0.01)
+    snap = h.snapshot()
+    snap["buckets"] = [[0.123456, 1]]  # not a registry edge
+    with pytest.raises(ValueError):
+        LatencyHistogram().merge_snapshot(snap)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: renderer + stdlib parser round-trip
+# ---------------------------------------------------------------------------
+
+
+def _solo_doc() -> dict:
+    h = LatencyHistogram()
+    for v in (1e-4, 2e-4, 5e-3, 1.5):
+        h.observe(v)
+    return {
+        "process": {
+            "role": "solo", "worker_id": 'w"esc\\ape\n', "pid": 1,
+            "started_at": 0.0, "uptime_s": 12.5,
+        },
+        "counters": {"pods_scheduled": 7, "scheduling_passes": 3},
+        "timings": {"engine": h.snapshot()},
+        "trace": {
+            "enabled": True,
+            "events": {"fault.fired": 2},
+            "histograms": {},
+            "ring": {"appended": 10, "size": 8, "evicted": 2},
+        },
+        "faults": {"replay.dispatch": {"calls": 5, "fired": 1}},
+        "jobs": {
+            "queue": {"depth": 1, "capacity": 16},
+            "workers": {"pool": 2, "active": 1},
+        },
+    }
+
+
+def test_prometheus_render_golden_and_roundtrip():
+    """The exposition format is pinned by parse, not by hope: HELP/TYPE
+    lines precede samples, label values escape backslash/quote/newline,
+    histograms render cumulative ``le`` buckets incl. ``+Inf`` equal to
+    ``_count``, and every family is in the lint-enforced registry."""
+    text = render_prometheus(_solo_doc())
+    lines = text.splitlines()
+    assert "# TYPE ksim_counter_total counter" in lines
+    assert "# TYPE ksim_latency_seconds histogram" in lines
+    # Label escaping: the worker id carries \ " and a newline.
+    assert '\\"esc\\\\ape\\n' in text
+    # Counters carry the name label; faults the site label.
+    assert any(
+        l.startswith("ksim_counter_total{") and 'name="pods_scheduled"' in l
+        and l.endswith(" 7") for l in lines
+    )
+    assert any(
+        'site="replay.dispatch"' in l and l.startswith("ksim_fault_fired_total")
+        for l in lines
+    )
+    fams = parse_prometheus(text)
+    assert set(fams) <= set(obs.METRIC_NAMES)
+    hist = fams["ksim_latency_seconds"]
+    buckets = [
+        s for s in hist["samples"] if s["name"] == "ksim_latency_seconds_bucket"
+    ]
+    # Full edge set + +Inf, cumulative, +Inf == _count.
+    assert len(buckets) == len(LatencyHistogram.EDGES) + 1
+    values = [s["value"] for s in buckets]
+    assert values == sorted(values)
+    inf = [s for s in buckets if s["labels"]["le"] == "+Inf"]
+    count = [
+        s for s in hist["samples"] if s["name"] == "ksim_latency_seconds_count"
+    ]
+    assert inf[0]["value"] == count[0]["value"] == 4
+    gauges = parse_prometheus(text)["ksim_queue_depth"]
+    assert gauges["samples"][0]["value"] == 1
+
+
+def test_prometheus_parser_rejects_malformed():
+    bad = [
+        # sample without TYPE
+        "ksim_up 1\n",
+        # bucket without le
+        "# TYPE ksim_latency_seconds histogram\n"
+        "ksim_latency_seconds_bucket 3\n",
+        # missing +Inf bucket
+        "# TYPE ksim_latency_seconds histogram\n"
+        'ksim_latency_seconds_bucket{le="0.001"} 3\n'
+        "ksim_latency_seconds_sum 1\nksim_latency_seconds_count 3\n",
+        # non-cumulative buckets
+        "# TYPE ksim_latency_seconds histogram\n"
+        'ksim_latency_seconds_bucket{le="0.001"} 3\n'
+        'ksim_latency_seconds_bucket{le="+Inf"} 2\n'
+        "ksim_latency_seconds_sum 1\nksim_latency_seconds_count 2\n",
+        # +Inf != _count
+        "# TYPE ksim_latency_seconds histogram\n"
+        'ksim_latency_seconds_bucket{le="+Inf"} 2\n'
+        "ksim_latency_seconds_sum 1\nksim_latency_seconds_count 3\n",
+        # unterminated label value
+        "# TYPE ksim_up gauge\n" 'ksim_up{worker="w 1\n',
+    ]
+    for text in bad:
+        with pytest.raises(ValueError):
+            parse_prometheus(text)
+
+
+# ---------------------------------------------------------------------------
+# Publishing + fleet-document merging
+# ---------------------------------------------------------------------------
+
+
+def _worker_doc(wid: str, *, published_at: float, claims: int) -> dict:
+    h = LatencyHistogram()
+    h.observe(0.002 * (claims + 1))
+    return {
+        "process": {
+            "role": "worker", "worker_id": wid, "pid": 100, "started_at": 0.0,
+            "uptime_s": 1.0, "seq": 1, "published_at": published_at,
+            "publish_s": 1.0,
+        },
+        "counters": {"fleet_claims": claims},
+        "timings": {},
+        "trace": {
+            "enabled": True, "events": {"jobs.fleet_claim": claims},
+            "histograms": {"replay.dispatch": h.snapshot()},
+        },
+        "faults": {"replay.dispatch": {"calls": claims, "fired": 0}},
+    }
+
+
+def test_publish_snapshot_is_crash_atomic(tmp_path):
+    doc = _worker_doc("wa", published_at=time.time(), claims=2)
+    path = publish_snapshot(str(tmp_path), doc, worker_id="wa")
+    assert os.path.basename(path) == "wa.json"
+    on_disk = json.load(open(path))
+    assert on_disk == json.loads(json.dumps(doc))
+    # tmp files never survive a successful publish.
+    assert [f for f in os.listdir(os.path.dirname(path)) if ".tmp" in f] == []
+    docs = obs.read_fleet_snapshots(str(tmp_path))
+    assert set(docs) == {"wa"}
+    # A torn/corrupt sibling is skipped, never fatal.
+    with open(os.path.join(str(tmp_path), obs.OBS_DIR, "wb.json"), "w") as f:
+        f.write('{"truncated": ')
+    assert set(obs.read_fleet_snapshots(str(tmp_path))) == {"wa"}
+
+
+def test_fleet_merge_sums_and_flags_stale_worker():
+    """Counters sum, histograms merge bucket-wise, and the dead worker
+    surfaces as ``stale_s > 0`` with its identity intact — NEVER
+    silently dropped."""
+    now = time.time()
+    docs = {
+        "wa": _worker_doc("wa", published_at=now, claims=2),
+        "wb": _worker_doc("wb", published_at=now - 300, claims=3),
+    }
+    merged = merge_fleet_docs(docs, now=now)
+    assert merged["scope"] == "fleet"
+    assert merged["counters"]["fleet_claims"] == 5
+    assert merged["trace"]["events"]["jobs.fleet_claim"] == 5
+    assert merged["faults"]["replay.dispatch"]["calls"] == 5
+    assert merged["timings"]["replay.dispatch"]["count"] == 2
+    assert set(merged["workers"]) == {"wa", "wb"}
+    wa, wb = merged["workers"]["wa"], merged["workers"]["wb"]
+    assert wa["stale"] is False and 0 <= wa["stale_s"] < 1
+    assert wb["stale"] is True and wb["stale_s"] > 0
+    assert wb["process"]["worker_id"] == "wb"  # identity survives death
+    # Fleet exposition: per-worker series, ksim_up 0 for the stale one.
+    fams = parse_prometheus(render_prometheus(merged))
+    ups = {
+        s["labels"]["worker"]: s["value"] for s in fams["ksim_up"]["samples"]
+    }
+    assert ups == {"wa": 1, "wb": 0}
+    ages = {
+        s["labels"]["worker"]: s["value"]
+        for s in fams["ksim_snapshot_age_seconds"]["samples"]
+    }
+    assert ages["wb"] > ages["wa"]
+    # A scraper's sum() over per-worker series re-derives the totals.
+    claims = sum(
+        s["value"]
+        for s in fams["ksim_counter_total"]["samples"]
+        if s["labels"]["name"] == "fleet_claims"
+    )
+    assert claims == merged["counters"]["fleet_claims"]
+
+
+def test_merge_chrome_traces_lanes_epochs_and_flows():
+    """One process lane per worker, timestamps rebased onto the oldest
+    worker's epoch, and the submit→claim→run path stitched as one
+    complete s/t/f flow triple (incomplete paths emit nothing)."""
+    def tr(pid, epoch, events):
+        return {
+            "traceEvents": [
+                {
+                    "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                    "args": {"name": f"seed{pid}"},
+                },
+                *events,
+            ],
+            "displayTimeUnit": "ms",
+            "otherData": {"epoch_unix_s": epoch},
+        }
+
+    claim = {
+        "name": "jobs.fleet_claim", "ph": "X", "pid": 2, "tid": 1,
+        "ts": 10.0, "dur": 5.0, "args": {"job": "j1"},
+    }
+    run = {
+        "name": "jobs.run", "ph": "X", "pid": 2, "tid": 1,
+        "ts": 30.0, "dur": 50.0, "args": {"job": "j1"},
+    }
+    enq = {
+        "name": "jobs.enqueue", "ph": "X", "pid": 1, "tid": 1,
+        "ts": 5.0, "dur": 1.0, "args": {"job": "j1"},
+    }
+    orphan = {  # j2 never claimed: no flow events for it
+        "name": "jobs.enqueue", "ph": "X", "pid": 1, "tid": 1,
+        "ts": 7.0, "dur": 1.0, "args": {"job": "j2"},
+    }
+    docs = {
+        "fd": tr(1, 100.0, [enq, orphan]),
+        "w1": tr(2, 102.0, [claim, run]),
+    }
+    merged = merge_chrome_traces(docs, flows=True)
+    evs = merged["traceEvents"]
+    # pid 1 and 2 each keep their own (pre-named) lane; no duplicates.
+    names = [e for e in evs if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert sorted(e["args"]["name"] for e in names) == ["seed1", "seed2"]
+    # w1's epoch is 2 s after fd's: its events shift by +2e6 us.
+    by_name: dict = {}
+    for e in evs:
+        if e.get("ph") == "X":
+            by_name.setdefault(e["name"], []).append(e["ts"])
+    assert by_name["jobs.fleet_claim"] == [pytest.approx(10.0 + 2e6)]
+    assert sorted(by_name["jobs.enqueue"]) == [5.0, 7.0]
+    assert merged["otherData"]["merged"] == ["fd", "w1"]
+    flows = [e for e in evs if e.get("name") == "jobs.flow"]
+    assert [f["ph"] for f in flows] == ["s", "t", "f"]
+    assert {f["args"]["job"] for f in flows} == {"j1"}
+    assert len({f["id"] for f in flows}) == 1
+    s, t, f = flows
+    assert (s["pid"], t["pid"], f["pid"]) == (1, 2, 2)
+    assert s["ts"] <= t["ts"] <= f["ts"]
+
+
+# ---------------------------------------------------------------------------
+# The publisher thread (in-process worker)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_doc() -> dict:
+    ops = [
+        {"step": 0, "createOperation": {"object": make_node("n0", cpu="4")}},
+        {"step": 1, "createOperation": {"object": make_pod("p0", cpu="100m")}},
+    ]
+    return {"spec": {"scenario": {"operations": ops}}}
+
+
+def test_worker_publishes_on_cadence_and_at_shutdown(tmp_path, monkeypatch):
+    from ksim_tpu.jobs import JobManager
+
+    monkeypatch.setenv("KSIM_OBS_PUBLISH_S", "0.2")
+    jm = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        role="worker", worker_id="wpub", lease_s=5.0, poll_s=0.1,
+    )
+    try:
+        assert jm._fleet._publish_thread is not None
+        deadline = time.monotonic() + 30
+        path = os.path.join(str(tmp_path), obs.OBS_DIR, "wpub.json")
+        while not os.path.exists(path):
+            assert time.monotonic() < deadline, "no snapshot published"
+            time.sleep(0.05)
+        doc = obs.read_fleet_snapshots(str(tmp_path))["wpub"]
+        ident = doc["process"]
+        assert ident["role"] == "worker" and ident["worker_id"] == "wpub"
+        assert ident["pid"] == os.getpid() and ident["seq"] >= 1
+        assert ident["publish_s"] == pytest.approx(0.2)
+        assert set(doc) >= {
+            "process", "counters", "timings", "trace", "faults", "jobs",
+        }
+        first_seq = ident["seq"]
+    finally:
+        jm.shutdown()
+    # Shutdown publishes one final snapshot AFTER the drain.
+    final = obs.read_fleet_snapshots(str(tmp_path))["wpub"]
+    assert final["process"]["seq"] > first_seq
+
+
+def test_zero_cadence_means_no_thread_and_no_directory(tmp_path, monkeypatch):
+    """The zero-perturbation contract: KSIM_OBS_PUBLISH_S=0 creates no
+    publisher thread and never materializes KSIM_JOBS_DIR/obs/."""
+    from ksim_tpu.jobs import JobManager
+
+    monkeypatch.setenv("KSIM_OBS_PUBLISH_S", "0")
+    jm = JobManager(
+        workers=1, queue_limit=8, jobs_dir=str(tmp_path),
+        role="worker", worker_id="woff", lease_s=5.0, poll_s=0.1,
+    )
+    try:
+        assert jm._fleet._publish_thread is None
+        assert not any(
+            t.name.startswith("obs-publish")
+            for t in __import__("threading").enumerate()
+        )
+    finally:
+        jm.shutdown()
+    assert not os.path.exists(os.path.join(str(tmp_path), obs.OBS_DIR))
+
+
+# ---------------------------------------------------------------------------
+# 2-process fleet smoke (the `make obs-check` leg)
+# ---------------------------------------------------------------------------
+
+
+def _spawn_worker(tmp_path, worker_id: str) -> subprocess.Popen:
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ksim_tpu.jobs",
+            "--dir", str(tmp_path), "--worker-id", worker_id,
+            "--workers", "1",
+        ],
+        env=sanitized_cpu_env({
+            "KSIM_WORKERS_LEASE_S": "30",
+            "KSIM_WORKERS_POLL_S": "0.2",
+            "KSIM_OBS_PUBLISH_S": "0.5",
+        }),
+        cwd="/root/repo",
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    line = proc.stdout.readline()
+    assert line.strip() == f"READY {worker_id}", line
+    return proc
+
+
+def _http(port, path):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    c.request("GET", path)
+    r = c.getresponse()
+    data = r.read().decode()
+    c.close()
+    return r.status, data
+
+
+@pytest.mark.slow
+def test_two_worker_fleet_scrape_counter_sums_and_staleness(
+    tmp_path, monkeypatch
+):
+    """The acceptance scenario: 2 worker processes + in-process front
+    door.  The fleet-scope document's counter sums equal the per-worker
+    sums, both workers are identity-attributed, the exposition parses
+    clean, and a SIGKILLed worker turns ``stale_s > 0`` while staying
+    in the document."""
+    from ksim_tpu.server import DIContainer, SimulatorServer
+
+    monkeypatch.setenv("KSIM_JOBS_DIR", str(tmp_path))
+    monkeypatch.setenv("KSIM_WORKERS_ROLE", "frontdoor")
+    monkeypatch.setenv("KSIM_WORKER_ID", "fd")
+    monkeypatch.setenv("KSIM_WORKERS_POLL_S", "0.1")
+    monkeypatch.setenv("KSIM_OBS_PUBLISH_S", "0.5")
+    procs = {
+        "wA": _spawn_worker(tmp_path, "wA"),
+        "wB": _spawn_worker(tmp_path, "wB"),
+    }
+    di = DIContainer()
+    srv = SimulatorServer(di, port=0).start()
+    try:
+        jm = di.job_manager
+        jobs = [jm.submit(_tiny_doc()) for _ in range(4)]
+        deadline = time.monotonic() + 120
+        for job in jobs:
+            while job.status()["state"] not in ("succeeded", "failed"):
+                assert time.monotonic() < deadline, job.status()
+                time.sleep(0.1)
+            assert job.status()["state"] == "succeeded", job.status()
+
+        def fleet_doc():
+            status, body = _http(srv.port, "/api/v1/metrics?scope=fleet")
+            assert status == 200
+            return json.loads(body)
+
+        # Wait until every worker's published snapshot has caught up
+        # with the 4 claims (publish cadence 0.5 s).
+        while True:
+            doc = fleet_doc()
+            done = {"fd", "wA", "wB"} <= set(doc["workers"]) and (
+                doc["counters"].get("fleet_claims") == 4
+            )
+            if done:
+                break
+            assert time.monotonic() < deadline, doc.get("workers", {}).keys()
+            time.sleep(0.2)
+        per_worker = [
+            w.get("counters", {}).get("fleet_claims", 0)
+            for w in doc["workers"].values()
+        ]
+        assert sum(per_worker) == doc["counters"]["fleet_claims"] == 4
+        for wid in ("wA", "wB"):
+            ident = doc["workers"][wid]["process"]
+            assert ident["worker_id"] == wid and ident["role"] == "worker"
+            assert doc["workers"][wid]["stale"] is False
+        # The exposition endpoint renders the same document, parseable.
+        status, text = _http(srv.port, "/metrics?scope=fleet")
+        assert status == 200
+        fams = parse_prometheus(text)
+        assert set(fams) <= set(obs.METRIC_NAMES)
+        claims = sum(
+            s["value"]
+            for s in fams["ksim_counter_total"]["samples"]
+            if s["labels"]["name"] == "fleet_claims"
+        )
+        assert claims == 4
+
+        # Kill wB: past the staleness bound it flags, never drops.
+        procs["wB"].kill()
+        procs["wB"].wait()
+        while True:
+            doc = fleet_doc()
+            wb = doc["workers"].get("wB")
+            assert wb is not None, "dead worker dropped from the document"
+            if wb["stale"]:
+                break
+            assert time.monotonic() < deadline + 60, wb
+            time.sleep(0.2)
+        assert wb["stale_s"] > 0
+        assert wb["process"]["worker_id"] == "wB"
+        assert doc["workers"]["wA"]["stale"] is False
+        # Stale-but-present in the exposition too: ksim_up 0.
+        _, text = _http(srv.port, "/metrics?scope=fleet")
+        ups = {
+            s["labels"]["worker"]: s["value"]
+            for s in parse_prometheus(text)["ksim_up"]["samples"]
+        }
+        assert ups["wB"] == 0 and ups["wA"] == 1
+    finally:
+        for proc in procs.values():
+            proc.kill()
+            proc.wait()
+        srv.shutdown_server()
+        di.shutdown()

@@ -242,7 +242,7 @@ def test_metrics_merges_faults_trace_and_replay_stats(server):
         # Replay stats appear once a driver exists in the process (other
         # tests in the suite may have created one); the KEY contract is
         # that the document is a single merged object.
-        assert set(m) >= {"counters", "timings", "trace", "faults"}
+        assert set(m) >= {"counters", "timings", "trace", "faults", "process"}
         if "replay" in m:
             # Live stats, a weakly-referenced driver already collected,
             # or a provider error — all are valid merged-doc shapes.
@@ -252,6 +252,35 @@ def test_metrics_merges_faults_trace_and_replay_stats(server):
     finally:
         FAULTS.reset()
         TRACE._active, TRACE._ring_on, TRACE._user_disabled = prev_state
+
+
+def test_metrics_identity_block_and_prometheus_exposition(server):
+    """Solo scope carries the process-identity block unconditionally
+    (the fleet aggregator keys on it), and GET /metrics renders the
+    document as Prometheus text the in-repo stdlib parser accepts —
+    for BOTH scopes, every family in the lint-enforced registry."""
+    import os
+
+    from ksim_tpu.obs import METRIC_NAMES, parse_prometheus
+
+    status, m = _req(server, "GET", "/api/v1/metrics")
+    assert status == 200
+    ident = m["process"]
+    assert set(ident) >= {"role", "worker_id", "pid", "started_at", "uptime_s"}
+    assert ident["role"] == "solo" and ident["pid"] == os.getpid()
+    assert ident["uptime_s"] >= 0
+    for path in ("/metrics", "/metrics?scope=fleet"):
+        status, text = _raw(server, "GET", path)
+        assert status == 200, path
+        families = parse_prometheus(text)
+        assert set(families) <= set(METRIC_NAMES), path
+        assert "ksim_up" in families, path
+    # Fleet scope without a jobs dir still answers: the serving process
+    # itself is the one (live, never-stale) worker.
+    status, fm = _req(server, "GET", "/api/v1/metrics?scope=fleet")
+    assert status == 200 and fm["scope"] == "fleet"
+    (wid,) = fm["workers"]
+    assert fm["workers"][wid]["stale"] is False
 
 
 def test_trace_endpoint_serves_chrome_json(server):

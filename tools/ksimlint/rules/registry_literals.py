@@ -14,6 +14,10 @@ scans every call site in the tree and checks BOTH directions:
   an enclosing phase on the timeline);
 - every ``TRACE.span("...")`` / ``TRACE.event("...")`` name is in the
   span/event taxonomy;
+- every ``_expo_family("...")`` Prometheus exposition family declared
+  in obs.py resolves into ``obs.METRIC_NAMES`` (and every registry
+  entry is declared somewhere — a family in the registry with no
+  exposition declaration would be a scrape-dashboard lie);
 - every static ``_reject("...")`` / ``_Unsupported("...")`` reason in
   engine/replay.py is in FALLBACK_REASONS (and f-string reason families
   match FALLBACK_REASON_PREFIXES); registry entries must appear in the
@@ -48,6 +52,7 @@ class RegistryConfig:
     replay_module: str = "ksim_tpu/engine/replay.py"
     faults_object: str = "FAULTS"  # <obj>.check(site)
     trace_object: str = "TRACE"  # <obj>.span(name) / <obj>.event(name)
+    metric_helper: str = "_expo_family"  # <helper>(family, kind, help)
 
 
 DEFAULT_CONFIG = RegistryConfig()
@@ -59,6 +64,8 @@ class Registries:
     sites_line: int
     span_names: tuple[str, ...]
     event_names: tuple[str, ...]
+    metric_names: tuple[str, ...]
+    metric_names_line: int
     fallback_reasons: frozenset[str]
     fallback_reasons_line: int
     fallback_prefixes: tuple[str, ...]
@@ -95,6 +102,7 @@ def load_registries(project: Project, cfg: RegistryConfig = DEFAULT_CONFIG) -> R
     sites, sites_line = _literal_assignment(faults, "SITES")
     span_names, _ = _literal_assignment(obs, "SPAN_NAMES")
     event_names, _ = _literal_assignment(obs, "EVENT_NAMES")
+    metric_names, metric_names_line = _literal_assignment(obs, "METRIC_NAMES")
     reasons, reasons_line = _literal_assignment(replay, "FALLBACK_REASONS")
     prefixes, _ = _literal_assignment(replay, "FALLBACK_REASON_PREFIXES")
     return Registries(
@@ -102,6 +110,8 @@ def load_registries(project: Project, cfg: RegistryConfig = DEFAULT_CONFIG) -> R
         sites_line=sites_line,
         span_names=tuple(span_names),
         event_names=tuple(event_names),
+        metric_names=tuple(metric_names),
+        metric_names_line=metric_names_line,
         fallback_reasons=frozenset(reasons),
         fallback_reasons_line=reasons_line,
         fallback_prefixes=tuple(prefixes),
@@ -154,6 +164,31 @@ def scan_fault_sites(
     for rel, node in _method_calls(
         project, cfg.faults_object, "check", frozenset({cfg.faults_module})
     ):
+        scan.add(rel, node)
+    return scan
+
+
+def _function_calls(project: Project, fname: str):
+    """Every bare ``<fname>(...)`` call in the tree: yields (rel, call
+    node).  The attribute-call spelling is out of scope on purpose —
+    the exposition helper is module-local by construction."""
+    for rel, sf in project.files.items():
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == fname
+            ):
+                yield rel, node
+
+
+def scan_metric_literals(
+    project: Project, cfg: RegistryConfig = DEFAULT_CONFIG
+) -> LiteralScan:
+    """Every ``_expo_family(...)`` exposition-family declaration — the
+    lint-scannable spelling of the `/metrics` surface."""
+    scan = LiteralScan()
+    for rel, node in _function_calls(project, cfg.metric_helper):
         scan.add(rel, node)
     return scan
 
@@ -281,6 +316,32 @@ def check(project: Project, cfg: RegistryConfig = DEFAULT_CONFIG) -> list[Findin
     for kind, scan_ in (("span", spans), ("event", events)):
         for rel, line in scan_.dynamic:
             flag(rel, line, f"TRACE.{kind} with a non-literal name (unverifiable)")
+
+    # -- exposition metric families --------------------------------------
+    metrics = scan_metric_literals(project, cfg)
+    metric_names = frozenset(regs.metric_names)
+    for value, locs in sorted(metrics.literals.items()):
+        if value not in metric_names:
+            for rel, line in locs:
+                flag(
+                    rel,
+                    line,
+                    f"exposition family {value!r} is not in obs.METRIC_NAMES",
+                )
+    for rel, line in metrics.dynamic:
+        flag(
+            rel,
+            line,
+            f"{cfg.metric_helper} with a non-literal family name (unverifiable)",
+        )
+    for name in regs.metric_names:
+        if name not in metrics.literals:
+            flag(
+                cfg.obs_module,
+                regs.metric_names_line,
+                f"METRIC_NAMES entry {name!r} has no {cfg.metric_helper} "
+                "declaration (dead registry entry)",
+            )
 
     # -- fallback reasons ------------------------------------------------
     fb = scan_fallback_reasons(project, cfg)

@@ -13,7 +13,8 @@ functions; this rule makes the property interprocedural (docs/lint.md
   skipped — the conservative-dispatch soundness limit.
 - **Role vocabulary** (docs/lint.md): ``main-thread``,
   ``dispatch-worker``, ``job-worker``, ``sse-handler``, ``compactor``,
-  ``service-loop``, ``fleet-poller``.  Anything else is a finding (a
+  ``service-loop``, ``fleet-poller``, ``obs-publisher``.  Anything
+  else is a finding (a
   typo'd role would silently opt out of every check below).
 - **Dispatch-worker strictness, propagated.**  The round-8 "no store to
   self" contract applies to every function reachable from a
@@ -55,6 +56,7 @@ ROLES = frozenset(
         "compactor",
         "service-loop",
         "fleet-poller",
+        "obs-publisher",
     }
 )
 

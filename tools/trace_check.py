@@ -16,7 +16,13 @@ any hardware condition, like ``make faults``), then validates:
   ksim_tpu/jobs) record job-tagged ``runner.step``/``replay.dispatch``
   spans into ISOLATED per-job trace rings (every record in a job's
   ring carries that job's id and no other's), with both jobs landing
-  identical counts.
+  identical counts;
+- a 2-worker FLEET (fifth run, the fleet observability plane —
+  docs/observability.md "Fleet observability"): every worker's
+  SIGTERM-published trace export merges into ONE Chrome trace with one
+  process lane per worker, job-tagged records attributed to the
+  owning worker's lane, and at least one complete
+  submit→claim→run flow-event triple (``s``/``t``/``f``) per job.
 
 The parent process is stdlib-only (the bench.py crash-containment
 pattern: jax backend init can wedge on a dead chip, so anything that
@@ -104,6 +110,92 @@ def _child_jobs(events: int, nodes: int, out_path: str) -> None:
             }
         )
     jm.shutdown(timeout=5)
+    with open(out_path, "w") as f:
+        json.dump(record, f)
+
+
+def _child_fleet_obs(out_path: str) -> None:
+    """A 2-worker process fleet behind an in-process front door: submit
+    two tiny jobs, SIGTERM the workers (their final telemetry publish
+    lands each worker's merged trace export in ``obs/``), then merge
+    every published trace with flow stitching for the parent's
+    lane/attribution/flow asserts."""
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    import signal
+    import tempfile as tf
+    import time
+
+    from ksim_tpu import obs
+    from ksim_tpu.jobs import JobManager
+    from tests.helpers import make_node, make_pod
+
+    jobs_dir = tf.mkdtemp(prefix="ksim_fleet_obs_")
+    workers: dict = {}
+    for wid in ("w1", "w2"):
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ksim_tpu.jobs",
+                "--dir", jobs_dir, "--worker-id", wid, "--workers", "1",
+            ],
+            cwd=_REPO, stdout=subprocess.PIPE, text=True,
+        )
+        line = proc.stdout.readline()
+        if line.strip() != f"READY {wid}":
+            raise SystemExit(f"worker {wid} never came up: {line!r}")
+        workers[wid] = proc
+    jm = JobManager(
+        workers=0, queue_limit=8, jobs_dir=jobs_dir,
+        role="frontdoor", worker_id="fd", lease_s=30.0, poll_s=0.2,
+    )
+    spec = {
+        "spec": {
+            "scenario": {
+                "operations": [
+                    {
+                        "step": 0,
+                        "createOperation": {"object": make_node("n0", cpu="4")},
+                    },
+                    {
+                        "step": 1,
+                        "createOperation": {"object": make_pod("p0", cpu="100m")},
+                    },
+                ]
+            }
+        }
+    }
+    submitted = [jm.submit(spec) for _ in range(2)]
+    deadline = time.time() + CHILD_TIMEOUT_S - 120
+    states: dict = {}
+    for job in submitted:
+        while True:
+            st = job.status()
+            if st["state"] in ("succeeded", "failed"):
+                break
+            if time.time() > deadline:
+                break
+            time.sleep(0.1)
+        states[job.id] = st
+    pids = {wid: p.pid for wid, p in workers.items()}
+    for p in workers.values():
+        p.send_signal(signal.SIGTERM)
+    for p in workers.values():
+        p.wait(timeout=60)
+    jm.shutdown()
+    traces = obs.read_fleet_traces(jobs_dir)
+    record = {
+        "worker_pids": pids,
+        "frontdoor_pid": os.getpid(),
+        "published": sorted(traces),
+        "jobs": {
+            j.id: {
+                "state": states[j.id]["state"],
+                "owner": states[j.id]["owner"],
+            }
+            for j in submitted
+        },
+        "merged": obs.merge_chrome_traces(traces, flows=True),
+    }
     with open(out_path, "w") as f:
         json.dump(record, f)
 
@@ -204,11 +296,15 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", action="store_true")
     ap.add_argument("--child-jobs", action="store_true")
+    ap.add_argument("--child-fleet-obs", action="store_true")
     ap.add_argument("--events", type=int, default=6000)
     ap.add_argument("--nodes", type=int, default=2000)
     ap.add_argument("--out", type=str, default="")
     ap.add_argument("--fleet", type=int, default=0)
     args = ap.parse_args()
+    if args.child_fleet_obs:
+        _child_fleet_obs(args.out)
+        return
     if args.child_jobs:
         _child_jobs(args.events, args.nodes, args.out)
         return
@@ -377,6 +473,96 @@ def main() -> None:
         print(
             f"trace-check: jobs run OK — 2 isolated job rings, counts "
             f"{counts_seen[0]}"
+        )
+
+        # -- run 5: a 2-worker fleet obs leg (round 19) ----------------
+        # The fleet observability plane end-to-end: two worker
+        # PROCESSES publish their trace exports at SIGTERM, the merged
+        # Chrome trace must carry one process lane per worker, every
+        # job-tagged run record must sit in its owning worker's lane,
+        # and each job must draw a complete submit->claim->run flow
+        # arrow (s/t/f triple) across the lanes.
+        result5_path = os.path.join(tmp, "result_fleet_obs.json")
+        fleet_env = dict(
+            env,
+            KSIM_TRACE="1",
+            KSIM_OBS_PUBLISH_S="5",
+            KSIM_WORKERS_POLL_S="0.2",
+            KSIM_WORKERS_LEASE_S="30",
+        )
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--child-fleet-obs", "--out", result5_path,
+        ]
+        proc = subprocess.run(
+            cmd, cwd=_REPO, env=fleet_env, timeout=CHILD_TIMEOUT_S
+        )
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"trace-check child (fleet-obs) exited rc={proc.returncode}"
+            )
+        with open(result5_path) as f:
+            result5 = json.load(f)
+        worker_pids = result5["worker_pids"]  # wid -> pid
+        jobs5 = result5["jobs"]  # jid -> {state, owner}
+        for jid, jrec in jobs5.items():
+            if jrec["state"] != "succeeded":
+                _fail(f"fleet-obs job {jid} ended {jrec['state']}")
+            if jrec["owner"] not in worker_pids:
+                _fail(
+                    f"fleet-obs job {jid} owned by {jrec['owner']!r}, "
+                    f"not a fleet worker {sorted(worker_pids)}"
+                )
+        missing = set(worker_pids) - set(result5["published"])
+        if missing:
+            _fail(f"workers never published a trace export: {sorted(missing)}")
+        merged5 = result5["merged"]["traceEvents"]
+        # One process lane per worker: exactly one process_name
+        # metadata record per worker id, all on distinct pids.
+        lanes = {}
+        for ev in merged5:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                lanes.setdefault(ev["args"]["name"], set()).add(ev["pid"])
+        for wid, pid in worker_pids.items():
+            if lanes.get(wid) != {pid}:
+                _fail(
+                    f"worker {wid} lane is {sorted(lanes.get(wid, ()))}, "
+                    f"expected exactly its pid {pid}"
+                )
+        # Job-tagged run records attribute to the OWNING worker's lane.
+        runs_seen = set()
+        for ev in merged5:
+            if ev.get("name") != "jobs.run" or ev.get("ph") != "X":
+                continue
+            jid = (ev.get("args") or {}).get("job")
+            if jid not in jobs5:
+                continue
+            owner_pid = worker_pids[jobs5[jid]["owner"]]
+            if ev.get("pid") != owner_pid:
+                _fail(
+                    f"job {jid} run record in pid {ev.get('pid')}'s lane; "
+                    f"owner {jobs5[jid]['owner']} is pid {owner_pid}"
+                )
+            runs_seen.add(jid)
+        if runs_seen != set(jobs5):
+            _fail(
+                f"merged trace lacks jobs.run spans for "
+                f"{sorted(set(jobs5) - runs_seen)}"
+            )
+        # >=1 COMPLETE submit->claim->run flow triple per job.
+        flows: dict = {}
+        for ev in merged5:
+            if ev.get("name") == "jobs.flow":
+                flows.setdefault(ev["args"]["job"], set()).add(ev["ph"])
+        for jid in jobs5:
+            if flows.get(jid) != {"s", "t", "f"}:
+                _fail(
+                    f"job {jid} flow phases {sorted(flows.get(jid, ()))}, "
+                    f"expected a complete s/t/f triple"
+                )
+        print(
+            f"trace-check: fleet-obs run OK — lanes {sorted(lanes)}, "
+            f"{len(flows)} complete flow triples"
         )
     print("trace-check: PASS")
 
