@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs restart-check shard-check mesh-check obs-check
+.PHONY: test test-tpu bench bench-tpu perf-table serve lint lock-check faults trace jobs restart-check shard-check mesh-check obs-check stream-check
 
 test:
 	$(PY) -m pytest tests/ -q --deselect tests/test_tpu_parity.py
@@ -118,6 +118,27 @@ obs-check: lint
 	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
 	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
 	'tests/test_obs_fleet.py', '-q', '-m', ''], env=sanitized_cpu_env()))"
+
+# Streaming-ingest verification (docs/scenario.md "Streaming ingest"):
+# the windowed-vs-materialized byte-identity suite (selector == batch
+# resample on shuffled input, window-boundary splits, producer-fault
+# degradation, mid-read bound refusal), the streaming behavior-lock leg
+# (borg_mini through tiny windows on both paths), and the churn_stream
+# bench rung evidence (mid-run RSS watermark, events/sec, counts_match,
+# dead-device one-JSON-line).  Sanitized CPU env so it runs under ANY
+# hardware condition; gated on lint because the trace-ingest
+# thread-role and the traces.stream span/site registrations are
+# exactly what the analyzer checks.
+stream-check: lint
+	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
+	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
+	'tests/test_traces_stream.py', \
+	'tests/test_behavior_locks.py::test_trace_lock_borg_mini_holds_with_streaming_ingest', \
+	'-q'], env=sanitized_cpu_env()))"
+	$(PY) -c "import subprocess, sys; from tests.helpers import sanitized_cpu_env; \
+	sys.exit(subprocess.call([sys.executable, '-m', 'pytest', \
+	'tests/test_bench.py', '-q', '-k', 'churn_stream'], \
+	env=sanitized_cpu_env()))"
 
 test-tpu:
 	$(PY) -m pytest tests/test_tpu_parity.py -q -rs

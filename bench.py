@@ -1295,6 +1295,134 @@ def child_churn_trace(
     return out
 
 
+def child_churn_stream(
+    seed: int,
+    records: int,
+    nodes: int,
+    ops_per_step: int,
+    max_events: int,
+    window: int,
+    queue_windows: int,
+) -> dict:
+    """Streaming-ingest rung (round 22, ksim_tpu/traces/stream): a
+    synthetic Borg JSONL generated in-child (deterministic from
+    ``seed``; SUBMIT/FINISH pairs so every record carries a lifetime)
+    is replayed through the windowed streaming pipeline — parse ->
+    resample -> compile feeding the device executor window-by-window —
+    and then through the materialized path for the byte-identity check.
+    Evidence the record must carry: ``rss_after_stream_kb``, the VmHWM
+    snapshot taken IMMEDIATELY after the streaming replay and BEFORE
+    the materialized comparison (the O(window) peak-memory claim — the
+    parent stage ratios it across a 10x stream-growth leg),
+    ``events_per_sec`` (events applied over the end-to-end streaming
+    wall, ingest included — the headline), the producer stats
+    (windows/queue_peak/fallback), and ``counts_match`` between the
+    streamed and materialized runs."""
+    import random
+
+    import jax
+
+    from ksim_tpu.scenario import ScenarioRunner
+    from ksim_tpu.traces import stream_trace_operations, trace_operations
+
+    _child_setup()
+    jax.config.update("jax_enable_x64", False)
+    rng = random.Random(seed)
+    tmp_dir = tempfile.mkdtemp(prefix="bench_stream_")
+    try:
+        path = os.path.join(tmp_dir, "synthetic_borg.jsonl")
+        t_us = 0
+        with open(path, "w") as f:
+            for i in range(records):
+                t_us += rng.randrange(1_000, 50_000)
+                # Lifetimes stay SHORT relative to the trace span
+                # (records x ~25 ms mean interarrival) so FINISH
+                # deletes interleave with arrivals and the LIVE pod
+                # population stays bounded: the rung's RSS ratio must
+                # measure ingest memory (O(window) vs O(stream)), not
+                # cluster-saturation memory from a workload whose pods
+                # never complete in-span.
+                life_us = rng.randrange(500_000, 60_000_000)
+                req = {
+                    "cpus": rng.choice((0.01, 0.025, 0.05, 0.1)),
+                    "memory": rng.choice((0.005, 0.01, 0.02, 0.05)),
+                }
+                f.write(json.dumps({
+                    "time": t_us, "type": "SUBMIT", "collection_id": i,
+                    "instance_index": 0,
+                    "priority": rng.choice((0, 103, 117, 200, 360)),
+                    "resource_request": req,
+                }) + "\n")
+                f.write(json.dumps({
+                    "time": t_us + life_us, "type": "FINISH",
+                    "collection_id": i, "instance_index": 0,
+                }) + "\n")
+        # The decompressed-byte guard exists for untrusted registry
+        # uploads; this child generated the file itself, and the
+        # 10x-source leg legitimately exceeds the 64 MiB default.
+        os.environ["KSIM_TRACES_MAX_BYTES"] = str(
+            os.path.getsize(path) + 1_048_576
+        )
+        t0 = time.perf_counter()
+        stream = stream_trace_operations(
+            path, "borg", nodes=nodes, max_events=max_events, seed=seed,
+            ops_per_step=ops_per_step, window=window or None,
+            queue_windows=queue_windows or None,
+        )
+        dev = ScenarioRunner(pod_bucket_min=64, device_replay=True)
+        rs = dev.run(stream)
+        stream_wall = time.perf_counter() - t0
+        sstats = stream.stats()
+        drv = dev.replay_driver
+        # The peak-memory evidence: VmHWM NOW, before the materialized
+        # comparison run hoists the whole operation list into memory.
+        rss_after_stream_kb = _proc_watermarks().get("rss_peak_kb")
+        ops = trace_operations(
+            path, "borg", nodes=nodes, max_events=max_events, seed=seed,
+            ops_per_step=ops_per_step,
+        )
+        mat = ScenarioRunner(pod_bucket_min=64, device_replay=True)
+        rm = mat.run(list(ops))
+        stream_counts = [rs.pods_scheduled, rs.unschedulable_attempts]
+        mat_counts = [rm.pods_scheduled, rm.unschedulable_attempts]
+        out = {
+            "records": records,
+            "max_events": max_events,
+            "nodes": nodes,
+            "window_ops": sstats["window_ops"],
+            "queue_windows": sstats["queue_windows"],
+            "windows": sstats["windows"],
+            "queue_peak": sstats["queue_peak"],
+            "ingest_fallback": sstats["fallback"],
+            "events": rs.events_applied,
+            "steps": len(rs.steps),
+            "wall_s": round(stream_wall, 3),
+            "events_per_sec": (
+                round(rs.events_applied / stream_wall, 1)
+                if stream_wall > 0 else None
+            ),
+            "rss_after_stream_kb": rss_after_stream_kb,
+            "ingest_prefetches": (
+                drv.stats().get("ingest_prefetches") if drv else None
+            ),
+            "counts": stream_counts,
+            "materialized_counts": mat_counts,
+            "counts_match": stream_counts == mat_counts,
+            "platform": jax.devices()[0].platform,
+        }
+    finally:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+    print(
+        f"[churn_stream {records}rec/{max_events}ev] "
+        f"{out['events']} events in {out['wall_s']}s "
+        f"({out['events_per_sec']}/s) rss_after_stream={rss_after_stream_kb}kB "
+        f"windows={out['windows']} match={out['counts_match']}",
+        file=sys.stderr,
+        flush=True,
+    )
+    return out
+
+
 def _proc_watermarks() -> dict:
     """This process's /proc watermarks (stdlib + procfs only, guarded
     for non-Linux): the memory-map count — XLA:CPU executables each mmap
@@ -1403,6 +1531,16 @@ def _child_main(args: argparse.Namespace) -> None:
                 args.trace_nodes,
                 args.trace_ops_per_step,
                 args.trace_max_events,
+            )
+        elif args.child == "churn_stream":
+            out = child_churn_stream(
+                args.seed,
+                args.stream_records,
+                args.stream_nodes,
+                args.stream_ops_per_step,
+                args.stream_max_events,
+                args.stream_window,
+                args.stream_queue,
             )
         else:  # pragma: no cover
             raise ValueError(f"unknown child mode {args.child!r}")
@@ -1642,6 +1780,12 @@ def main() -> None:
     ap.add_argument("--trace-nodes", type=int, default=24)
     ap.add_argument("--trace-ops-per-step", type=int, default=2)
     ap.add_argument("--trace-max-events", type=int, default=0)
+    ap.add_argument("--stream-records", type=int, default=30_000)
+    ap.add_argument("--stream-max-events", type=int, default=2_500)
+    ap.add_argument("--stream-nodes", type=int, default=64)
+    ap.add_argument("--stream-ops-per-step", type=int, default=100)
+    ap.add_argument("--stream-window", type=int, default=0)
+    ap.add_argument("--stream-queue", type=int, default=0)
     try:
         default_budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     except ValueError:
@@ -1658,7 +1802,7 @@ def main() -> None:
         choices=[
             "probe", "rung", "churn", "churn_shard", "churn_fleet",
             "churn_fleet_shard", "churn_jobs", "churn_workers",
-            "churn_trace", "churn_restart", "churn_resume",
+            "churn_trace", "churn_stream", "churn_restart", "churn_resume",
         ],
         default=None,
     )
@@ -2074,6 +2218,75 @@ def main() -> None:
             mode="churn_trace",
         )
 
+    def run_churn_stream_stage() -> None:
+        """Streaming-ingest rung (round 22): the SAME streaming child at
+        three sizings, each leg a fresh child snapshotting its RSS
+        high-water mark right after the streaming replay.  ``cold`` is
+        the base sizing; ``large_source`` grows the RAW stream 10x at
+        the SAME resample budget — the replayed schedule stays
+        budget-sized, so the leg isolates INGEST memory and ``rss_ratio``
+        (large_source over cold, acceptance bound <= 1.3) is the
+        O(window + budget) peak-memory claim (a materializing ingest
+        would hold 10x the parsed records); ``large_budget`` grows the
+        resample budget 10x instead for the ``events_per_sec``
+        headline under sustained ingest ∥ replay overlap (its RSS is
+        NOT the memory claim: replaying 10x the events legitimately
+        grows live-cluster state and compiled shapes).  A combined
+        ``counts_match`` pins streamed == materialized on all legs."""
+        if args.skip_churn or args.only:
+            return
+        if orch.remaining() < 200:
+            payload["rungs"]["churn_stream"] = {"error": "skipped: budget exhausted"}
+            return
+
+        def leg_args(records: int, max_events: int) -> list:
+            return [
+                "--seed", str(args.seed),
+                "--stream-records", str(records),
+                "--stream-max-events", str(max_events),
+                "--stream-nodes", str(args.stream_nodes),
+                "--stream-ops-per-step", str(args.stream_ops_per_step),
+                "--stream-window", str(args.stream_window),
+                "--stream-queue", str(args.stream_queue),
+            ]
+
+        cold = orch.run_child(
+            "churn_stream",
+            leg_args(args.stream_records, args.stream_max_events),
+            env,
+            CHURN_TIMEOUT,
+        )
+        record: dict = {"cold": cold}
+        match = bool(cold.get("counts_match"))
+        if "error" not in cold and orch.remaining() > 150:
+            src = orch.run_child(
+                "churn_stream",
+                leg_args(args.stream_records * 10, args.stream_max_events),
+                env,
+                CHURN_TIMEOUT,
+            )
+            record["large_source"] = src
+            if "error" not in src:
+                ck = cold.get("rss_after_stream_kb")
+                lk = src.get("rss_after_stream_kb")
+                if ck and lk:
+                    record["rss_ratio"] = round(lk / ck, 3)
+                match = match and bool(src.get("counts_match"))
+        if "error" not in cold and orch.remaining() > 120:
+            big = orch.run_child(
+                "churn_stream",
+                leg_args(args.stream_records, args.stream_max_events * 10),
+                env,
+                CHURN_TIMEOUT,
+            )
+            record["large_budget"] = big
+            if "error" not in big:
+                record["events_per_sec"] = big.get("events_per_sec")
+                match = match and bool(big.get("counts_match"))
+        record["counts_match"] = match
+        payload["rungs"]["churn_stream"] = record
+        orch.flush_partial()
+
     def run_churn_restart_stage() -> None:
         """Warm-restart rung (round 15): the SAME restart child twice
         over one shared persistent-executable dir — cold (empty dir:
@@ -2228,6 +2441,7 @@ def main() -> None:
     run_churn_jobs_stage()
     run_churn_workers_stage()
     run_churn_trace_stage()
+    run_churn_stream_stage()
     run_churn_restart_stage()
     run_churn_resume_stage()
     run_churn_exact_stage()

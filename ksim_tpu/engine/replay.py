@@ -1371,6 +1371,7 @@ class ReplayDriver:
         requeue_on_node_delete: bool = True,
         lane: "int | None" = None,
         lane_faults=None,
+        ingest_hook=None,
     ) -> None:
         self.store = store
         self.service = service
@@ -1405,6 +1406,13 @@ class ReplayDriver:
         self.device_steps = 0  # guarded-by: main-thread
         self.fallback_steps = 0  # guarded-by: main-thread
         self.device_round_trips = 0  # guarded-by: main-thread
+        # Streaming ingest overlap (round 22, traces/stream.py): a
+        # runner-provided NONBLOCKING drain of the trace-ingest queue,
+        # called on the main thread while the dispatch worker owns the
+        # device — the third stage of the ingest ∥ prelower ∥ dispatch
+        # pipeline.  None for materialized runs.
+        self._ingest_hook = ingest_hook
+        self.ingest_prefetches = 0  # guarded-by: main-thread
         self.unsupported: dict[str, int] = {}  # guarded-by: main-thread
         # Failure-containment state — PER DRIVER, never process-global
         # (two runners in one process must not trip each other's
@@ -1511,6 +1519,7 @@ class ReplayDriver:
             "device_steps": self.device_steps,
             "fallback_steps": self.fallback_steps,
             "device_round_trips": self.device_round_trips,
+            "ingest_prefetches": self.ingest_prefetches,
             "device_errors": self.device_errors,
             "watchdog_timeouts": self.watchdog_timeouts,
             "breaker_tripped": self.breaker_tripped,
@@ -2092,6 +2101,7 @@ class ReplayDriver:
             # No worker to overlap with; the parse/memo warm still moves
             # off the next window's replay.lower span.
             self._prelower_next(plan, future)
+            self._drain_ingest()
             return out
         box: dict[str, Any] = {}
         # A job-scoped caller's trace override is thread-local; carry it
@@ -2110,6 +2120,7 @@ class ReplayDriver:
         t.start()
         t0 = time.monotonic()
         self._prelower_next(plan, future)
+        self._drain_ingest()
         t.join(max(self.watchdog_s - (time.monotonic() - t0), 0.001))
         if t.is_alive():
             self.watchdog_timeouts += 1
@@ -2124,6 +2135,28 @@ class ReplayDriver:
         if "err" in box:
             raise box["err"]
         return box["out"]
+
+    def _drain_ingest(self) -> None:
+        """Pull whatever the trace-ingest producer has ready (a bounded,
+        nonblocking window drain) while the dispatch worker owns the
+        device.  Errors other than cancellation are swallowed HERE on
+        purpose: a mid-dispatch raise would be misclassified by the
+        device-error ladder (or strand the un-joined worker), and the
+        same error re-raises deterministically at the runner's next
+        blocking ensure."""
+        if self._ingest_hook is None:
+            return
+        try:
+            self._ingest_hook()
+            self.ingest_prefetches += 1
+        except RunCancelled:
+            raise
+        except Exception:
+            logger.debug(
+                "ingest prefetch hook failed; deferring to the blocking "
+                "ingest path",
+                exc_info=True,
+            )
 
     def _note_device_error(self, e: BaseException) -> None:
         """Account one degraded dispatch; trip the breaker on the Nth

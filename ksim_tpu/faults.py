@@ -96,6 +96,7 @@ SITES: tuple[str, ...] = (
     "jobs.checkpoint_restore",
     "jobs.lease_claim",
     "jobs.lease_renew",
+    "traces.stream",
 )
 
 

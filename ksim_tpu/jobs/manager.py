@@ -35,8 +35,11 @@ bounds-aware:
   convoy 6k jobs behind it;
 - per-submission resource bounds (``KSIM_JOBS_MAX_EVENTS`` /
   ``KSIM_JOBS_MAX_NODES``) refuse oversized specs at POST time with
-  ``JobLimitExceeded`` (HTTP 413) — measured AFTER trace ingestion, so
-  a trace-sourced job is bounded by what it would actually replay;
+  ``JobLimitExceeded`` (HTTP 413) — measured against what the job would
+  actually replay.  Trace-sourced specs are refused DURING streaming
+  ingest (``TraceBoundExceeded`` from traces/resample.py's monotone
+  lower bound): the server stops reading the trace at the first proof
+  of excess instead of compiling the whole stream first;
 - scenarios may reference REGISTERED traces by name
   (``spec.scenario.source.trace.name`` resolved in the operator's
   ``KSIM_TRACES_DIR`` — ksim_tpu/traces/registry.py); raw ``path``
@@ -231,7 +234,9 @@ def _spec_hash(sim: dict) -> str:
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
 
 
-def _parse_job_spec(doc: Any) -> tuple[list, dict, int, str]:
+def _parse_job_spec(
+    doc: Any, *, event_bound: int = 0, node_bound: int = 0
+) -> tuple[list, dict, int, str]:
     """Validate a tenant job document -> (operations, simulator spec,
     priority, canonical fault spec).  Accepts the
     SchedulerSimulation-ish shape::
@@ -250,7 +255,12 @@ def _parse_job_spec(doc: Any) -> tuple[list, dict, int, str]:
     tenants must not make the server read its own filesystem (the
     KEP-184 mounted-file workflow is the operator's
     ``cmd/simulation.py``, not this surface); trace references resolve
-    by REGISTERED NAME only (``_tenant_trace_resolver``)."""
+    by REGISTERED NAME only (``_tenant_trace_resolver``).
+
+    ``event_bound`` / ``node_bound`` flow into the streaming trace
+    ingest (traces/stream + resample): a trace-sourced spec that
+    provably exceeds either bound raises ``TraceBoundExceeded``
+    mid-read, before the rest of the trace is consumed."""
     from ksim_tpu.scenario.spec import (
         ScenarioSpecError,
         faults_spec_from_doc,
@@ -293,7 +303,12 @@ def _parse_job_spec(doc: Any) -> tuple[list, dict, int, str]:
             "job spec needs an inline scenario (spec.scenario.operations "
             "or spec.scenario.source.trace)"
         )
-    ops = operations_from_spec(scenario, trace_resolver=_tenant_trace_resolver)
+    ops = operations_from_spec(
+        scenario,
+        trace_resolver=_tenant_trace_resolver,
+        event_bound=event_bound,
+        node_bound=node_bound,
+    )
     fault_spec = faults_spec_from_doc(doc)
     if fault_spec:
         for part in fault_spec.split(","):
@@ -1194,15 +1209,37 @@ class JobManager:
         lock, so concurrent submits cannot interleave ordinals with
         rejections; lock order is ``_lock`` → ``queue._cond`` →
         ``job._cond``, matching every other path."""
-        ops, sim, spec_priority, fault_spec = _parse_job_spec(doc)
+        from ksim_tpu.traces.schema import TraceBoundExceeded
+
+        try:
+            ops, sim, spec_priority, fault_spec = _parse_job_spec(
+                doc,
+                event_bound=self._max_job_events,
+                node_bound=self._max_job_nodes,
+            )
+        except TraceBoundExceeded as e:
+            # Streaming ingest proved the bound exceeded MID-READ and
+            # stopped consuming trace bytes; translate to the job
+            # plane's vocabulary (HTTP 413, same as the post-parse
+            # checks below).
+            env = (
+                "KSIM_JOBS_MAX_EVENTS"
+                if e.kind == "events"
+                else "KSIM_JOBS_MAX_NODES"
+            )
+            raise JobLimitExceeded(
+                f"job trace compiles to at least {e.observed} {e.kind}, "
+                f"over the per-job bound of {e.limit} ({env}); ingest "
+                "stopped early"
+            ) from None
         if priority is None:
             priority = spec_priority
         if tenant is None:
             scope = (doc.get("spec") or doc) if isinstance(doc, dict) else {}
             tenant = str(scope.get("tenant") or "") or "default"
-        # Resource bounds, AFTER parsing/ingestion: what is measured is
-        # the stream the job would actually replay (a trace-sourced job
-        # is bounded by its compiled size, not its reference's).
+        # Resource bounds for inline specs (trace-sourced specs are
+        # bounded during streaming ingest above): what is measured is
+        # the stream the job would actually replay.
         if self._max_job_events and len(ops) > self._max_job_events:
             raise JobLimitExceeded(
                 f"job spec compiles to {len(ops)} events, over the "

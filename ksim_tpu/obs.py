@@ -139,6 +139,12 @@ SPAN_NAMES: tuple[str, ...] = (
     #                     worker's published snapshot (or Chrome trace)
     #                     into the merged document (merge_fleet_docs /
     #                     merge_chrome_traces below)
+    "traces.stream",  # one streaming trace ingestion on the producer
+    #                   thread: parse + bounded-memory select + windowed
+    #                   compile feeding the replay executor
+    #                   (ksim_tpu/traces/stream.py; args carry
+    #                   format/windows/ops — overlaps the replay it
+    #                   feeds by construction)
 )
 
 #: Instant event names.
@@ -202,6 +208,12 @@ EVENT_NAMES: tuple[str, ...] = (
     #                        (args: worker / stale_s — the dead worker
     #                        is FLAGGED in the merged doc, never
     #                        silently dropped)
+    "traces.ingest_fallback",  # the streaming producer degraded to the
+    #                            materialized ingest path (args.reason —
+    #                            an armed fault or unexpected error
+    #                            before the first window; counts stay
+    #                            byte-identical, only the O(window)
+    #                            memory claim is forfeited for this run)
 )
 
 _KNOWN_NAMES = frozenset(SPAN_NAMES) | frozenset(EVENT_NAMES)

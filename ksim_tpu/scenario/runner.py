@@ -74,6 +74,93 @@ class ScenarioResult:
         return self.events_applied / self.wall_seconds if self.wall_seconds else 0.0
 
 
+class _StreamFeeder:
+    """Incremental step-grouper over a streaming operation source
+    (traces/stream.py ``TraceOperationStream``): the windowed twin of
+    ``ScenarioRunner._group_by_step``.
+
+    ``keys``/``by_step`` grow as windows arrive; a step is COMPLETE (and
+    appended to ``keys``) only once a later step's first operation — or
+    EOF — proves no more operations belong to it.  Batch lists keep
+    their object identity for as long as they are resident: the replay
+    driver's speculative-prelower match (engine/replay.py ``_take_spec``)
+    is identity-based, so ``by_step[s]`` must return the SAME list every
+    iteration.  ``release`` evicts batches the run has committed past —
+    that eviction is the O(window) half of the memory claim; the step
+    keys themselves (small ints) are kept for cursor arithmetic.
+
+    ``ensure`` BLOCKS on the producer queue; ``prefetch`` never blocks
+    and is the replay driver's ingest-hook entry (drain while the
+    device dispatch is in flight).  Both run on the consumer (main)
+    thread only."""
+
+    def __init__(self, stream) -> None:
+        self._stream = stream
+        self._it = iter(stream)
+        self.keys: list[int] = []  # complete steps, ascending
+        self.by_step: dict[int, list[Operation]] = {}
+        self._open_step: "int | None" = None
+        self._open_batch: "list[Operation] | None" = None
+        self._eof = False
+        self._released = 0  # keys-index cursor: everything below is evicted
+
+    def _accept(self, op: Operation) -> None:
+        if self._open_step is None or op.step > self._open_step:
+            if self._open_step is not None:
+                self._seal()
+            elif self.keys and op.step <= self.keys[-1]:
+                raise ValueError(
+                    f"streaming operations out of step order: step {op.step} "
+                    f"after step {self.keys[-1]} was sealed"
+                )
+            self._open_step = op.step
+            self._open_batch = [op]
+        elif op.step == self._open_step:
+            self._open_batch.append(op)
+        else:
+            raise ValueError(
+                f"streaming operations out of step order: step {op.step} "
+                f"after step {self._open_step}"
+            )
+
+    def _seal(self) -> None:
+        self.by_step[self._open_step] = self._open_batch
+        self.keys.append(self._open_step)
+        self._open_step = None
+        self._open_batch = None
+
+    def ensure(self, n: int) -> None:
+        """Block until ``n`` complete steps exist or the stream ends."""
+        while len(self.keys) < n and not self._eof:
+            try:
+                op = next(self._it)
+            except StopIteration:
+                self._eof = True
+                if self._open_step is not None:
+                    self._seal()
+                return
+            self._accept(op)
+
+    def prefetch(self, n: int) -> int:
+        """Drain whatever the producer has READY toward ``n`` complete
+        steps; never blocks.  Producer-side errors are deferred: they
+        re-raise at the next blocking ``ensure``."""
+        pulled = 0
+        while len(self.keys) < n and not self._eof:
+            op = self._stream.next_nowait()
+            if op is None:
+                break
+            self._accept(op)
+            pulled += 1
+        return pulled
+
+    def release(self, upto: int) -> None:
+        """Evict committed step batches (keys indices below ``upto``)."""
+        while self._released < min(upto, len(self.keys)):
+            self.by_step.pop(self.keys[self._released], None)
+            self._released += 1
+
+
 class ScenarioRunner:
     """Replays an operation stream against a store + scheduler service.
 
@@ -550,7 +637,31 @@ class ScenarioRunner:
         With ``fleet=S`` the stream replays on every lane (``lane_ops``
         overrides individual lanes' streams — those lanes run the solo
         device path, outside the shared-universe cohort) and the result
-        carries the per-lane results on ``.lanes``."""
+        carries the per-lane results on ``.lanes``.
+
+        A STREAMING source (``ops.streaming_ops`` — traces/stream.py)
+        takes the windowed loop: operations are consumed as the
+        producer emits them, never materialized whole, with ingest
+        overlapping the in-flight device dispatch.  Streaming is the
+        solo fresh-run path: fleet replays and incremental resume both
+        need the full sorted step-key index up front."""
+        if getattr(ops, "streaming_ops", False):
+            if self._fleet is not None or lane_ops:
+                raise ValueError(
+                    "streaming ingest is the solo-run path (fleet replay "
+                    "materializes its lanes)"
+                )
+            if resume_cursor or resume_result is not None:
+                raise ValueError(
+                    "incremental resume needs materialized operations "
+                    "(a resume cursor indexes the full sorted step-key list)"
+                )
+            if self._checkpoint_hook is not None:
+                raise ValueError(
+                    "checkpoint_hook needs materialized operations (its "
+                    "cursor must stay valid for a later resume)"
+                )
+            return self._run_streaming(ops)
         if self._fleet is not None:
             if resume_cursor or resume_result is not None:
                 raise ValueError(
@@ -632,6 +743,90 @@ class ScenarioRunner:
                 result.phase_counts[name] = count - prev_count
         return result
 
+    def _run_streaming(self, stream) -> ScenarioResult:
+        """The windowed twin of ``run``'s solo loop: a ``_StreamFeeder``
+        stands in for the materialized ``by_step``/``keys`` view, the
+        replay driver's ``ingest_hook`` drains ready windows while each
+        dispatch is in flight (ingest ∥ prelower ∥ dispatch), and
+        committed step batches are evicted as the cursor advances —
+        peak host memory is O(window + lookahead), not O(stream).  The
+        schedule itself is byte-identical to the materialized run: the
+        feeder groups the same operations into the same step batches,
+        only their lifetime in memory changes."""
+        result = ScenarioResult()
+        TRACE.ensure_timing()
+        phase0 = TRACE.phase_totals()
+        t0 = time.perf_counter()
+        feeder = _StreamFeeder(stream)
+        driver = None
+        try:
+            if self._device_replay:
+                from ksim_tpu.engine.replay import SEGMENT_STEPS, ReplayDriver
+
+                # The hook's prefetch target is re-aimed every iteration:
+                # 4·k steps past the cursor bounds the opportunistic
+                # drain, so overlap never turns back into O(stream)
+                # buffering on the consumer side.
+                target = [0]
+                driver = ReplayDriver(
+                    self.store,
+                    self.service,
+                    k=self._device_segment_steps or SEGMENT_STEPS,
+                    requeue_on_node_delete=self._requeue,
+                    lane_faults=self._lane_faults,
+                    ingest_hook=lambda: feeder.prefetch(target[0]),
+                )
+                self.replay_driver = driver
+            i = 0
+            while True:
+                self._check_cancelled()
+                if driver is not None:
+                    # The same 2-window lookahead the materialized loop
+                    # slices out of ``keys`` — blocking here is the
+                    # backpressure point when replay outruns ingest.
+                    feeder.ensure(i + 2 * driver.k)
+                    target[0] = i + 4 * driver.k
+                else:
+                    feeder.ensure(i + 1)
+                if i >= len(feeder.keys):
+                    break
+                if driver is not None:
+                    batches = [
+                        feeder.by_step[s]
+                        for s in feeder.keys[i : i + 2 * driver.k]
+                    ]
+                    seg = driver.try_segment(batches)
+                    if seg is not None and self._commit_segment(
+                        feeder.keys[i : i + len(seg.steps)],
+                        batches[: len(seg.steps)],
+                        seg,
+                        driver,
+                        result,
+                    ):
+                        i += len(seg.steps)
+                        feeder.release(i)
+                        continue
+                step = feeder.keys[i]
+                if driver is not None:
+                    driver.fallback_steps += 1
+                done = self._run_step(step, feeder.by_step[step], result)
+                i += 1
+                feeder.release(i)
+                if done:
+                    result.succeeded = True
+                    break
+        finally:
+            # An abandoned producer blocked on a full queue would leak;
+            # close() is idempotent and also covers clean exhaustion.
+            stream.close()
+        result.wall_seconds = time.perf_counter() - t0
+        for name, (total, count) in TRACE.phase_totals().items():
+            prev_total, prev_count = phase0.get(name, (0.0, 0))
+            if count > prev_count:
+                result.phase_seconds[name] = round(total - prev_total, 6)
+                result.phase_counts[name] = count - prev_count
+        return result
+
     @staticmethod
     def _group_by_step(ops: Iterable[Operation]) -> tuple[dict, list]:
         by_step: dict[int, list[Operation]] = {}
@@ -665,6 +860,11 @@ class ScenarioRunner:
             if bad:
                 raise ValueError(
                     f"lane_ops lanes {bad} outside the fleet (0..{n - 1})"
+                )
+            if any(getattr(v, "streaming_ops", False) for v in lane_ops.values()):
+                raise ValueError(
+                    "streaming ingest is the solo-run path (lane_ops streams "
+                    "must be materialized)"
                 )
         spec = self._fleet_faults
         if spec is None:

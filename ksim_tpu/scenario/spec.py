@@ -114,9 +114,11 @@ def default_trace_resolver(trace_doc: JSONObj) -> str:
     return resolve(str(name))
 
 
-def _operations_from_source(src: JSONObj, trace_resolver) -> list[Operation]:
+def _operations_from_source(
+    src: JSONObj, trace_resolver, *, event_bound: int = 0, node_bound: int = 0
+) -> list[Operation]:
     from ksim_tpu.traces.compile import TRACE_FORMATS, trace_operations
-    from ksim_tpu.traces.schema import TraceError
+    from ksim_tpu.traces.schema import TraceBoundExceeded, TraceError
 
     if not isinstance(src, dict) or set(src) != {"trace"}:
         raise ScenarioSpecError(
@@ -151,7 +153,15 @@ def _operations_from_source(src: JSONObj, trace_resolver) -> list[Operation]:
             seed=seed,
             ops_per_step=ops_per_step,
             source_nodes=source_nodes,
+            event_bound=event_bound,
+            node_bound=node_bound,
         )
+    except TraceBoundExceeded:
+        # NOT a bad document: the caller's size limit fired mid-read.
+        # The jobs plane owns this vocabulary (JobLimitExceeded, HTTP
+        # 413) — folding it into ScenarioSpecError would turn a quota
+        # refusal into a 400.
+        raise
     except TraceError as e:
         # One failure vocabulary at this surface: a bad trace reference
         # or corrupt file is a bad SCENARIO document (HTTP 400), not a
@@ -196,14 +206,22 @@ def faults_spec_from_doc(doc: JSONObj) -> str:
 
 
 def operations_from_spec(
-    doc: JSONObj, *, trace_resolver=None
+    doc: JSONObj, *, trace_resolver=None, event_bound: int = 0, node_bound: int = 0
 ) -> list[Operation]:
     """Lower a Scenario document (or bare ``{"operations": [...]}``) to
     the runner's Operation list, sorted by step (stable within a step,
     like the KEP's per-MajorStep batches).  A document may instead
     carry ``spec.source.trace`` (exactly one of the two): the named
     trace is ingested through ``trace_resolver`` (default: explicit
-    path, else the ``KSIM_TRACES_DIR`` registry)."""
+    path, else the ``KSIM_TRACES_DIR`` registry).
+
+    ``event_bound``/``node_bound`` (0 = unbounded) arm the trace-ingest
+    plane's EARLY size refusal: ingestion raises ``TraceBoundExceeded``
+    — deliberately NOT mapped onto ``ScenarioSpecError`` — the moment
+    the compiled size provably passes the bound, so the caller (the
+    jobs plane) refuses mid-read instead of after full parse+compile.
+    Inline ``spec.operations`` documents are unaffected (the caller
+    checks their materialized size as before)."""
     spec = doc.get("spec") or doc
     raw_ops = spec.get("operations")
     source = spec.get("source")
@@ -213,7 +231,9 @@ def operations_from_spec(
                 "document has both spec.operations and spec.source — "
                 "exactly one must be present"
             )
-        return _operations_from_source(source, trace_resolver)
+        return _operations_from_source(
+            source, trace_resolver, event_bound=event_bound, node_bound=node_bound
+        )
     if raw_ops is None:
         raise ScenarioSpecError("document has no spec.operations")
     out: list[Operation] = []

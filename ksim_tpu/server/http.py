@@ -51,12 +51,13 @@ job plane (docs/jobs.md):
                                            running: cooperative, the
                                            in-flight segment rolls
                                            back)
-    GET    /api/v1/traces               -> trace names registered in
-                                           the operator's
-                                           KSIM_TRACES_DIR (what a
-                                           tenant may reference as
-                                           scenario source.trace.name
-                                           — docs/scenario.md)
+    GET    /api/v1/traces               -> traces registered in the
+                                           operator's KSIM_TRACES_DIR
+                                           (what a tenant may reference
+                                           as scenario source.trace.name
+                                           — docs/scenario.md), with
+                                           per-entry size_bytes / gzip /
+                                           detected-format metadata
 
 CORS headers come from ``cors_allowed_origins`` (the reference reads them
 from config, server.go:28-32)."""
@@ -258,10 +259,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, TRACE.export_chrome())
         elif url.path == "/api/v1/traces":
             # The named-trace registry (ksim_tpu/traces/registry.py):
-            # names only — resolution and parsing stay server-side.
-            from ksim_tpu.traces.registry import list_traces
+            # names plus advisory metadata — resolution and parsing
+            # stay server-side, and the detected format never overrides
+            # the format a job spec names explicitly.
+            from ksim_tpu.traces.registry import list_trace_entries
 
-            self._json(200, {"items": list_traces()})
+            self._json(200, {"items": list_trace_entries()})
         elif url.path == "/api/v1/waitingpods":
             # Permit-parked pods (the framework handle's waiting-pod view).
             self._json(200, {"items": self.server.di.scheduler_service.get_waiting_pods()})

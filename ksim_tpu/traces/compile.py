@@ -50,8 +50,8 @@ import re
 from typing import TYPE_CHECKING, Iterable, Sequence
 
 from ksim_tpu.obs import TRACE
-from ksim_tpu.traces.resample import resample
-from ksim_tpu.traces.schema import TraceError, TraceRecord
+from ksim_tpu.traces.resample import StreamSelector
+from ksim_tpu.traces.schema import TraceBoundExceeded, TraceError, TraceRecord
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from ksim_tpu.scenario.runner import Operation
@@ -147,6 +147,92 @@ def _pod_name(seq: int, rec: TraceRecord) -> str:
     return f"p{seq:05d}-{san}"
 
 
+def _validate_compile_args(
+    records: Sequence[TraceRecord], n_nodes: int, ops_per_step: int
+) -> None:
+    if n_nodes <= 0:
+        raise TraceError("n_nodes must be positive")
+    if ops_per_step <= 0:
+        raise TraceError("ops_per_step must be positive")
+    if not records:
+        raise TraceError("trace compiled to zero records")
+
+
+def _node_ops(n_nodes: int, seed: int) -> "list[Operation]":
+    """The step-0 node bootstrap: the whole fleet, sizes drawn
+    seed-deterministically in node-index order (the rng draw SEQUENCE
+    is part of the byte-identity contract)."""
+    import random
+
+    from ksim_tpu.scenario.runner import Operation
+
+    rng = random.Random(seed)
+    return [
+        Operation(
+            step=0,
+            op="create",
+            kind="nodes",
+            obj=_mk_node(rng, f"node-{i}", _ZONES[i % len(_ZONES)]),
+        )
+        for i in range(n_nodes)
+    ]
+
+
+class _EventLayout:
+    """The (step, phase, seq) grid pod events sort on, factored out of
+    ``compile_trace`` so the streaming producer (traces/stream.py) can
+    materialize the SAME operation list window-by-window: keys are tiny
+    tuples computed up front (O(selected events)), operations are built
+    one window at a time from the key order.  ``records`` must already
+    be in resample's sorted order — ``seq`` indexes into it and names
+    the pods."""
+
+    def __init__(self, records: Sequence[TraceRecord], ops_per_step: int) -> None:
+        self.records = records
+        self.t0 = min(r.arrival_s for r in records)
+        span = max(r.arrival_s for r in records) - self.t0
+        n_pod_events = sum(2 if r.lifetime_s > 0 else 1 for r in records)
+        self.n_steps = max(1, round(n_pod_events / ops_per_step))
+        self.tick = (span / self.n_steps) or 1.0
+
+    def _step_of(self, t: float, horizon: int) -> int:
+        return 1 + min(int((t - self.t0) / self.tick), horizon)
+
+    def keys(self) -> "list[tuple[int, int, int]]":
+        """Sorted (step, phase, seq) keys: creates (phase 0) in arrival
+        order, then deletes (phase 1) in end-time order — a same-step
+        create+delete stays a well-formed net no-op for the window
+        parser."""
+        keyed: list[tuple[int, int, int]] = []
+        for seq, rec in enumerate(self.records):
+            create_step = self._step_of(rec.arrival_s, self.n_steps - 1)
+            keyed.append((create_step, 0, seq))
+            if rec.lifetime_s > 0:
+                # A delete never precedes its create; ends clamp to ONE
+                # step past the creation horizon, so a pod born in the
+                # last step still lives for a scheduling pass before it
+                # leaves.
+                del_step = max(
+                    self._step_of(rec.arrival_s + rec.lifetime_s, self.n_steps),
+                    create_step,
+                )
+                keyed.append((del_step, 1, seq))
+        keyed.sort()
+        return keyed
+
+    def materialize(self, key: "tuple[int, int, int]") -> "Operation":
+        from ksim_tpu.scenario.runner import Operation
+
+        step, phase, seq = key
+        rec = self.records[seq]
+        name = _pod_name(seq, rec)
+        if phase == 0:
+            return Operation(step=step, op="create", kind="pods", obj=_mk_pod(name, rec))
+        return Operation(
+            step=step, op="delete", kind="pods", name=name, namespace="default"
+        )
+
+
 def compile_trace(
     records: Sequence[TraceRecord],
     *,
@@ -157,73 +243,10 @@ def compile_trace(
     """Lower sorted records to the runner's ``Operation`` list: the
     step-0 node bootstrap, then each record's create (and delete, when
     its lifetime is known) on the fixed arrival-time grid."""
-    import random
-
-    from ksim_tpu.scenario.runner import Operation
-
-    if n_nodes <= 0:
-        raise TraceError("n_nodes must be positive")
-    if ops_per_step <= 0:
-        raise TraceError("ops_per_step must be positive")
-    if not records:
-        raise TraceError("trace compiled to zero records")
-    rng = random.Random(seed)
-    ops: list[Operation] = [
-        Operation(
-            step=0,
-            op="create",
-            kind="nodes",
-            obj=_mk_node(rng, f"node-{i}", _ZONES[i % len(_ZONES)]),
-        )
-        for i in range(n_nodes)
-    ]
-    t0 = min(r.arrival_s for r in records)
-    span = max(r.arrival_s for r in records) - t0
-    n_pod_events = sum(2 if r.lifetime_s > 0 else 1 for r in records)
-    n_steps = max(1, round(n_pod_events / ops_per_step))
-    tick = (span / n_steps) or 1.0
-
-    def step_of(t: float, horizon: int) -> int:
-        return 1 + min(int((t - t0) / tick), horizon)
-
-    # (step, phase, order) keys: creates (phase 0) in arrival order, then
-    # deletes (phase 1) in end-time order — a same-step create+delete
-    # stays a well-formed net no-op for the window parser.
-    keyed: list[tuple[int, int, int, Operation]] = []
-    for seq, rec in enumerate(records):
-        name = _pod_name(seq, rec)
-        create_step = step_of(rec.arrival_s, n_steps - 1)
-        keyed.append(
-            (
-                create_step,
-                0,
-                seq,
-                Operation(step=create_step, op="create", kind="pods", obj=_mk_pod(name, rec)),
-            )
-        )
-        if rec.lifetime_s > 0:
-            # A delete never precedes its create; ends clamp to ONE step
-            # past the creation horizon, so a pod born in the last step
-            # still lives for a scheduling pass before it leaves.
-            del_step = max(
-                step_of(rec.arrival_s + rec.lifetime_s, n_steps), create_step
-            )
-            keyed.append(
-                (
-                    del_step,
-                    1,
-                    seq,
-                    Operation(
-                        step=del_step,
-                        op="delete",
-                        kind="pods",
-                        name=name,
-                        namespace="default",
-                    ),
-                )
-            )
-    keyed.sort(key=lambda e: e[:3])
-    ops.extend(e[3] for e in keyed)
+    _validate_compile_args(records, n_nodes, ops_per_step)
+    ops = _node_ops(n_nodes, seed)
+    layout = _EventLayout(records, ops_per_step)
+    ops.extend(layout.materialize(k) for k in layout.keys())
     return ops
 
 
@@ -236,20 +259,32 @@ def trace_operations(
     seed: int = 0,
     ops_per_step: int = 100,
     source_nodes: "int | None" = None,
+    event_bound: int = 0,
+    node_bound: int = 0,
 ) -> "list[Operation]":
     """The one-call ingestion surface: parse ``source`` with the ``fmt``
     parser, resample to the node count / event budget, compile to the
     operation stream — all inside a ``scenario.ingest`` span so the
     ingestion cost shows up on the same timeline as the replay it
-    feeds."""
+    feeds.  ``event_bound``/``node_bound`` (0 = unbounded) arm EARLY
+    refusal: the single-pass selector raises
+    :class:`~ksim_tpu.traces.schema.TraceBoundExceeded` the moment the
+    compiled size provably passes the bound, so an oversized source
+    stops costing bytes mid-read instead of after full parse+compile
+    (the jobs plane maps it to HTTP 413)."""
     with TRACE.span("scenario.ingest", format=fmt, nodes=nodes) as span:
-        records = resample(
-            _parser(fmt)(source),
+        if node_bound and nodes > node_bound:
+            raise TraceBoundExceeded("nodes", node_bound, nodes)
+        selector = StreamSelector(
             seed=seed,
             max_events=max_events,
             target_nodes=nodes if source_nodes else None,
             source_nodes=source_nodes,
+            event_bound=event_bound,
+            base_events=nodes,
         )
+        selector.feed_all(_parser(fmt)(source))
+        records = selector.finish()
         ops = compile_trace(
             records, n_nodes=nodes, seed=seed, ops_per_step=ops_per_step
         )

@@ -28,7 +28,13 @@ from typing import IO, Iterable, Iterator
 
 from ksim_tpu.traces.schema import TraceError
 
-__all__ = ["list_traces", "open_trace_lines", "resolve", "trace_dir"]
+__all__ = [
+    "list_trace_entries",
+    "list_traces",
+    "open_trace_lines",
+    "resolve",
+    "trace_dir",
+]
 
 #: Default ``KSIM_TRACES_MAX_BYTES``: 64 MiB of (decompressed) input.
 DEFAULT_MAX_BYTES = 64 * 1024 * 1024
@@ -82,6 +88,63 @@ def list_traces() -> list[str]:
     return sorted(
         e for e in entries if _valid_name(e) and os.path.isfile(os.path.join(base, e))
     )
+
+
+def _sniff_format(path: str) -> str:
+    """Best-effort format detection from the first non-blank line (gz
+    transparent, bounded read): a JSON object is the Borg instance-event
+    table, an 8/9-column CSV row is an Alibaba workload table, anything
+    else — including unreadable or over-cap files — is ``"unknown"``.
+    Advisory metadata only: job submission still names the format
+    explicitly and the strict parsers remain the authority."""
+    import json
+
+    try:
+        for line in open_trace_lines(path, max_bytes=1 << 20):
+            text = line.strip()
+            if not text:
+                continue
+            if text.startswith("{"):
+                try:
+                    return "borg" if isinstance(json.loads(text), dict) else "unknown"
+                except ValueError:
+                    return "unknown"
+            if len(text.split(",")) in (8, 9):
+                return "alibaba"
+            return "unknown"
+    except TraceError:
+        return "unknown"
+    return "unknown"
+
+
+def list_trace_entries() -> list[dict]:
+    """Registered traces with per-entry metadata — the ``GET
+    /api/v1/traces`` shape: ``name`` / ``size_bytes`` (on-disk, NOT
+    decompressed) / ``gzip`` (magic-byte sniff) / ``format`` (detected,
+    advisory — see ``_sniff_format``).  Sorted by name like
+    :func:`list_traces`; entries that disappear or turn unreadable
+    mid-listing are skipped rather than failing the listing."""
+    base = trace_dir()
+    out: list[dict] = []
+    if base is None:
+        return out
+    for name in list_traces():
+        path = os.path.join(base, name)
+        try:
+            size = os.stat(path).st_size
+            with open(path, "rb") as f:
+                gz = f.read(2) == b"\x1f\x8b"
+        except OSError:
+            continue
+        out.append(
+            {
+                "name": name,
+                "size_bytes": size,
+                "gzip": gz,
+                "format": _sniff_format(path),
+            }
+        )
+    return out
 
 
 def _max_bytes() -> int:

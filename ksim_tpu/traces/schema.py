@@ -40,7 +40,13 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-__all__ = ["TraceRecord", "TraceError", "TraceParseError", "TIER_COUNT"]
+__all__ = [
+    "TraceRecord",
+    "TraceError",
+    "TraceParseError",
+    "TraceBoundExceeded",
+    "TIER_COUNT",
+]
 
 #: Normalized priority bands (see ``tier`` above).
 TIER_COUNT = 5
@@ -62,6 +68,24 @@ class TraceParseError(TraceError):
     def __init__(self, line: int, message: str) -> None:
         super().__init__(f"line {line}: {message}")
         self.line = line
+
+
+class TraceBoundExceeded(TraceError):
+    """A tenant ingest bound was provably exceeded MID-READ — raised by
+    the streaming selector the moment the compiled-event floor passes
+    the caller's limit, so oversized (or gzip-bomb-sized) traces stop
+    costing bytes immediately instead of after full parse+compile.
+    Carries machine-readable fields; the jobs plane maps it onto its
+    own limit vocabulary (``KSIM_JOBS_MAX_EVENTS`` / ``_MAX_NODES``)
+    and HTTP 413."""
+
+    def __init__(self, kind: str, limit: int, observed: int) -> None:
+        super().__init__(
+            f"trace ingest exceeds the {kind} bound: at least {observed} > {limit}"
+        )
+        self.kind = kind  # "events" | "nodes"
+        self.limit = limit
+        self.observed = observed
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,23 @@
+"""Seeded trace-ingest violation (tests/test_lint.py).
+
+NOT imported by anything.  The ``trace-ingest`` producer reaches
+``_account`` along a same-receiver edge, and ``_account`` WRITES a
+``# guarded-by: main-thread`` attribute — the one expected finding
+(cross-thread write; reads would be tolerated).
+"""
+
+import threading
+
+
+class Producer:
+    def __init__(self):
+        self.consumed = 0  # guarded-by: main-thread
+
+    def start(self):
+        threading.Thread(target=self._produce, daemon=True).start()
+
+    def _produce(self):  # ksimlint: thread-role(trace-ingest)
+        self._account()
+
+    def _account(self):
+        self.consumed += 1  # cross-thread write: the seeded finding

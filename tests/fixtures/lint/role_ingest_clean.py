@@ -1,0 +1,33 @@
+"""trace-ingest role with no violations (tests/test_lint.py).
+
+NOT imported by anything.  Mirrors ksim_tpu/traces/stream.py's shape:
+the producer thread carries the ``trace-ingest`` role, writes only its
+OWN (unguarded) stats attributes, and READS a main-thread-guarded
+counter for its progress line — off-main reads tolerate tearing.
+"""
+
+import queue
+import threading
+
+
+class Producer:
+    def __init__(self):
+        self.windows = 0  # producer-owned stat: unguarded by design
+        self.consumed = 0  # guarded-by: main-thread
+        self._q = queue.Queue(maxsize=4)
+
+    def start(self):
+        threading.Thread(target=self._produce, daemon=True).start()
+
+    def _produce(self):  # ksimlint: thread-role(trace-ingest)
+        for win in self._windows():
+            self._q.put(win)
+            self.windows += 1
+
+    def _windows(self):
+        _ = self.consumed  # off-main read: tolerated
+        yield []
+
+    def drain(self):  # ksimlint: thread-role(main-thread)
+        self.consumed += 1
+        return self._q.get_nowait()

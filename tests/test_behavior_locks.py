@@ -191,6 +191,46 @@ def test_trace_lock_borg_mini_device_vs_per_pass():
     assert driver.device_steps == len(dev.steps)
 
 
+def test_trace_lock_borg_mini_holds_with_streaming_ingest():
+    """Round 20: the SAME locked counts through the windowed streaming
+    pipeline (traces/stream.py feeding the runner window-by-window,
+    tiny windows so every step crosses a boundary) on BOTH the per-pass
+    and the device path — streaming is a transport change, not a
+    behavior change."""
+    from ksim_tpu.traces import stream_trace_operations
+
+    jax.config.update("jax_enable_x64", False)
+
+    def fresh():
+        return stream_trace_operations(
+            "tests/fixtures/traces/borg_mini.jsonl",
+            "borg",
+            nodes=24,
+            ops_per_step=2,
+            window=8,
+            queue_windows=2,
+        )
+
+    base = ScenarioRunner(pod_bucket_min=64).run(fresh())
+    assert base.events_applied == TRACE_LOCK_EVENTS
+    assert (base.pods_scheduled, base.unschedulable_attempts) == (
+        TRACE_LOCK_SCHEDULED,
+        TRACE_LOCK_UNSCHEDULABLE,
+    )
+    dev_r = ScenarioRunner(pod_bucket_min=64, device_replay=True)
+    dev = dev_r.run(fresh())
+    assert (dev.pods_scheduled, dev.unschedulable_attempts) == (
+        TRACE_LOCK_SCHEDULED,
+        TRACE_LOCK_UNSCHEDULABLE,
+    )
+    assert [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in dev.steps
+    ] == [
+        (s.step, s.scheduled, s.unschedulable, s.pending_after) for s in base.steps
+    ]
+    assert dev_r.replay_driver.fallback_steps == 0
+
+
 # The full 50k flagship locks (repo CLAUDE.md).
 LOCK_50K_SCHEDULED = 52_781
 LOCK_50K_UNSCHEDULABLE = 42_829

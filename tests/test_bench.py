@@ -325,6 +325,76 @@ def test_bench_churn_trace_child_survives_dead_device(tmp_path):
     assert rec["unsupported"].get("device_error", 0) >= 2
 
 
+_STREAM_CHILD_ARGS = [
+    "--stream-records", "400", "--stream-max-events", "120",
+    "--stream-nodes", "8", "--stream-ops-per-step", "10",
+    "--stream-window", "64", "--stream-queue", "2",
+]
+
+
+def test_bench_churn_stream_child_records_streaming_evidence(tmp_path):
+    """Round 20: the churn_stream child's record carries the streaming
+    acceptance evidence — the mid-run VmHWM snapshot (taken before the
+    materialized comparison), the events/sec headline, the producer's
+    window/queue stats with zero fallbacks, and streamed-vs-materialized
+    counts_match."""
+    out = tmp_path / "stream.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_stream", "--out", str(out),
+            *_STREAM_CHILD_ARGS,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=sanitized_cpu_env(),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["counts_match"] is True
+    assert rec["counts"] == rec["materialized_counts"]
+    assert rec["rss_after_stream_kb"] > 0
+    assert rec["rss_after_stream_kb"] <= rec["rss_peak_kb"]
+    assert rec["events_per_sec"] > 0
+    assert rec["window_ops"] == 64 and rec["queue_windows"] == 2
+    assert rec["windows"] >= 2  # ~128 ops over 64-op windows
+    assert rec["ingest_fallback"] == 0
+    assert rec["ingest_prefetches"] >= 1
+
+
+def test_bench_churn_stream_child_survives_dead_device(tmp_path):
+    """One-JSON-line-under-any-hardware, streaming edition: with every
+    dispatch failing the streamed replay degrades to the per-step host
+    path mid-pipeline, the streamed counts still match the materialized
+    run, and the record still exists."""
+    out = tmp_path / "stream_dead.json"
+    env = sanitized_cpu_env(
+        {
+            "KSIM_FAULTS": "replay.dispatch=always@device",
+            "KSIM_REPLAY_BREAKER_N": "2",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--child", "churn_stream", "--out", str(out),
+            *_STREAM_CHILD_ARGS,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["counts_match"] is True  # the host path carried the stream
+    assert rec["counts"] == rec["materialized_counts"]
+    assert rec["ingest_fallback"] == 0  # producer faults are a separate plane
+
+
 @pytest.mark.slow
 def test_bench_churn_restart_child_records_warm_restart_evidence(tmp_path):
     """Round 15: the churn_restart child's record carries the warm-restart

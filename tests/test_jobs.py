@@ -824,6 +824,33 @@ def test_job_limit_returns_413_over_http(monkeypatch):
         di.shutdown()
 
 
+def test_job_trace_bound_refused_during_streaming_ingest(monkeypatch):
+    """A trace-sourced spec over KSIM_JOBS_MAX_EVENTS is refused DURING
+    streaming ingest (TraceBoundExceeded -> JobLimitExceeded): the
+    refusal message carries both the env-var name and the early-stop
+    marker, and nothing is queued."""
+    from ksim_tpu.jobs import JobLimitExceeded
+
+    monkeypatch.setenv("KSIM_TRACES_DIR", "tests/fixtures/traces")
+    jm = JobManager(workers=0, queue_limit=8, max_job_events=5)
+    try:
+        with pytest.raises(JobLimitExceeded, match="KSIM_JOBS_MAX_EVENTS"):
+            jm.submit(
+                _trace_job(
+                    name="borg_mini.jsonl", format="borg", nodes=4, opsPerStep=8
+                )
+            )
+        with pytest.raises(JobLimitExceeded, match="ingest stopped early"):
+            jm.submit(
+                _trace_job(
+                    name="borg_mini.jsonl", format="borg", nodes=4, opsPerStep=8
+                )
+            )
+        assert jm.queue.depth() == 0
+    finally:
+        jm.shutdown(timeout=1)
+
+
 # ---------------------------------------------------------------------------
 # Round 14: trace-by-name submission + spec-armed chaos
 # ---------------------------------------------------------------------------
@@ -837,7 +864,7 @@ def test_job_submits_registered_trace_by_name(server, monkeypatch):
     monkeypatch.setenv("KSIM_TRACES_DIR", "tests/fixtures/traces")
     status, names = _req(server, "GET", "/api/v1/traces")
     assert status == 200
-    assert "alibaba_batch_mini.csv" in names["items"]
+    assert "alibaba_batch_mini.csv" in [e["name"] for e in names["items"]]
     status, job = _req(
         server,
         "POST",

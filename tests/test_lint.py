@@ -488,6 +488,25 @@ def test_cli_exits_1_on_seeded_concurrency_fixtures(capsys):
     assert out.count("[thread-role]") == 1
 
 
+def test_trace_ingest_role_fixtures(capsys):
+    """Round 20: ``trace-ingest`` is in the role vocabulary — the
+    stream.py-shaped clean fixture passes, the seeded cross-thread
+    write (producer storing to a main-thread-guarded attr through a
+    helper) fails, and the real producer module itself is clean under
+    the rule."""
+    from tools.ksimlint.__main__ import main
+
+    assert main(["--root", REPO, "tests/fixtures/lint/role_ingest_clean.py"]) == 0
+    capsys.readouterr()
+    assert main(["--root", REPO, "tests/fixtures/lint/role_ingest_bad.py"]) == 1
+    out = capsys.readouterr().out
+    assert out.count("[thread-role]") == 1 and "trace-ingest" in out
+    assert (
+        main(["--root", REPO, "--rule", "thread-role", "ksim_tpu/traces/stream.py"])
+        == 0
+    )
+
+
 def test_cli_rule_flag_filters(capsys):
     """--rule is the repeatable single-rule spelling of --rules; an
     unknown rule is still a loud exit 2."""

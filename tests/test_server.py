@@ -504,3 +504,35 @@ def test_yaml_resource_roundtrip(server):
     assert got["profiles"][0]["plugins"]["multiPoint"]["disabled"] == [
         {"name": "ImageLocality"}
     ]
+
+
+def test_traces_endpoint_lists_entries_with_metadata(server, tmp_path, monkeypatch):
+    """GET /api/v1/traces pins the registry-entry shape — ``name`` /
+    ``size_bytes`` / ``gzip`` / ``format`` — across a plain Borg JSONL,
+    an Alibaba CSV, and a gzipped trace (detected format is advisory;
+    job specs still name theirs explicitly)."""
+    import gzip
+
+    (tmp_path / "mini.jsonl").write_text('{"time": 0, "type": "SUBMIT"}\n')
+    (tmp_path / "batch.csv").write_text("t1,task,j1,1,0,100,Terminated,0.5,1.0\n")
+    with gzip.open(tmp_path / "mini2.jsonl.gz", "wt") as f:
+        f.write('{"time": 1}\n')
+    monkeypatch.setenv("KSIM_TRACES_DIR", str(tmp_path))
+    status, body = _req(server, "GET", "/api/v1/traces")
+    assert status == 200
+    items = body["items"]
+    assert [e["name"] for e in items] == [
+        "batch.csv",
+        "mini.jsonl",
+        "mini2.jsonl.gz",
+    ]
+    for entry in items:
+        assert set(entry) == {"name", "size_bytes", "gzip", "format"}
+        assert entry["size_bytes"] > 0
+    by_name = {e["name"]: e for e in items}
+    assert by_name["mini.jsonl"]["format"] == "borg"
+    assert by_name["mini.jsonl"]["gzip"] is False
+    assert by_name["batch.csv"]["format"] == "alibaba"
+    assert by_name["batch.csv"]["gzip"] is False
+    assert by_name["mini2.jsonl.gz"]["format"] == "borg"
+    assert by_name["mini2.jsonl.gz"]["gzip"] is True

@@ -13,7 +13,8 @@ functions; this rule makes the property interprocedural (docs/lint.md
   skipped — the conservative-dispatch soundness limit.
 - **Role vocabulary** (docs/lint.md): ``main-thread``,
   ``dispatch-worker``, ``job-worker``, ``sse-handler``, ``compactor``,
-  ``service-loop``, ``fleet-poller``, ``obs-publisher``.  Anything
+  ``service-loop``, ``fleet-poller``, ``obs-publisher``,
+  ``trace-ingest``.  Anything
   else is a finding (a
   typo'd role would silently opt out of every check below).
 - **Dispatch-worker strictness, propagated.**  The round-8 "no store to
@@ -57,6 +58,7 @@ ROLES = frozenset(
         "service-loop",
         "fleet-poller",
         "obs-publisher",
+        "trace-ingest",
     }
 )
 
